@@ -1,0 +1,35 @@
+"""kdtree_tpu — a TPU-native k-d tree framework.
+
+Re-expresses the capabilities of the reference OpenMP/MPI course project
+(Dan-Yeh/Parallel-Kd-Tree) as an idiomatic JAX/XLA/Pallas program: seeded
+problem generation, exact median-split k-d tree construction, exact (k-)NN
+queries, on one chip or a sharded mesh. See SURVEY.md at the repo root for the
+full structural analysis of the reference and the design mapping.
+"""
+
+from kdtree_tpu.models.tree import KDTree, TreeSpec, tree_spec
+from kdtree_tpu.ops.build import build, build_jit, validate_invariants
+from kdtree_tpu.ops.query import knn, nearest_neighbor
+from kdtree_tpu.ops.generate import (
+    generate_problem,
+    generate_points_rowwise,
+    generate_points_shard,
+)
+from kdtree_tpu.ops import bruteforce
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "KDTree",
+    "TreeSpec",
+    "tree_spec",
+    "build",
+    "build_jit",
+    "validate_invariants",
+    "knn",
+    "nearest_neighbor",
+    "generate_problem",
+    "generate_points_rowwise",
+    "generate_points_shard",
+    "bruteforce",
+]

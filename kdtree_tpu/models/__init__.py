@@ -1,0 +1,3 @@
+from kdtree_tpu.models.tree import KDTree, TreeSpec, node_levels, tree_spec
+
+__all__ = ["KDTree", "TreeSpec", "node_levels", "tree_spec"]

@@ -1,0 +1,152 @@
+"""Implicit array k-d tree model.
+
+The reference (``/root/reference/kdtree_sequential.cpp:30-70``) builds a heap of
+``Node{Point*, left, right}`` objects by host recursion. On TPU, pointer trees
+and host recursion are non-starters: everything under ``jit`` must have static
+shapes and compiler-friendly control flow. So the tree here is *data*:
+
+- ``points``      f32[N, D]   the point cloud (unchanged, never permuted)
+- ``node_point``  i32[H]      heap-indexed: node ``i`` has children ``2i+1`` /
+                              ``2i+2``; value is the index into ``points`` of
+                              the point stored at that node, or -1 if the node
+                              does not exist (empty subtree)
+- ``split_val``   f32[H]      the node's coordinate on its split axis
+                              (``axis = level(i) % D``, mirroring the cyclic
+                              axis choice at ``kdtree_sequential.cpp:42``)
+
+The *shape* of the tree (which heap slots exist, which permutation positions
+become which node) depends only on N — the reference's exact-median split
+(``median = n/2``; left ``n/2``, right ``n - n/2 - 1``,
+``kdtree_sequential.cpp:51-56``) makes every segment size a static function of
+N. ``TreeSpec`` precomputes that static structure once on the host (NumPy) and
+is cached per N; the device build (:mod:`kdtree_tpu.ops.build`) then only moves
+the dynamic content (the permutation) through ``lax.sort`` calls.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TreeSpec:
+    """Static (host-side) structure of a k-d tree over ``n`` points.
+
+    Attributes:
+      n: number of points.
+      num_levels: number of level-synchronous build rounds (= max tree depth).
+      heap_size: size of the implicit heap arrays (max node id + 1).
+      level_medpos: per level, the permutation positions consumed as that
+        level's node points (the segment medians), in segment order.
+      level_nodes: per level, the heap node ids those medians become.
+    """
+
+    n: int
+    num_levels: int
+    heap_size: int
+    level_medpos: Tuple[np.ndarray, ...]
+    level_nodes: Tuple[np.ndarray, ...]
+
+    @property
+    def consume_level(self) -> np.ndarray:
+        """i32[N]: build level at which each permutation position is consumed
+        as a node (positions never move after that level). Static in position
+        space — the single N-sized constant that lets the device build run as
+        one ``fori_loop`` with a single fused sort in the compiled program."""
+        out = np.empty(self.n, np.int32)
+        for lvl, pos in enumerate(self.level_medpos):
+            out[pos] = lvl
+        return out
+
+    @property
+    def all_medpos(self) -> np.ndarray:
+        return np.concatenate(self.level_medpos) if self.level_medpos else np.zeros(0, np.int32)
+
+    @property
+    def all_nodes(self) -> np.ndarray:
+        return np.concatenate(self.level_nodes) if self.level_nodes else np.zeros(0, np.int32)
+
+
+@functools.lru_cache(maxsize=64)
+def tree_spec(n: int) -> TreeSpec:
+    """Simulate the reference's recursion shape (sizes only) level by level.
+
+    Mirrors the arithmetic of ``build_tree_rec``
+    (``kdtree_sequential.cpp:51-56``): a segment of ``c`` points puts its
+    median at local offset ``c // 2``; the left child gets ``c // 2`` points,
+    the right child ``c - c//2 - 1``. Positions consumed as medians stay fixed
+    ("dead") for all deeper levels, so child segments are exactly the maximal
+    runs of live positions.
+    """
+    if n <= 0:
+        raise ValueError(f"n must be positive, got {n}")
+    segs = [(0, n, 0)]  # (start, count, heap node id)
+    level_medpos = []
+    level_nodes = []
+    max_node = 0
+    while segs:
+        medpos = np.empty(len(segs), np.int32)
+        nodes = np.empty(len(segs), np.int32)
+        nxt = []
+        for i, (s, c, node) in enumerate(segs):
+            m = c // 2
+            medpos[i] = s + m
+            nodes[i] = node
+            max_node = max(max_node, node)
+            if m > 0:
+                nxt.append((s, m, 2 * node + 1))
+            if c - m - 1 > 0:
+                nxt.append((s + m + 1, c - m - 1, 2 * node + 2))
+        level_medpos.append(medpos)
+        level_nodes.append(nodes)
+        segs = nxt
+    return TreeSpec(
+        n=n,
+        num_levels=len(level_medpos),
+        heap_size=max_node + 1,
+        level_medpos=tuple(level_medpos),
+        level_nodes=tuple(level_nodes),
+    )
+
+
+def node_levels(heap_size: int) -> np.ndarray:
+    """Static level of each heap node: level(i) = floor(log2(i + 1))."""
+    # frexp is exact for ints < 2**53 (unlike log2 which can round).
+    return (np.frexp(np.arange(1, heap_size + 1, dtype=np.int64).astype(np.float64))[1] - 1).astype(np.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+class KDTree:
+    """The built tree: a pytree of three arrays, jit/shard_map friendly."""
+
+    def __init__(self, points, node_point, split_val):
+        self.points = points
+        self.node_point = node_point
+        self.split_val = split_val
+
+    @property
+    def n(self) -> int:
+        return self.points.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.points.shape[1]
+
+    @property
+    def heap_size(self) -> int:
+        return self.node_point.shape[0]
+
+    def tree_flatten(self):
+        return (self.points, self.node_point, self.split_val), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"KDTree(n={self.n}, dim={self.dim}, heap_size={self.heap_size})"

@@ -1,0 +1,20 @@
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.build import build, build_jit, validate_invariants
+from kdtree_tpu.ops.generate import (
+    generate_problem,
+    generate_points_rowwise,
+    generate_points_shard,
+)
+from kdtree_tpu.ops.query import knn, nearest_neighbor
+
+__all__ = [
+    "bruteforce",
+    "build",
+    "build_jit",
+    "validate_invariants",
+    "generate_problem",
+    "generate_points_rowwise",
+    "generate_points_shard",
+    "knn",
+    "nearest_neighbor",
+]

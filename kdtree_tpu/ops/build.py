@@ -1,0 +1,143 @@
+"""Level-synchronous k-d tree construction.
+
+The reference builds depth-first with one ``std::sort`` per node
+(``kdtree_sequential.cpp:30-70``; O(N log^2 N) work, sequential). The TPU
+re-expression processes **all segments of a level at once** with a single
+``lax.sort`` over composite keys — the per-subtree OpenMP task parallelism the
+course spec asked for (SURVEY.md C16) falls out as plain data parallelism, and
+XLA maps it onto the chip.
+
+Per level:
+  1. ``segkey[p] = 2 * cumsum(dead)[p] - dead[p]`` — a monotone i32 that is
+     constant within each live segment and unique for every dead (already
+     consumed) position, so a stable sort by (segkey, coord, id) sorts within
+     segments while leaving consumed medians pinned in place.
+  2. one stable ``lax.sort`` of (segkey, axis coordinate, permutation).
+  3. mark this level's (static) median positions dead.
+
+The median positions and heap node ids per level are static functions of N
+(``TreeSpec``), because the reference's exact-median split arithmetic
+(``kdtree_sequential.cpp:51-56``) fixes every segment size in advance — that
+choice is what makes the whole build jit-compile with static shapes, and we
+keep it.
+
+Note: the reference's sort call excludes the last element of each sub-range
+(``kdtree_sequential.cpp:46-48``), a bug that corrupts low-D answers
+(SURVEY.md §3.5). This build sorts full segments — the corrected semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kdtree_tpu.models.tree import KDTree, TreeSpec, node_levels, tree_spec
+
+
+def build(points: jax.Array, spec: TreeSpec | None = None) -> KDTree:
+    """Build the implicit-array k-d tree over ``points`` (f32[N, D]).
+
+    Jit-compatible (shapes static given N); usable as-is inside ``shard_map``
+    for the per-device local build of the ensemble mode.
+    """
+    n, d = points.shape
+    if spec is None:
+        spec = tree_spec(n)
+    assert spec.n == n
+
+    # The dead set lives in *position* space and positions never move once
+    # consumed, so which positions are dead at level l is static: one N-sized
+    # constant instead of per-level scatter updates. That lets the level loop
+    # be a fori_loop with a single lax.sort in the compiled program — compile
+    # time is O(1) in tree depth (an unrolled loop at 1M points took ~3min of
+    # XLA compile; this takes seconds).
+    consume = jnp.asarray(spec.consume_level)
+
+    def level_step(lvl, perm):
+        dead = (consume < lvl).astype(jnp.int32)
+        csum = jnp.cumsum(dead)
+        segkey = 2 * csum - dead
+        axis = jnp.mod(lvl, d)
+        coord = points[perm, axis]
+        # Stable 3-key sort: segment id, coordinate, then original index —
+        # the (coord, id) composite makes exact-median selection deterministic
+        # under f32 ties (SURVEY.md §7 "hard parts").
+        _, _, perm = lax.sort((segkey, coord, perm), num_keys=3, is_stable=True)
+        return perm
+
+    perm = lax.fori_loop(
+        0, spec.num_levels, level_step, jnp.arange(n, dtype=jnp.int32)
+    )
+
+    # Consumed positions never move again, so one gather over the final
+    # permutation recovers every node's point.
+    all_nodes = jnp.asarray(spec.all_nodes)
+    all_medpos = jnp.asarray(spec.all_medpos)
+    node_point = jnp.full(spec.heap_size, -1, dtype=jnp.int32)
+    node_point = node_point.at[all_nodes].set(perm[all_medpos])
+
+    axes = jnp.asarray(node_levels(spec.heap_size) % d)
+    gathered = points[jnp.maximum(node_point, 0), axes]
+    split_val = jnp.where(node_point >= 0, gathered, jnp.float32(0))
+
+    return KDTree(points=points, node_point=node_point, split_val=split_val)
+
+
+#: Jitted entry point (spec derived from the static input shape).
+build_jit = jax.jit(lambda points: build(points))
+
+
+# ---------------------------------------------------------------------------
+# Host-side validation (test / debug utility — the working replacement for the
+# reference's dead tree printers, Utility.cpp:21-63).
+# ---------------------------------------------------------------------------
+
+
+def validate_invariants(tree: KDTree) -> None:
+    """Assert the k-d invariant on every node, host-side with NumPy.
+
+    For node i at level l with axis a = l % D: every point in the left subtree
+    has coord[a] <= split_val[i] and every point in the right subtree has
+    coord[a] >= split_val[i]. (Ties may land on either side of the median under
+    the deterministic (coord, id) composite sort, so the right-side comparison
+    is >=; the reference's unstable std::sort has the same latitude.)
+
+    Also checks that node_point is a permutation: every point appears exactly
+    once.
+    """
+    pts = np.asarray(tree.points)
+    npnt = np.asarray(tree.node_point)
+    sval = np.asarray(tree.split_val)
+    d = pts.shape[1]
+    levels = node_levels(tree.heap_size)
+
+    used = npnt[npnt >= 0]
+    assert used.size == tree.n, f"{used.size} nodes for {tree.n} points"
+    assert np.array_equal(np.sort(used), np.arange(tree.n)), "node_point is not a permutation"
+
+    def subtree_points(i):
+        out = []
+        stack = [i]
+        while stack:
+            j = stack.pop()
+            if j >= tree.heap_size or npnt[j] < 0:
+                continue
+            out.append(npnt[j])
+            stack.extend((2 * j + 1, 2 * j + 2))
+        return np.array(out, dtype=np.int64)
+
+    for i in range(tree.heap_size):
+        if npnt[i] < 0:
+            continue
+        a = levels[i] % d
+        assert sval[i] == pts[npnt[i], a], f"split_val mismatch at node {i}"
+        left = subtree_points(2 * i + 1) if 2 * i + 1 < tree.heap_size else np.zeros(0, np.int64)
+        right = subtree_points(2 * i + 2) if 2 * i + 2 < tree.heap_size else np.zeros(0, np.int64)
+        if left.size:
+            assert pts[left, a].max() <= sval[i], f"left violation at node {i}"
+        if right.size:
+            assert pts[right, a].min() >= sval[i], f"right violation at node {i}"

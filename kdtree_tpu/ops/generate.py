@@ -1,0 +1,73 @@
+"""Problem generation.
+
+The reference generates the point cloud host-side with ``std::mt19937`` +
+``uniform_real_distribution<float>(-100, 100)`` (``Utility.cpp:6-18``), with
+queries as the last ``num_queries`` rows (``kdtree_sequential.cpp:157``). Its
+MPI variant regenerates only the local shard via ``random.discard``
+(``kdtree_mpi.cpp:19-41``) — a communication-avoidance trick.
+
+The TPU-native path uses JAX's counter-based threefry PRNG: generation happens
+on device, and shard-local generation is free — each device fills its own rows
+of the same deterministic global array, the counter-based analog of the
+reference's ``discard`` trick. Bit-exact replay of the reference's mt19937
+stream (for golden parity against the reference binary) lives in
+:mod:`kdtree_tpu.native` instead.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+COORD_MIN = -100.0  # Utility.cpp:8
+COORD_MAX = 100.0
+
+
+def generate_problem(
+    seed: int, dim: int, num_points: int, num_queries: int = 10, dtype=jnp.float32
+) -> Tuple[jax.Array, jax.Array]:
+    """Generate ``(points[num_points, dim], queries[num_queries, dim])``.
+
+    Same contract as the reference (seeded, uniform in [-100, 100), queries
+    drawn after/apart from the points) but with the threefry PRNG so the same
+    seed gives the same problem on any device count or mesh layout.
+    """
+    kp, kq = jax.random.split(jax.random.key(seed), 2)
+    points = jax.random.uniform(
+        kp, (num_points, dim), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX
+    )
+    queries = jax.random.uniform(
+        kq, (num_queries, dim), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX
+    )
+    return points, queries
+
+
+def generate_points_shard(
+    seed: int, dim: int, shard_start: int, shard_rows: int, dtype=jnp.float32
+) -> jax.Array:
+    """Generate rows ``[shard_start, shard_start + shard_rows)`` of the global
+    point array, without generating the rest.
+
+    The counter-based equivalent of the reference's ``random.discard`` skip
+    (``kdtree_mpi.cpp:24,32``): each row's bits depend only on (seed, row), so
+    any shard can be produced independently and the union over shards is
+    bit-identical to the single-device :func:`generate_problem` output.
+    """
+    kp, _ = jax.random.split(jax.random.key(seed), 2)
+    row_keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(
+        jnp.arange(shard_start, shard_start + shard_rows)
+    )
+    return jax.vmap(
+        lambda k: jax.random.uniform(k, (dim,), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX)
+    )(row_keys)
+
+
+def generate_points_rowwise(seed: int, dim: int, num_points: int, dtype=jnp.float32) -> jax.Array:
+    """Whole-array variant of :func:`generate_points_shard` (rows 0..N).
+
+    Use this (not :func:`generate_problem`) when single-device output must be
+    bit-identical to multi-device shard-local generation.
+    """
+    return generate_points_shard(seed, dim, 0, num_points, dtype=dtype)

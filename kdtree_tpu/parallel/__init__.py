@@ -1,0 +1,4 @@
+from kdtree_tpu.parallel.ensemble import ensemble_knn
+from kdtree_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+__all__ = ["ensemble_knn", "make_mesh", "SHARD_AXIS"]

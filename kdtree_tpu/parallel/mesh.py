@@ -1,0 +1,27 @@
+"""Device-mesh helpers.
+
+The reference's distributed substrate is MPI (``MPI_Init``/``Bcast``/``Reduce``
+over ranks, ``kdtree_mpi.cpp:177-199,253``). Here the substrate is a
+``jax.sharding.Mesh``: ranks become mesh axis positions, the Bcast becomes
+replication, and reductions become XLA collectives riding ICI/DCN. Tests fake a
+pod with ``--xla_force_host_platform_device_count`` — the analog of the
+reference's ``mpirun --oversubscribe`` (``Makefile:36``).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
+    """1-D mesh over the first ``num_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(f"requested {num_devices} devices, have {len(devs)}")
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis,))

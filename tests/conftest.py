@@ -1,0 +1,30 @@
+"""Test environment: fake an 8-device pod on CPU.
+
+The analog of the reference's ``mpirun --oversubscribe`` (Makefile:36): the
+same sharded code paths run against 8 virtual CPU devices so multi-chip logic
+is exercised without a pod. Must run before the first ``import jax``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize force-registers the axon TPU backend and
+# prepends it to jax_platforms; pin the config back to pure CPU so the
+# virtual 8-device mesh is what tests see.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,52 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_jit, generate_problem, tree_spec, validate_invariants
+from kdtree_tpu.models.tree import node_levels
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 100, 1000])
+def test_spec_consumes_every_point(n):
+    spec = tree_spec(n)
+    pos = spec.all_medpos
+    assert sorted(pos.tolist()) == list(range(n))
+    assert len(set(spec.all_nodes.tolist())) == n
+    assert spec.num_levels <= int(np.ceil(np.log2(n + 1))) + 1
+
+
+def test_spec_matches_reference_split_arithmetic():
+    """left = n/2, node = 1, right = n - n/2 - 1 (kdtree_sequential.cpp:51-56)."""
+    spec = tree_spec(10)
+    # root consumes position 10 // 2 = 5 as heap node 0
+    assert spec.level_medpos[0][0] == 5 and spec.level_nodes[0][0] == 0
+    # level 1: left segment [0, 5) -> median 2, right segment [6, 10) -> median 8
+    assert spec.level_medpos[1].tolist() == [2, 8]
+    assert spec.level_nodes[1].tolist() == [1, 2]
+
+
+@pytest.mark.parametrize("n,d", [(1, 3), (2, 3), (3, 2), (17, 3), (128, 2), (1000, 3), (513, 8)])
+def test_invariants(n, d):
+    pts, _ = generate_problem(seed=n + d, dim=d, num_points=n)
+    tree = build_jit(pts)
+    validate_invariants(tree)
+
+
+def test_node_levels():
+    lv = node_levels(15)
+    assert lv.tolist() == [0, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3, 3, 3, 3, 3]
+
+
+def test_build_deterministic():
+    pts, _ = generate_problem(seed=3, dim=3, num_points=257)
+    t1 = build_jit(pts)
+    t2 = build_jit(pts)
+    np.testing.assert_array_equal(np.asarray(t1.node_point), np.asarray(t2.node_point))
+
+
+def test_build_with_duplicate_points():
+    """f32 ties: exact-median determinism via the (coord, id) composite key."""
+    base = jnp.ones((16, 3), jnp.float32)
+    pts = jnp.concatenate([base, 2.0 * base, base], axis=0)
+    tree = build_jit(pts)
+    validate_invariants(tree)
