@@ -1,0 +1,3 @@
+from kdtree_tpu.utils.cli import main
+
+main()

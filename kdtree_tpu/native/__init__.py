@@ -1,0 +1,76 @@
+"""ctypes binding for the native mt19937 replay generator.
+
+Compiled on first use with the system g++ into ``_build/`` next to this file;
+falls back gracefully (``available() -> False``) when no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "gen.cpp"
+_SO = _HERE / "_build" / "libkdtgen.so"
+_lock = threading.Lock()
+_lib = None
+_failed = False
+
+
+def _load():
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+                _SO.parent.mkdir(parents=True, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     str(_SRC), "-o", str(_SO)],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(str(_SO))
+            lib.kdt_generate_rows.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.kdt_generate_rows.restype = None
+            lib.kdt_first_draw.argtypes = [ctypes.c_int32]
+            lib.kdt_first_draw.restype = ctypes.c_float
+            _lib = lib
+        except Exception:
+            _failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def generate_rows(seed: int, dim: int, row_start: int, row_count: int) -> np.ndarray:
+    """Rows [row_start, row_start+row_count) of the reference mt19937 stream,
+    bit-identical to Utility.cpp:6-18 / kdtree_mpi.cpp:19-41."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native generator unavailable (no g++ toolchain?)")
+    out = np.empty((row_count, dim), dtype=np.float32)
+    lib.kdt_generate_rows(
+        seed, dim, row_start, row_count,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
+
+
+def generate_problem_mt19937(seed: int, dim: int, num_points: int, num_queries: int = 10):
+    """(points[N, D], queries[Q, D]) with the reference's exact layout:
+    one stream of N+Q rows, queries last (kdtree_sequential.cpp:157,169)."""
+    rows = generate_rows(seed, dim, 0, num_points + num_queries)
+    return rows[:num_points], rows[num_points:]

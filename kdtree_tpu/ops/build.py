@@ -29,6 +29,7 @@ Note: the reference's sort call excludes the last element of each sub-range
 from __future__ import annotations
 
 import functools
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,26 +38,55 @@ from jax import lax
 
 from kdtree_tpu.models.tree import KDTree, TreeSpec, node_levels, tree_spec
 
+# The static structure arrays are O(N); embedding them as HLO constants bloats
+# the program (a 16M-point build produced a >100 MB module that the remote
+# TPU compiler rejected outright). So they are *runtime arguments* everywhere:
+# spec_arrays() materializes them once per (n, d) on the default device, and
+# the jitted/sharded builds thread them through as inputs.
 
-def build(points: jax.Array, spec: TreeSpec | None = None) -> KDTree:
-    """Build the implicit-array k-d tree over ``points`` (f32[N, D]).
 
-    Jit-compatible (shapes static given N); usable as-is inside ``shard_map``
-    for the per-device local build of the ensemble mode.
-    """
+@functools.lru_cache(maxsize=16)
+def _position_arrays(n: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    spec = tree_spec(n)
+    return (
+        jnp.asarray(spec.consume_level),
+        jnp.asarray(spec.all_nodes),
+        jnp.asarray(spec.all_medpos),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _node_axes(heap_size: int, d: int) -> jax.Array:
+    return jnp.asarray(node_levels(heap_size) % d)
+
+
+def spec_arrays(n: int, d: int) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Device-resident structure arrays for a tree over n points in d dims:
+    (consume_level i32[N], all_nodes i32[N], all_medpos i32[N],
+    node_axes i32[H]). The O(N) position arrays are d-independent and cached
+    per n; only the small node_axes array is per (heap_size, d)."""
+    consume, all_nodes, all_medpos = _position_arrays(n)
+    return consume, all_nodes, all_medpos, _node_axes(tree_spec(n).heap_size, d)
+
+
+def build_impl(
+    points: jax.Array,
+    consume: jax.Array,
+    all_nodes: jax.Array,
+    all_medpos: jax.Array,
+    node_axes: jax.Array,
+    *,
+    num_levels: int,
+) -> KDTree:
+    """Pure traceable build; structure arrays are inputs, not constants."""
     n, d = points.shape
-    if spec is None:
-        spec = tree_spec(n)
-    assert spec.n == n
+    heap_size = node_axes.shape[0]
 
     # The dead set lives in *position* space and positions never move once
-    # consumed, so which positions are dead at level l is static: one N-sized
-    # constant instead of per-level scatter updates. That lets the level loop
-    # be a fori_loop with a single lax.sort in the compiled program — compile
-    # time is O(1) in tree depth (an unrolled loop at 1M points took ~3min of
-    # XLA compile; this takes seconds).
-    consume = jnp.asarray(spec.consume_level)
-
+    # consumed, so deadness at level l is `consume < l` — no per-level scatter.
+    # That lets the level loop be a fori_loop with a single lax.sort in the
+    # compiled program: compile time is O(1) in tree depth (an unrolled loop
+    # at 1M points took ~3min of XLA compile; this takes seconds).
     def level_step(lvl, perm):
         dead = (consume < lvl).astype(jnp.int32)
         csum = jnp.cumsum(dead)
@@ -69,26 +99,52 @@ def build(points: jax.Array, spec: TreeSpec | None = None) -> KDTree:
         _, _, perm = lax.sort((segkey, coord, perm), num_keys=3, is_stable=True)
         return perm
 
-    perm = lax.fori_loop(
-        0, spec.num_levels, level_step, jnp.arange(n, dtype=jnp.int32)
-    )
+    perm = lax.fori_loop(0, num_levels, level_step, jnp.arange(n, dtype=jnp.int32))
 
     # Consumed positions never move again, so one gather over the final
     # permutation recovers every node's point.
-    all_nodes = jnp.asarray(spec.all_nodes)
-    all_medpos = jnp.asarray(spec.all_medpos)
-    node_point = jnp.full(spec.heap_size, -1, dtype=jnp.int32)
+    node_point = jnp.full(heap_size, -1, dtype=jnp.int32)
     node_point = node_point.at[all_nodes].set(perm[all_medpos])
 
-    axes = jnp.asarray(node_levels(spec.heap_size) % d)
-    gathered = points[jnp.maximum(node_point, 0), axes]
+    gathered = points[jnp.maximum(node_point, 0), node_axes]
     split_val = jnp.where(node_point >= 0, gathered, jnp.float32(0))
 
     return KDTree(points=points, node_point=node_point, split_val=split_val)
 
 
-#: Jitted entry point (spec derived from the static input shape).
-build_jit = jax.jit(lambda points: build(points))
+def build(points: jax.Array, spec: TreeSpec | None = None) -> KDTree:
+    """Build the implicit-array k-d tree over ``points`` (f32[N, D]).
+
+    Traceable under jit/shard_map. NOTE: when traced, the structure arrays
+    become program constants — fine for small/medium N; for large N prefer
+    :func:`build_jit`, which passes them as runtime arguments.
+    """
+    n, d = points.shape
+    if spec is None:
+        spec = tree_spec(n)
+    assert spec.n == n
+    consume, all_nodes, all_medpos, node_axes = spec_arrays(n, d)
+    return build_impl(
+        points, consume, all_nodes, all_medpos, node_axes, num_levels=spec.num_levels
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_levels",))
+def _build_jit_impl(points, consume, all_nodes, all_medpos, node_axes, num_levels):
+    return build_impl(
+        points, consume, all_nodes, all_medpos, node_axes, num_levels=num_levels
+    )
+
+
+def build_jit(points: jax.Array) -> KDTree:
+    """Jitted build; structure arrays enter as device inputs (no giant HLO
+    constants), cached per (N, D)."""
+    n, d = points.shape
+    spec = tree_spec(n)
+    consume, all_nodes, all_medpos, node_axes = spec_arrays(n, d)
+    return _build_jit_impl(
+        points, consume, all_nodes, all_medpos, node_axes, spec.num_levels
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -28,18 +28,21 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kdtree_tpu.models.tree import tree_spec
-from kdtree_tpu.ops.build import build
+from kdtree_tpu.ops.build import build_impl, spec_arrays
 from kdtree_tpu.ops.query import _knn_batch
 
 from .mesh import SHARD_AXIS
 
 
-def _local_build_query(points_local, queries, k: int, axis_name: str):
-    """Per-device program: build local tree, query, globalize indices."""
+def _local_build_query(points_local, queries, structure, k: int, num_levels: int,
+                       axis_name: str):
+    """Per-device program: build local tree, query, globalize indices.
+
+    ``structure`` carries the (replicated) spec arrays as runtime inputs so
+    they don't get embedded as O(N/P) constants in the sharded program."""
     n_local = points_local.shape[0]
-    spec = tree_spec(n_local)
-    tree = build(points_local, spec)
-    d2, idx = _knn_batch(tree.node_point, tree.points, queries, k, spec.num_levels)
+    tree = build_impl(points_local, *structure, num_levels=num_levels)
+    d2, idx = _knn_batch(tree.node_point, tree.points, queries, k, num_levels)
     shard = lax.axis_index(axis_name)
     gidx = jnp.where(idx >= 0, idx + shard * n_local, -1)
     # merge the P local top-k lists into the exact global top-k
@@ -53,8 +56,9 @@ def _local_build_query(points_local, queries, k: int, axis_name: str):
     return -neg, jnp.take_along_axis(cat_i, sel, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "mesh", "pad_value"))
-def _ensemble_jit(points, queries, k: int, mesh: Mesh, pad_value: float):
+@functools.partial(jax.jit, static_argnames=("k", "mesh", "pad_value", "num_levels"))
+def _ensemble_jit(points, queries, structure, k: int, mesh: Mesh, pad_value: float,
+                  num_levels: int):
     n, d = points.shape
     p = mesh.shape[SHARD_AXIS]
     pad = (-n) % p
@@ -63,13 +67,15 @@ def _ensemble_jit(points, queries, k: int, mesh: Mesh, pad_value: float):
             [points, jnp.full((pad, d), pad_value, points.dtype)], axis=0
         )
     fn = jax.shard_map(
-        functools.partial(_local_build_query, k=k, axis_name=SHARD_AXIS),
+        functools.partial(
+            _local_build_query, k=k, num_levels=num_levels, axis_name=SHARD_AXIS
+        ),
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS, None), P(None, None)),
+        in_specs=(P(SHARD_AXIS, None), P(None, None), P(None)),
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
     )
-    d2, gidx = fn(points, queries)
+    d2, gidx = fn(points, queries, structure)
     # padding rows (if any) can never win: +inf coords give +inf distances
     return d2, jnp.where(gidx < n, gidx, -1).astype(jnp.int32)
 
@@ -93,4 +99,9 @@ def ensemble_knn(
 
         mesh = make_mesh()
     k = min(k, points.shape[0])
-    return _ensemble_jit(points, queries, k, mesh, float("inf"))
+    n, d = points.shape
+    p = mesh.shape[SHARD_AXIS]
+    n_local = (n + p - 1) // p  # ceil-div: padded rows / shard count
+    structure = spec_arrays(n_local, d)
+    num_levels = tree_spec(n_local).num_levels
+    return _ensemble_jit(points, queries, structure, k, mesh, float("inf"), num_levels)

@@ -1,0 +1,33 @@
+"""Tree serialization.
+
+The reference's tree lives only in process memory (heap ``Node``s freed at
+exit, ``Utility.cpp:40-45``) — no persistence at all. The implicit-array
+representation makes checkpointing trivial: three arrays to npz. Save/load is
+deterministic and device-agnostic (arrays come back on the default device).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kdtree_tpu.models.tree import KDTree
+
+
+def save_tree(path: str, tree: KDTree) -> None:
+    np.savez_compressed(
+        path,
+        points=np.asarray(tree.points),
+        node_point=np.asarray(tree.node_point),
+        split_val=np.asarray(tree.split_val),
+    )
+
+
+def load_tree(path: str) -> KDTree:
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        return KDTree(
+            points=jnp.asarray(z["points"]),
+            node_point=jnp.asarray(z["node_point"]),
+            split_val=jnp.asarray(z["split_val"]),
+        )

@@ -1,0 +1,55 @@
+"""Benchmark timing — the working replacement for the reference's DEBUG timer.
+
+The reference wraps all of main in one chrono timer behind a compile-time
+macro (``kdtree_sequential.cpp:146-154,186-191``), conflating generation,
+build, and query, and conflating compile with run. Here: named phases, each
+fenced with ``jax.block_until_ready`` so async dispatch can't lie, and
+explicit warmup so compile time is reported separately.
+
+Measured pitfall on the axon TPU platform (see .claude/skills/verify/SKILL.md):
+re-running a jitted function on the *same* input array can report ~0s; always
+time with fresh inputs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict
+
+import jax
+
+
+class PhaseTimer:
+    """Collects named phase durations; each phase blocks on its outputs."""
+
+    def __init__(self) -> None:
+        self.phases: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        holder: list[Any] = []
+        t0 = time.perf_counter()
+        try:
+            yield holder
+        finally:
+            if holder:
+                jax.block_until_ready(holder)
+                # belt-and-braces sync: on axon, block_until_ready can return
+                # early under a deep dispatch queue; a 1-element host fetch of
+                # each output is a true data-dependent barrier and costs only
+                # the tunnel RTT.
+                import numpy as _np
+
+                for leaf in jax.tree_util.tree_leaves(holder):
+                    if hasattr(leaf, "ravel"):
+                        _np.asarray(leaf.ravel()[:1])
+            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0
+
+    def total(self) -> float:
+        return sum(self.phases.values())
+
+    def report(self) -> Dict[str, float]:
+        out = dict(self.phases)
+        out["total"] = self.total()
+        return out

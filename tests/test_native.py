@@ -1,0 +1,31 @@
+import numpy as np
+import pytest
+
+from kdtree_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="no g++ toolchain")
+
+
+def test_rows_deterministic():
+    a = native.generate_rows(42, 3, 0, 100)
+    b = native.generate_rows(42, 3, 0, 100)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.float32 and a.shape == (100, 3)
+    assert a.min() >= -100.0 and a.max() < 100.0
+
+
+def test_discard_window_matches_full_stream():
+    """The MPI discard trick (kdtree_mpi.cpp:24,32): any row window equals the
+    corresponding slice of the full stream."""
+    full = native.generate_rows(7, 5, 0, 200)
+    for start, count in ((0, 10), (50, 25), (199, 1)):
+        win = native.generate_rows(7, 5, start, count)
+        np.testing.assert_array_equal(full[start : start + count], win)
+
+
+def test_problem_layout():
+    """Queries are the LAST rows of the stream (kdtree_sequential.cpp:157)."""
+    pts, qs = native.generate_problem_mt19937(1, 4, 50, 10)
+    full = native.generate_rows(1, 4, 0, 60)
+    np.testing.assert_array_equal(pts, full[:50])
+    np.testing.assert_array_equal(qs, full[50:])

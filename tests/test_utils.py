@@ -1,0 +1,45 @@
+import numpy as np
+
+from kdtree_tpu import build_jit, generate_problem, knn
+from kdtree_tpu.utils.checkpoint import load_tree, save_tree
+from kdtree_tpu.utils.timing import PhaseTimer
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    pts, qs = generate_problem(seed=2, dim=3, num_points=300, num_queries=5)
+    tree = build_jit(pts)
+    path = str(tmp_path / "tree.npz")
+    save_tree(path, tree)
+    tree2 = load_tree(path)
+    np.testing.assert_array_equal(np.asarray(tree.node_point), np.asarray(tree2.node_point))
+    d1, i1 = knn(tree, qs, k=3)
+    d2, i2 = knn(tree2, qs, k=3)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_phase_timer():
+    t = PhaseTimer()
+    with t.phase("a") as h:
+        x, _ = generate_problem(seed=1, dim=2, num_points=64)
+        h.append(x)
+    with t.phase("b"):
+        pass
+    rep = t.report()
+    assert set(rep) == {"a", "b", "total"}
+    assert rep["total"] >= rep["a"] >= 0.0
+
+
+def test_graft_entry():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    import __graft_entry__ as ge
+
+    import jax
+
+    fn, args = ge.entry()
+    d2, idx = jax.jit(fn)(*args)
+    assert d2.shape == (64, 16)
+    ge.dryrun_multichip(8)
