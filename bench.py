@@ -37,7 +37,7 @@ def main() -> None:
         pts, qs = kt.generate_problem(seed=seed, dim=dim, num_points=n, num_queries=nq)
         tree = kt.build_jit(pts)
         d2, idx = kt.nearest_neighbor(tree, qs)
-        return d2
+        return pts, qs, d2
 
     # warmup / compile (fresh seed so nothing is cached from prior runs).
     # NOTE: sync via host fetch, not block_until_ready — on the axon platform
@@ -45,20 +45,22 @@ def main() -> None:
     # (measured: it reported a 16M build+query chain as 1.1ms; a host fetch
     # shows the true 8.4s). The fetched result is 10 floats, so the ~0.1s
     # tunnel RTT is noise against the measured phase.
-    np.asarray(run(999))
+    np.asarray(run(999)[2])
 
     times = []
+    last = None
     for seed in (1, 2, 3):
         t0 = time.perf_counter()
-        np.asarray(run(seed))
+        out = run(seed)
+        np.asarray(out[2])
         times.append(time.perf_counter() - t0)
+        last = out
     best = min(times)
     pts_per_s = n / best
 
-    # sanity: answers must match the oracle (don't publish garbage speed)
-    pts, qs = kt.generate_problem(seed=1, dim=dim, num_points=n, num_queries=nq)
-    tree = kt.build_jit(pts)
-    d2, _ = kt.nearest_neighbor(tree, qs)
+    # sanity on the last timed run: answers must match the oracle
+    # (don't publish garbage speed)
+    pts, qs, d2 = last
     bf, _ = kt.bruteforce.knn(pts, qs, k=1)
     if not np.allclose(np.asarray(d2), np.asarray(bf)[:, 0], rtol=1e-4):
         print(json.dumps({"metric": "FAILED oracle check", "value": 0, "unit": "", "vs_baseline": 0}))

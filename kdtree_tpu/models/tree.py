@@ -51,6 +51,7 @@ class TreeSpec:
     heap_size: int
     level_medpos: Tuple[np.ndarray, ...]
     level_nodes: Tuple[np.ndarray, ...]
+    level_segstart: Tuple[np.ndarray, ...]  # per level: segment start per node
 
     @property
     def consume_level(self) -> np.ndarray:
@@ -61,6 +62,16 @@ class TreeSpec:
         out = np.empty(self.n, np.int32)
         for lvl, pos in enumerate(self.level_medpos):
             out[pos] = lvl
+        return out
+
+    @property
+    def position_node(self) -> np.ndarray:
+        """i32[N]: heap node id that each permutation position becomes (every
+        position is consumed exactly once). Static — lets sharded builds map
+        owned positions to nodes without host coordination."""
+        out = np.empty(self.n, np.int32)
+        for pos, nodes in zip(self.level_medpos, self.level_nodes):
+            out[pos] = nodes
         return out
 
     @property
@@ -88,15 +99,18 @@ def tree_spec(n: int) -> TreeSpec:
     segs = [(0, n, 0)]  # (start, count, heap node id)
     level_medpos = []
     level_nodes = []
+    level_segstart = []
     max_node = 0
     while segs:
         medpos = np.empty(len(segs), np.int32)
         nodes = np.empty(len(segs), np.int32)
+        starts = np.empty(len(segs), np.int32)
         nxt = []
         for i, (s, c, node) in enumerate(segs):
             m = c // 2
             medpos[i] = s + m
             nodes[i] = node
+            starts[i] = s
             max_node = max(max_node, node)
             if m > 0:
                 nxt.append((s, m, 2 * node + 1))
@@ -104,6 +118,7 @@ def tree_spec(n: int) -> TreeSpec:
                 nxt.append((s + m + 1, c - m - 1, 2 * node + 2))
         level_medpos.append(medpos)
         level_nodes.append(nodes)
+        level_segstart.append(starts)
         segs = nxt
     return TreeSpec(
         n=n,
@@ -111,6 +126,7 @@ def tree_spec(n: int) -> TreeSpec:
         heap_size=max_node + 1,
         level_medpos=tuple(level_medpos),
         level_nodes=tuple(level_nodes),
+        level_segstart=tuple(level_segstart),
     )
 
 

@@ -29,15 +29,23 @@ def _load():
     with _lock:
         if _lib is not None or _failed:
             return _lib
+        def _compile():
+            _SO.parent.mkdir(parents=True, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 str(_SRC), "-o", str(_SO)],
+                check=True, capture_output=True,
+            )
+
         try:
             if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-                _SO.parent.mkdir(parents=True, exist_ok=True)
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     str(_SRC), "-o", str(_SO)],
-                    check=True, capture_output=True,
-                )
-            lib = ctypes.CDLL(str(_SO))
+                _compile()
+            try:
+                lib = ctypes.CDLL(str(_SO))
+            except OSError:
+                # stale or wrong-arch binary: force one rebuild before giving up
+                _compile()
+                lib = ctypes.CDLL(str(_SO))
             lib.kdt_generate_rows.argtypes = [
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_float),

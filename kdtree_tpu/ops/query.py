@@ -32,10 +32,17 @@ from jax import lax
 from kdtree_tpu.models.tree import KDTree, tree_spec
 
 
-def _knn_one(node_point, points, max_depth: int, k: int, q):
-    """Exact k-NN for a single query; shapes static, vmap-friendly."""
-    heap_size = node_point.shape[0]
-    d = points.shape[1]
+def _knn_one(get_node, heap_size: int, d: int, max_depth: int, k: int, q):
+    """Exact k-NN for a single query; shapes static, vmap-friendly.
+
+    ``get_node(node) -> (coords f32[D], id i32, traversable bool)`` abstracts
+    the tree storage: the classic tree gathers ``points[node_point[node]]``,
+    the global (distributed-build) tree reads a node-coordinate heap directly.
+    ``traversable`` means the node's subtree may contain real points (for the
+    classic tree that's just "slot occupied"; the global tree keeps +inf
+    padding sentinels as non-takeable nodes whose *left* subtrees still hold
+    real points). ``id < 0`` means the node's own point must not be taken.
+    """
     stack_cap = max_depth + 2  # one far-sibling per level + the live path head
 
     stack_n = jnp.zeros(stack_cap, jnp.int32)
@@ -55,17 +62,15 @@ def _knn_one(node_point, points, max_depth: int, k: int, q):
 
         worst = jnp.max(best_d)
         node_c = jnp.minimum(node, heap_size - 1)
-        pidx = node_point[node_c]
-        exists = (node < heap_size) & (pidx >= 0)
-        visit = exists & (bound < worst)
+        p, pidx, traversable = get_node(node_c)
+        visit = (node < heap_size) & traversable & (bound < worst)
 
-        p = points[jnp.maximum(pidx, 0)]
         diff = q - p
         d2 = jnp.sum(diff * diff)
 
         # insert into the k-buffer, replacing the current worst
         wi = jnp.argmax(best_d)
-        take = visit & (d2 < worst)
+        take = visit & (d2 < worst) & (pidx >= 0)
         best_d = jnp.where(take, best_d.at[wi].set(d2), best_d)
         best_i = jnp.where(take, best_i.at[wi].set(pidx), best_i)
 
@@ -95,7 +100,33 @@ def _knn_one(node_point, points, max_depth: int, k: int, q):
 
 @functools.partial(jax.jit, static_argnames=("k", "max_depth"))
 def _knn_batch(node_point, points, queries, k: int, max_depth: int):
-    return jax.vmap(lambda q: _knn_one(node_point, points, max_depth, k, q))(queries)
+    heap_size = node_point.shape[0]
+    d = points.shape[1]
+
+    def get_node(node):
+        pidx = node_point[node]
+        return points[jnp.maximum(pidx, 0)], pidx, pidx >= 0
+
+    return jax.vmap(
+        lambda q: _knn_one(get_node, heap_size, d, max_depth, k, q)
+    )(queries)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "max_depth"))
+def _knn_batch_nodes(node_coords, node_gid, node_traversable, queries, k: int,
+                     max_depth: int):
+    """k-NN over a node-coordinate heap (global-tree storage): node i's point
+    coordinates live at node_coords[i], its global point id at node_gid[i]
+    (-1 = padding sentinel or empty slot), and node_traversable[i] says
+    whether the subtree can contain real points (static reachability)."""
+    heap_size, d = node_coords.shape
+
+    def get_node(node):
+        return node_coords[node], node_gid[node], node_traversable[node]
+
+    return jax.vmap(
+        lambda q: _knn_one(get_node, heap_size, d, max_depth, k, q)
+    )(queries)
 
 
 def knn(tree: KDTree, queries: jax.Array, k: int = 1) -> Tuple[jax.Array, jax.Array]:

@@ -1,4 +1,18 @@
 from kdtree_tpu.parallel.ensemble import ensemble_knn
+from kdtree_tpu.parallel.global_tree import (
+    GlobalKDTree,
+    build_global,
+    global_build_knn,
+    global_knn,
+)
 from kdtree_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
-__all__ = ["ensemble_knn", "make_mesh", "SHARD_AXIS"]
+__all__ = [
+    "ensemble_knn",
+    "make_mesh",
+    "SHARD_AXIS",
+    "GlobalKDTree",
+    "build_global",
+    "global_build_knn",
+    "global_knn",
+]

@@ -1,0 +1,307 @@
+"""Global-tree mode: ONE exact k-d tree over points sharded across the mesh.
+
+This is the capability the reference *doesn't* have — its MPI mode builds P
+independent local trees and never moves a point between ranks
+(``kdtree_mpi.cpp:204-253``). Here the top levels of a single global tree are
+built by actually redistributing points across chips, which is what scales a
+1-billion-point build across a pod (SURVEY.md §7, BASELINE.json north star).
+
+Mechanics: the single-chip build is "per level: stable sort by (segment key,
+axis coordinate, id)" (:mod:`kdtree_tpu.ops.build`). The global build runs the
+*same* level loop, but each level's sort is a **distributed block-bitonic
+sort** over the mesh:
+
+1. each device sorts its local block of (segkey, coord, gid, coords);
+2. a bitonic merge network over ranks: at each step a device exchanges its
+   whole block with ``rank ^ j`` via ``lax.ppermute``, merges the two sorted
+   blocks, and keeps the lower or upper half (direction per the classic
+   bitonic network). log2(P)*(log2(P)+1)/2 steps, each one full-block
+   exchange over ICI.
+
+Elements carry their segment key from their pre-sort *position* (the key set
+per level is static — ``TreeSpec.consume_level``), so consumed medians land
+back in their own global position and live segments sort internally, exactly
+as in the single-chip build: the resulting tree is **identical** to the
+single-chip tree over the same global array (tested).
+
+The built tree is returned as a node-coordinate heap (coords + global id per
+heap slot), assembled by a psum-scatter of each device's owned positions.
+Replicating the heap bounds this mode's N by per-chip HBM; a heap-sharded
+query path is the next scaling step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kdtree_tpu.models.tree import tree_spec
+from kdtree_tpu.ops.query import _knn_batch_nodes
+
+from .mesh import SHARD_AXIS
+
+
+@jax.tree_util.register_pytree_node_class
+class GlobalKDTree:
+    """A globally built tree: node-coordinate heap + global point ids.
+
+    ``node_traversable`` is the static reachability mask: padding sentinels
+    sort to the global suffix, so a node's subtree contains real points iff
+    its (static) segment start lies below n_real. ``n_real`` / ``num_levels``
+    are static aux data.
+    """
+
+    def __init__(self, node_coords, node_gid, node_traversable, n_real, num_levels):
+        self.node_coords = node_coords
+        self.node_gid = node_gid
+        self.node_traversable = node_traversable
+        self.n_real = n_real
+        self.num_levels = num_levels
+
+    @property
+    def heap_size(self) -> int:
+        return self.node_coords.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.node_coords.shape[1]
+
+    def tree_flatten(self):
+        return (
+            (self.node_coords, self.node_gid, self.node_traversable),
+            (self.n_real, self.num_levels),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __repr__(self):
+        return (
+            f"GlobalKDTree(n={self.n_real}, heap_size={self.heap_size}, "
+            f"dim={self.dim})"
+        )
+
+
+@functools.lru_cache(maxsize=16)
+def _traversable_mask(n_pad: int, n_real: int) -> np.ndarray:
+    """bool[heap]: node subtree intersects the real prefix [0, n_real).
+
+    Padding rows carry +inf in every coordinate, so within any segment they
+    sort behind all real points; inductively they occupy exactly the global
+    suffix [n_real, n_pad) at every level. A subtree covers the static
+    position range starting at its segment start, so it holds a real point
+    iff that start < n_real.
+    """
+    spec = tree_spec(n_pad)
+    mask = np.zeros(spec.heap_size, bool)
+    for nodes, starts in zip(spec.level_nodes, spec.level_segstart):
+        mask[nodes] = starts < n_real
+    return mask
+
+
+def _merge_split(skey, coord, gid, coords, keep_lower):
+    """Merge two sorted blocks (stacked along axis 0) and keep one half."""
+    L = skey.shape[0] // 2
+    order = lax.sort(
+        (skey, coord, gid, jnp.arange(2 * L, dtype=jnp.int32)),
+        num_keys=3,
+        is_stable=True,
+    )[3]
+    lo = jnp.where(keep_lower, 0, L)
+    sel = lax.dynamic_slice_in_dim(order, lo, L)
+    return skey[sel], coord[sel], gid[sel], coords[sel]
+
+
+def _local_sort(skey, coord, gid, coords):
+    order = lax.sort(
+        (skey, coord, gid, jnp.arange(skey.shape[0], dtype=jnp.int32)),
+        num_keys=3,
+        is_stable=True,
+    )[3]
+    return skey[order], coord[order], gid[order], coords[order]
+
+
+def _bitonic_level_sort(skey, coord, gid, coords, num_devices: int, axis_name: str):
+    """Distributed stable sort by (skey, coord, gid) over the device axis."""
+    skey, coord, gid, coords = _local_sort(skey, coord, gid, coords)
+    if num_devices == 1:
+        return skey, coord, gid, coords
+    rank = lax.axis_index(axis_name)
+
+    def _pack(skey, coord, gid, coords):
+        # single f32 exchange buffer [L, D+3]; i32 lanes travel bitcast (the
+        # bits are only transported, never compared, so the cast is safe)
+        return jnp.concatenate(
+            [
+                lax.bitcast_convert_type(skey, jnp.float32)[:, None],
+                coord[:, None],
+                lax.bitcast_convert_type(gid, jnp.float32)[:, None],
+                coords,
+            ],
+            axis=1,
+        )
+
+    def _unpack(buf):
+        return (
+            lax.bitcast_convert_type(buf[:, 0], jnp.int32),
+            buf[:, 1],
+            lax.bitcast_convert_type(buf[:, 2], jnp.int32),
+            buf[:, 3:],
+        )
+
+    k = 2
+    while k <= num_devices:
+        j = k // 2
+        while j >= 1:
+            pairs = [(i, i ^ j) for i in range(num_devices)]
+            other = _unpack(
+                lax.ppermute(_pack(skey, coord, gid, coords), axis_name, pairs)
+            )
+            partner = rank ^ j
+            ascending = (rank & k) == 0
+            keep_lower = (rank < partner) == ascending
+            skey, coord, gid, coords = _merge_split(
+                jnp.concatenate([skey, other[0]]),
+                jnp.concatenate([coord, other[1]]),
+                jnp.concatenate([gid, other[2]]),
+                jnp.concatenate([coords, other[3]], axis=0),
+                keep_lower,
+            )
+            j //= 2
+        k *= 2
+    return skey, coord, gid, coords
+
+
+def _global_build_local(
+    coords, gid, consume_local, posnode_local, *,
+    num_levels: int, heap_size: int, num_devices: int, axis_name: str,
+):
+    """Per-device body of the distributed build (under shard_map).
+
+    coords:        f32[L, D] this device's current points (migrate each level)
+    gid:           i32[L] their global point ids (-1 for padding)
+    consume_local: i32[L] static consume level of this device's *positions*
+    posnode_local: i32[L] static heap node id of this device's positions
+    """
+    L, d = coords.shape
+
+    def level_step(lvl, carry):
+        coords, gid = carry
+        dead = (consume_local < lvl).astype(jnp.int32)
+        # global segment key needs the global prefix count of dead positions:
+        # local cumsum + exclusive scan of per-device totals over the mesh.
+        local_csum = jnp.cumsum(dead)
+        total = local_csum[-1]
+        totals = lax.all_gather(total, axis_name)  # [P]
+        rank = lax.axis_index(axis_name)
+        prefix = jnp.sum(jnp.where(jnp.arange(num_devices) < rank, totals, 0))
+        csum = local_csum + prefix
+        segkey = 2 * csum - dead
+        axis = jnp.mod(lvl, d)
+        coord = coords[:, axis]
+        _, _, gid2, coords2 = _bitonic_level_sort(
+            segkey, coord, gid, coords, num_devices, axis_name
+        )
+        return coords2, gid2
+
+    coords, gid = lax.fori_loop(0, num_levels, level_step, (coords, gid))
+
+    # scatter owned positions into the heap; psum replicates across devices
+    node_gid = (
+        jnp.full(heap_size, 0, jnp.int32).at[posnode_local].add(gid + 1)
+    )
+    node_coords = (
+        jnp.zeros((heap_size, d), coords.dtype).at[posnode_local].add(coords)
+    )
+    node_gid = lax.psum(node_gid, axis_name) - 1  # -1 where empty/padding
+    node_coords = lax.psum(node_coords, axis_name)
+    return node_coords, node_gid
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "num_levels", "heap_size")
+)
+def _build_global_jit(points, gid, consume, posnode, mesh, num_levels, heap_size):
+    p = mesh.shape[SHARD_AXIS]
+    fn = jax.shard_map(
+        functools.partial(
+            _global_build_local,
+            num_levels=num_levels,
+            heap_size=heap_size,
+            num_devices=p,
+            axis_name=SHARD_AXIS,
+        ),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(None, None), P(None)),
+        check_vma=False,
+    )
+    return fn(points, gid, consume, posnode)
+
+
+def build_global(points: jax.Array, mesh: Mesh | None = None) -> GlobalKDTree:
+    """Build one exact global tree over ``points`` (f32[N, D]) sharded across
+    the mesh. P must be a power of two (bitonic network); N is padded to a
+    multiple of P with +inf sentinel rows, which become inf-leaves that can
+    never win a query.
+
+    The result is identical to the single-chip ``build`` of the same array
+    (same node ids, same structure) — see tests/test_global_tree.py.
+    """
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    p = mesh.shape[SHARD_AXIS]
+    if p & (p - 1):
+        raise ValueError(f"global-tree mode needs a power-of-2 device count, got {p}")
+    n, d = points.shape
+    pad = (-n) % p
+    if pad:
+        points = jnp.concatenate(
+            [points, jnp.full((pad, d), jnp.inf, points.dtype)], axis=0
+        )
+    n_pad = n + pad
+    spec = tree_spec(n_pad)
+    gid = jnp.where(jnp.arange(n_pad) < n, jnp.arange(n_pad), -1).astype(jnp.int32)
+    consume = jnp.asarray(spec.consume_level)
+    posnode = jnp.asarray(spec.position_node)
+    node_coords, node_gid = _build_global_jit(
+        points, gid, consume, posnode, mesh, spec.num_levels, spec.heap_size
+    )
+    trav = jnp.asarray(_traversable_mask(n_pad, n))
+    return GlobalKDTree(
+        node_coords=node_coords,
+        node_gid=node_gid,
+        node_traversable=trav,
+        n_real=n,
+        num_levels=spec.num_levels,
+    )
+
+
+def global_knn(
+    gtree: GlobalKDTree, queries: jax.Array, k: int = 1
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN against a globally built tree.
+
+    Returns (dists_sq f32[Q, k], global indices i32[Q, k]) ascending.
+    """
+    k = min(k, gtree.n_real)
+    return _knn_batch_nodes(
+        gtree.node_coords, gtree.node_gid, gtree.node_traversable, queries, k,
+        gtree.num_levels,
+    )
+
+
+def global_build_knn(
+    points: jax.Array, queries: jax.Array, k: int = 1, mesh: Mesh | None = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Convenience: distributed build + query in one call."""
+    return global_knn(build_global(points, mesh), queries, k=k)

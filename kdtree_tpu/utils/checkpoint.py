@@ -13,21 +13,33 @@ import numpy as np
 from kdtree_tpu.models.tree import KDTree
 
 
-def save_tree(path: str, tree: KDTree) -> None:
+def save_tree(path: str, tree: KDTree, meta: dict | None = None) -> None:
+    """Save a tree plus optional provenance metadata (seed, generator, ...)
+    so a later load can reconstruct the matching problem instead of trusting
+    the caller to pass consistent flags."""
+    extra = {f"meta_{k}": np.asarray(v) for k, v in (meta or {}).items()}
     np.savez_compressed(
         path,
         points=np.asarray(tree.points),
         node_point=np.asarray(tree.node_point),
         split_val=np.asarray(tree.split_val),
+        **extra,
     )
 
 
-def load_tree(path: str) -> KDTree:
+def load_tree(path: str) -> tuple[KDTree, dict]:
+    """Returns (tree, meta) where meta holds whatever save_tree recorded."""
     import jax.numpy as jnp
 
     with np.load(path) as z:
-        return KDTree(
+        tree = KDTree(
             points=jnp.asarray(z["points"]),
             node_point=jnp.asarray(z["node_point"]),
             split_val=jnp.asarray(z["split_val"]),
         )
+        meta = {
+            k[len("meta_"):]: z[k].item() if z[k].ndim == 0 else z[k]
+            for k in z.files
+            if k.startswith("meta_")
+        }
+    return tree, meta

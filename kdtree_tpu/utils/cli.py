@@ -102,7 +102,12 @@ def cmd_harness(args) -> None:
     if args.spec:
         # argv mode (Utility.cpp:104-120): READY after arg count check
         print("READY", flush=True)
-        seed, dim, num_points = (int(x) for x in args.spec)
+        try:
+            seed, dim, num_points = (int(x) for x in args.spec)
+        except ValueError:
+            print(f"Invalid problem spec {args.spec!r}: SEED DIM_POINTS "
+                  "NUM_POINTS must be integers", file=sys.stderr)
+            sys.exit(1)
     else:
         # interactive mode (Utility.cpp:92-102)
         print("READY", flush=True)
@@ -151,7 +156,7 @@ def cmd_build(args) -> None:
 
     points, _ = _generate(args.seed, args.dim, args.n, args.generator)
     tree = build_jit(points)
-    save_tree(args.out, tree)
+    save_tree(args.out, tree, meta={"seed": args.seed, "generator": args.generator})
     print(f"saved tree (n={tree.n}, dim={tree.dim}) to {args.out}")
 
 
@@ -159,8 +164,19 @@ def cmd_query(args) -> None:
     from kdtree_tpu import knn
     from kdtree_tpu.utils.checkpoint import load_tree
 
-    tree = load_tree(args.tree)
-    _, queries = _generate(args.seed, tree.dim, tree.n, args.generator)
+    tree, meta = load_tree(args.tree)
+    # the checkpoint's provenance wins over CLI defaults — querying a seed-7
+    # tree with seed-42 queries would silently answer a problem that never
+    # existed
+    if "seed" in meta:
+        seed = int(meta["seed"])
+    else:
+        seed = args.seed if args.seed is not None else 42
+    generator = str(meta.get("generator", args.generator))
+    if args.seed is not None and args.seed != seed:
+        print(f"note: using checkpoint seed {seed} (ignoring --seed {args.seed})",
+              file=sys.stderr)
+    _, queries = _generate(seed, tree.dim, tree.n, generator)
     d2, idx = knn(tree, queries, k=args.k)
     for q in range(queries.shape[0]):
         print_result_line(tree.n + q, float(np.sqrt(d2[q, 0])))
@@ -201,7 +217,8 @@ def main(argv=None) -> None:
 
     q = sub.add_parser("query", help="load a tree and run the 10 protocol queries")
     q.add_argument("--tree", required=True)
-    q.add_argument("--seed", type=int, default=42)
+    q.add_argument("--seed", type=int, default=None,
+                   help="override checkpoint seed (normally read from the npz)")
     q.add_argument("--k", type=int, default=1)
     q.set_defaults(fn=cmd_query)
 

@@ -1,0 +1,75 @@
+"""Global-tree mode: one exact tree over mesh-sharded points.
+
+The strongest test here is structural identity: the distributed build must
+produce the *same* tree (same node -> global point id mapping) as the
+single-chip build over the same global array, because both run the identical
+level-synchronous algorithm — only the sort is distributed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_jit, generate_problem, tree_spec
+from kdtree_tpu.models.tree import node_levels
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.parallel import build_global, global_build_knn, global_knn, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+@pytest.mark.parametrize("n,d", [(512, 3), (1024, 5), (256, 2)])
+def test_structural_identity_with_single_chip(mesh8, n, d):
+    pts, _ = generate_problem(seed=n + d, dim=d, num_points=n)
+    gtree = build_global(pts, mesh=mesh8)
+    tree = build_jit(pts)
+    np.testing.assert_array_equal(
+        np.asarray(gtree.node_gid), np.asarray(tree.node_point)
+    )
+    # node coordinates must be the actual point coordinates
+    npnt = np.asarray(tree.node_point)
+    valid = npnt >= 0
+    np.testing.assert_array_equal(
+        np.asarray(gtree.node_coords)[valid], np.asarray(pts)[npnt[valid]]
+    )
+
+
+@pytest.mark.parametrize("n,d,k", [(512, 3, 1), (512, 3, 16), (777, 4, 3)])
+def test_global_knn_matches_bruteforce(mesh8, n, d, k):
+    pts, qs = generate_problem(seed=n + k, dim=d, num_points=n, num_queries=10)
+    d2, idx = global_build_knn(pts, qs, k=k, mesh=mesh8)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-6)
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - np.asarray(pts)[np.asarray(idx)]) ** 2, axis=-1
+    )
+    np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-6)
+
+
+def test_global_padding_orphans(mesh8):
+    """Non-divisible N: padding sentinels become non-takeable suffix nodes;
+    real points in their left subtrees must still be reachable (regression
+    test for the orphaned-subtree hazard)."""
+    for n in (509, 63, 9):
+        pts, qs = generate_problem(seed=n, dim=3, num_points=n, num_queries=10)
+        d2, idx = global_build_knn(pts, qs, k=2, mesh=mesh8)
+        bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=2)
+        np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-6)
+        assert int(np.asarray(idx).max()) < n and int(np.asarray(idx).min()) >= 0
+
+
+def test_global_two_devices():
+    pts, qs = generate_problem(seed=1, dim=3, num_points=200, num_queries=6)
+    d2, _ = global_build_knn(pts, qs, k=1, mesh=make_mesh(2))
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2)[:, :1], rtol=1e-6)
+
+
+def test_non_power_of_two_mesh_rejected():
+    with pytest.raises(ValueError):
+        pts, _ = generate_problem(seed=1, dim=3, num_points=64)
+        build_global(pts, mesh=make_mesh(3))

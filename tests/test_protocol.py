@@ -113,3 +113,32 @@ def test_usage_error():
     res = _run_cli(["harness", "1", "2"])
     assert res.returncode == 1
     assert "Usage:" in res.stderr
+
+
+def test_malformed_spec():
+    """Non-integer argv spec exits 1 with a diagnostic, not a traceback."""
+    res = _run_cli(["harness", "42", "x", "500000"])
+    assert res.returncode == 1
+    assert "must be integers" in res.stderr
+    assert "Traceback" not in res.stderr
+
+
+def test_build_query_roundtrip(tmp_path):
+    """build saves provenance; query replays it regardless of --seed."""
+    tree_path = str(tmp_path / "t.npz")
+    res = _run_cli(["--generator", "threefry", "build", "--seed", "7",
+                    "--dim", "3", "--n", "500", "--out", tree_path])
+    assert res.returncode == 0, res.stderr[-2000:]
+    res = _run_cli(["query", "--tree", tree_path, "--seed", "42"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ignoring --seed 42" in res.stderr
+    lines = res.stdout.strip().splitlines()
+    assert lines[-1] == "DONE" and len(lines) == 11
+
+    from kdtree_tpu import generate_problem
+    from kdtree_tpu.ops import bruteforce
+
+    pts, qs = generate_problem(7, 3, 500, 10)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
+    got = [float(ln.split(" \t DISTANCE: ")[1]) for ln in lines[:-1]]
+    np.testing.assert_allclose(got, np.sqrt(np.asarray(bf)[:, 0]), rtol=1e-4)

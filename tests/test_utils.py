@@ -9,8 +9,9 @@ def test_checkpoint_roundtrip(tmp_path):
     pts, qs = generate_problem(seed=2, dim=3, num_points=300, num_queries=5)
     tree = build_jit(pts)
     path = str(tmp_path / "tree.npz")
-    save_tree(path, tree)
-    tree2 = load_tree(path)
+    save_tree(path, tree, meta={"seed": 2, "generator": "threefry"})
+    tree2, meta = load_tree(path)
+    assert meta == {"seed": 2, "generator": "threefry"}
     np.testing.assert_array_equal(np.asarray(tree.node_point), np.asarray(tree2.node_point))
     d1, i1 = knn(tree, qs, k=3)
     d2, i2 = knn(tree2, qs, k=3)
