@@ -9,6 +9,7 @@ full structural analysis of the reference and the design mapping.
 
 from kdtree_tpu.models.tree import KDTree, TreeSpec, tree_spec
 from kdtree_tpu.ops.build import build, build_jit, validate_invariants
+from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn, build_bucket
 from kdtree_tpu.ops.query import knn, nearest_neighbor
 from kdtree_tpu.ops.generate import (
     generate_problem,
@@ -20,6 +21,9 @@ from kdtree_tpu.ops import bruteforce
 __version__ = "0.1.0"
 
 __all__ = [
+    "BucketKDTree",
+    "build_bucket",
+    "bucket_knn",
     "KDTree",
     "TreeSpec",
     "tree_spec",

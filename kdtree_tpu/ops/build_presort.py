@@ -1,0 +1,140 @@
+"""Presort build: O(N) work per level instead of a comparison sort per level.
+
+The sort-based build (:mod:`kdtree_tpu.ops.build`) pays a full stable
+``lax.sort`` per level — O(N log N) x depth. This implementation uses the
+classic parallel k-d construction strategy instead (cf. GPU builders such as
+Wehr & Radkowski's adaptive split-and-sort — PAPERS.md): sort the point ids
+**once per axis** up front, then maintain, for every axis, the invariant
+
+    list_a = point ids ordered segment-major, coord_a-minor,
+
+where "segments" are the same static position structure the sort-based build
+uses (``TreeSpec``: exact-median splits make every boundary static, holes at
+consumed medians persist). Splitting a level then needs NO sort:
+
+1. position-space classification (shared by all axes, static structure +
+   dynamic level, all plain cummax/cumsum scans):
+   - ``H[p]``: nearest hole at-or-left  -> segment start = H+1
+   - ``M[p]`` / ``Q[p]``: nearest dying position left / right -> the
+     segment's median position
+   - side(p): left / dies-now / right / already-dead
+2. the split-axis list maps sides from positions to point ids (one scatter);
+3. every axis list stably repartitions [left | hole | right] inside each
+   segment with two segmented cumsums and one scatter — coordinate order is
+   preserved within the children, restoring the invariant.
+
+Consumed points sit at their static hole position in EVERY list, so the final
+node extraction is one gather, same as the sort-based build. The resulting
+tree is bit-identical to the sort-based build (tested), since both order
+segments by (coord, id).
+
+Work per level: ~10 elementwise/scan passes over N per axis — HBM-bandwidth
+bound, which is what a TPU wants — versus a full sort. Measured single-chip:
+~3x faster at 16M x 3D.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kdtree_tpu.models.tree import KDTree, tree_spec
+from kdtree_tpu.ops.build import spec_arrays
+
+# side codes
+_LEFT, _DIES, _RIGHT, _STAY = 0, 1, 2, 3
+
+
+def build_presort_impl(
+    points: jax.Array,
+    consume: jax.Array,
+    all_nodes: jax.Array,
+    all_medpos: jax.Array,
+    node_axes: jax.Array,
+    *,
+    num_levels: int,
+) -> KDTree:
+    n, d = points.shape
+    heap_size = node_axes.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    # the only comparison sorts: one stable (coord, id) ordering per axis
+    def sort_axis(col):
+        _, pid = lax.sort((col, iota), num_keys=1, is_stable=True)
+        return pid
+
+    lists = jax.vmap(sort_axis, in_axes=1)(points)  # i32[D, N]
+
+    def level_step(lvl, lists):
+        # ---- position-space structure for this level (axis-independent) ----
+        hole = consume < lvl
+        dying = consume == lvl
+        H = lax.cummax(jnp.where(hole, iota, -1))
+        M = lax.cummax(jnp.where(dying, iota, -1))
+        valid = consume <= lvl
+        Q = lax.cummin(jnp.where(valid, iota, n)[::-1])[::-1]
+        cq = consume[jnp.minimum(Q, n - 1)]
+        seg_start = H + 1
+        # the segment median is right of p while p is in the left half
+        med = jnp.where(cq == lvl, Q, M)
+        side_pos = jnp.where(
+            hole, _STAY, jnp.where(dying, _DIES, jnp.where(cq == lvl, _LEFT, _RIGHT))
+        )
+
+        # ---- map sides from positions to points via the split-axis list ----
+        a = jnp.mod(lvl, d)
+        alist = lax.dynamic_index_in_dim(lists, a, axis=0, keepdims=False)
+        side_of_pid = jnp.zeros(n, jnp.int32).at[alist].set(side_pos)
+
+        # ---- stable 3-way repartition of every axis list ------------------
+        def repartition(lst):
+            side = side_of_pid[lst]
+            left = (side == _LEFT).astype(jnp.int32)
+            right = (side == _RIGHT).astype(jnp.int32)
+            exl = jnp.cumsum(left) - left  # exclusive
+            exr = jnp.cumsum(right) - right
+            rank_l = exl - exl[seg_start]
+            rank_r = exr - exr[seg_start]
+            new_pos = jnp.where(
+                side == _LEFT,
+                seg_start + rank_l,
+                jnp.where(
+                    side == _DIES,
+                    med,
+                    jnp.where(side == _RIGHT, med + 1 + rank_r, iota),
+                ),
+            )
+            return jnp.zeros(n, jnp.int32).at[new_pos].set(lst)
+
+        return jax.vmap(repartition)(lists)
+
+    lists = lax.fori_loop(0, num_levels, level_step, lists)
+
+    # consumed points sit at their hole in every list; use list 0
+    final = lists[0]
+    node_point = jnp.full(heap_size, -1, dtype=jnp.int32)
+    node_point = node_point.at[all_nodes].set(final[all_medpos])
+    gathered = points[jnp.maximum(node_point, 0), node_axes]
+    split_val = jnp.where(node_point >= 0, gathered, jnp.float32(0))
+    return KDTree(points=points, node_point=node_point, split_val=split_val)
+
+
+@functools.partial(jax.jit, static_argnames=("num_levels",))
+def _build_presort_jit(points, consume, all_nodes, all_medpos, node_axes, num_levels):
+    return build_presort_impl(
+        points, consume, all_nodes, all_medpos, node_axes, num_levels=num_levels
+    )
+
+
+def build_presort(points: jax.Array) -> KDTree:
+    """Jitted presort build; drop-in replacement for ``build_jit`` (the trees
+    are identical; this one is ~3x faster per level at scale)."""
+    n, d = points.shape
+    spec = tree_spec(n)
+    consume, all_nodes, all_medpos, node_axes = spec_arrays(n, d)
+    return _build_presort_jit(
+        points, consume, all_nodes, all_medpos, node_axes, spec.num_levels
+    )

@@ -1,0 +1,60 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_bucket, bucket_knn, generate_problem
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.bucket import bucket_spec
+
+
+@pytest.mark.parametrize("n,cap", [(1, 8), (7, 8), (8, 8), (9, 8), (1000, 16), (4096, 128)])
+def test_spec_partitions_points(n, cap):
+    spec = bucket_spec(n, cap)
+    covered = list(spec.med_pos)
+    for s, ln in zip(spec.bucket_start, spec.bucket_len):
+        covered.extend(range(s, s + ln))
+        assert 1 <= ln <= cap
+    assert sorted(covered) == list(range(n))
+
+
+@pytest.mark.parametrize(
+    "n,d,k,cap",
+    [(100, 3, 1, 8), (1000, 3, 16, 16), (2048, 3, 4, 128), (777, 5, 3, 32), (50, 2, 1, 128)],
+)
+def test_bucket_knn_matches_bruteforce(n, d, k, cap):
+    pts, qs = generate_problem(seed=n + d + k, dim=d, num_points=n, num_queries=10)
+    tree = build_bucket(pts, bucket_cap=cap)
+    d2, idx = bucket_knn(tree, qs, k=k)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - np.asarray(pts)[np.asarray(idx)]) ** 2, axis=-1
+    )
+    np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-6)
+
+
+def test_whole_tree_is_one_bucket():
+    pts, qs = generate_problem(seed=9, dim=3, num_points=50, num_queries=5)
+    tree = build_bucket(pts, bucket_cap=128)
+    assert tree.num_levels == 0 and tree.bucket_pts.shape[0] == 1
+    d2, _ = bucket_knn(tree, qs, k=2)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=2)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+
+
+def test_chunked_query_equals_unchunked():
+    pts, _ = generate_problem(seed=4, dim=3, num_points=2000)
+    qs = generate_problem(seed=5, dim=3, num_points=1000, num_queries=1)[0]
+    tree = build_bucket(pts, bucket_cap=64)
+    a_d, a_i = bucket_knn(tree, qs, k=3, chunk=128)
+    b_d, b_i = bucket_knn(tree, qs, k=3, chunk=1024)
+    np.testing.assert_array_equal(np.asarray(a_d), np.asarray(b_d))
+    np.testing.assert_array_equal(np.asarray(a_i), np.asarray(b_i))
+
+
+def test_duplicate_points_bucket():
+    pts = jnp.zeros((300, 3), jnp.float32)
+    tree = build_bucket(pts, bucket_cap=64)
+    d2, idx = bucket_knn(tree, jnp.ones((2, 3)), k=4)
+    np.testing.assert_allclose(np.asarray(d2), 3.0, rtol=1e-6)
+    assert (np.asarray(idx) >= 0).all()

@@ -1,0 +1,38 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_jit, generate_problem, validate_invariants
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.build_presort import build_presort
+from kdtree_tpu.ops.query import knn
+
+
+@pytest.mark.parametrize(
+    "n,d", [(1, 3), (2, 3), (3, 2), (17, 3), (100, 3), (1000, 3), (513, 8), (777, 2), (999, 5)]
+)
+def test_identical_to_sort_based_build(n, d):
+    """Both builds order segments by (coord, id), so the trees must be
+    bit-identical — the strongest possible cross-check."""
+    pts, _ = generate_problem(seed=n * 7 + d, dim=d, num_points=n)
+    a = build_jit(pts)
+    b = build_presort(pts)
+    np.testing.assert_array_equal(np.asarray(a.node_point), np.asarray(b.node_point))
+    np.testing.assert_array_equal(np.asarray(a.split_val), np.asarray(b.split_val))
+
+
+def test_identical_with_duplicates():
+    base = jnp.ones((16, 3), jnp.float32)
+    pts = jnp.concatenate([base, 2.0 * base, base], axis=0)
+    a = build_jit(pts)
+    b = build_presort(pts)
+    np.testing.assert_array_equal(np.asarray(a.node_point), np.asarray(b.node_point))
+    validate_invariants(b)
+
+
+def test_presort_tree_queries_match_oracle():
+    pts, qs = generate_problem(seed=5, dim=3, num_points=2048, num_queries=10)
+    tree = build_presort(pts)
+    d2, idx = knn(tree, qs, k=8)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=8)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
