@@ -30,12 +30,16 @@ def _load():
         if _lib is not None or _failed:
             return _lib
         def _compile():
+            # compile to a PID-suffixed temp and os.replace() into place so a
+            # concurrent process can never CDLL a partially written file
             _SO.parent.mkdir(parents=True, exist_ok=True)
+            tmp = _SO.with_suffix(f".so.{os.getpid()}")
             subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                 str(_SRC), "-o", str(_SO)],
+                 str(_SRC), "-o", str(tmp)],
                 check=True, capture_output=True,
             )
+            os.replace(tmp, _SO)
 
         try:
             if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:

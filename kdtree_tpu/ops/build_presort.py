@@ -28,9 +28,14 @@ node extraction is one gather, same as the sort-based build. The resulting
 tree is bit-identical to the sort-based build (tested), since both order
 segments by (coord, id).
 
-Work per level: ~10 elementwise/scan passes over N per axis — HBM-bandwidth
-bound, which is what a TPU wants — versus a full sort. Measured single-chip:
-~3x faster at 16M x 3D.
+Work per level: ~10 elementwise/scan passes over N per axis versus a full
+sort — asymptotically better, but the passes are dominated by 16M-wide random
+gathers and scatters, which XLA:TPU serializes. Measured on the real v5e chip
+at 16M x 3D this loses badly to the sort build (~49s vs ~8.5s), so the sort
+strategy is the production path; this module remains as (a) the correctness
+scaffold for the Pallas partition kernel, which implements the same
+repartition with explicit VMEM tiles instead of scatters, and (b) the faster
+option on CPU backends where scatters are cheap.
 """
 
 from __future__ import annotations
@@ -48,17 +53,16 @@ from kdtree_tpu.ops.build import spec_arrays
 _LEFT, _DIES, _RIGHT, _STAY = 0, 1, 2, 3
 
 
-def build_presort_impl(
-    points: jax.Array,
-    consume: jax.Array,
-    all_nodes: jax.Array,
-    all_medpos: jax.Array,
-    node_axes: jax.Array,
-    *,
-    num_levels: int,
-) -> KDTree:
+def presort_lists(points: jax.Array, consume: jax.Array, *, num_levels: int) -> jax.Array:
+    """Run the presort level loop; returns the per-axis lists i32[D, N].
+
+    ``consume[p]`` is the level at which position p's point is consumed as a
+    node median (>= num_levels for positions that never die — e.g. bucket-leaf
+    points, see :func:`kdtree_tpu.ops.bucket.build_bucket_presort`). Segments
+    with no dying median at a level ("frozen" bucket segments) are left in
+    place, preserving the invariant.
+    """
     n, d = points.shape
-    heap_size = node_axes.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
 
     # the only comparison sorts: one stable (coord, id) ordering per axis
@@ -80,8 +84,18 @@ def build_presort_impl(
         seg_start = H + 1
         # the segment median is right of p while p is in the left half
         med = jnp.where(cq == lvl, Q, M)
+        # frozen segment (no dying median this level, e.g. a finished bucket):
+        # nearest dying-left lies before the segment start -> stay put
         side_pos = jnp.where(
-            hole, _STAY, jnp.where(dying, _DIES, jnp.where(cq == lvl, _LEFT, _RIGHT))
+            hole,
+            _STAY,
+            jnp.where(
+                dying,
+                _DIES,
+                jnp.where(
+                    cq == lvl, _LEFT, jnp.where(M >= seg_start, _RIGHT, _STAY)
+                ),
+            ),
         )
 
         # ---- map sides from positions to points via the split-axis list ----
@@ -111,7 +125,21 @@ def build_presort_impl(
 
         return jax.vmap(repartition)(lists)
 
-    lists = lax.fori_loop(0, num_levels, level_step, lists)
+    return lax.fori_loop(0, num_levels, level_step, lists)
+
+
+def build_presort_impl(
+    points: jax.Array,
+    consume: jax.Array,
+    all_nodes: jax.Array,
+    all_medpos: jax.Array,
+    node_axes: jax.Array,
+    *,
+    num_levels: int,
+) -> KDTree:
+    n, d = points.shape
+    heap_size = node_axes.shape[0]
+    lists = presort_lists(points, consume, num_levels=num_levels)
 
     # consumed points sit at their hole in every list; use list 0
     final = lists[0]
@@ -130,8 +158,8 @@ def _build_presort_jit(points, consume, all_nodes, all_medpos, node_axes, num_le
 
 
 def build_presort(points: jax.Array) -> KDTree:
-    """Jitted presort build; drop-in replacement for ``build_jit`` (the trees
-    are identical; this one is ~3x faster per level at scale)."""
+    """Jitted presort build; drop-in replacement for ``build_jit`` (identical
+    trees — but see the module docstring: slower than build_jit on TPU)."""
     n, d = points.shape
     spec = tree_spec(n)
     consume, all_nodes, all_medpos, node_axes = spec_arrays(n, d)

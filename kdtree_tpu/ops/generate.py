@@ -44,6 +44,18 @@ def generate_problem(
     return points, queries
 
 
+def generate_queries(
+    seed: int, dim: int, num_queries: int = 10, dtype=jnp.float32
+) -> jax.Array:
+    """Only the query block of :func:`generate_problem` — bit-identical to its
+    second return value, without materializing the N points (the query key is
+    independent of num_points by construction)."""
+    _, kq = jax.random.split(jax.random.key(seed), 2)
+    return jax.random.uniform(
+        kq, (num_queries, dim), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX
+    )
+
+
 def generate_points_shard(
     seed: int, dim: int, shard_start: int, shard_rows: int, dtype=jnp.float32
 ) -> jax.Array:
@@ -53,7 +65,9 @@ def generate_points_shard(
     The counter-based equivalent of the reference's ``random.discard`` skip
     (``kdtree_mpi.cpp:24,32``): each row's bits depend only on (seed, row), so
     any shard can be produced independently and the union over shards is
-    bit-identical to the single-device :func:`generate_problem` output.
+    bit-identical to :func:`generate_points_rowwise` (NOT to
+    :func:`generate_problem`, which draws the whole (N, D) block from one key
+    in a single call and therefore produces different bits).
     """
     kp, _ = jax.random.split(jax.random.key(seed), 2)
     row_keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(

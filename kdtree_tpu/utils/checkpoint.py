@@ -2,44 +2,65 @@
 
 The reference's tree lives only in process memory (heap ``Node``s freed at
 exit, ``Utility.cpp:40-45``) — no persistence at all. The implicit-array
-representation makes checkpointing trivial: three arrays to npz. Save/load is
-deterministic and device-agnostic (arrays come back on the default device).
+representation makes checkpointing trivial: every tree class here is a
+registered pytree of arrays plus static aux ints, so save/load is a generic
+flatten → npz → unflatten round trip. Deterministic and device-agnostic
+(arrays come back on the default device). Provenance metadata (seed,
+generator, ...) rides along so a later load can reconstruct the matching
+problem instead of trusting the caller to pass consistent flags.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from kdtree_tpu.models.tree import KDTree
+
+def _registry():
+    from kdtree_tpu.models.tree import KDTree
+    from kdtree_tpu.ops.bucket import BucketKDTree
+    from kdtree_tpu.parallel.global_tree import GlobalKDTree
+
+    return {"classic": KDTree, "bucket": BucketKDTree, "global": GlobalKDTree}
 
 
-def save_tree(path: str, tree: KDTree, meta: dict | None = None) -> None:
-    """Save a tree plus optional provenance metadata (seed, generator, ...)
-    so a later load can reconstruct the matching problem instead of trusting
-    the caller to pass consistent flags."""
-    extra = {f"meta_{k}": np.asarray(v) for k, v in (meta or {}).items()}
-    np.savez_compressed(
-        path,
-        points=np.asarray(tree.points),
-        node_point=np.asarray(tree.node_point),
-        split_val=np.asarray(tree.split_val),
-        **extra,
-    )
+def save_tree(path: str, tree, meta: dict | None = None) -> None:
+    """Save any framework tree (KDTree / BucketKDTree / GlobalKDTree) + meta."""
+    kinds = _registry()
+    kind = next((k for k, cls in kinds.items() if isinstance(tree, cls)), None)
+    if kind is None:
+        raise TypeError(f"not a checkpointable tree: {type(tree)!r}")
+    # the class protocol (not tree_flatten utils) so aux static ints persist
+    children, aux = type(tree).tree_flatten(tree)
+    payload = {f"child_{i}": np.asarray(c) for i, c in enumerate(children)}
+    if aux is not None:
+        payload["aux"] = np.asarray(aux, dtype=np.int64)
+    payload["kind"] = np.asarray(kind)
+    payload.update({f"meta_{k}": np.asarray(v) for k, v in (meta or {}).items()})
+    np.savez_compressed(path, **payload)
 
 
-def load_tree(path: str) -> tuple[KDTree, dict]:
-    """Returns (tree, meta) where meta holds whatever save_tree recorded."""
+def load_tree(path: str):
+    """Returns (tree, meta); the tree type round-trips via the saved kind."""
     import jax.numpy as jnp
 
     with np.load(path) as z:
-        tree = KDTree(
-            points=jnp.asarray(z["points"]),
-            node_point=jnp.asarray(z["node_point"]),
-            split_val=jnp.asarray(z["split_val"]),
-        )
         meta = {
             k[len("meta_"):]: z[k].item() if z[k].ndim == 0 else z[k]
             for k in z.files
             if k.startswith("meta_")
         }
+        if "kind" not in z.files:  # legacy round-1 format: classic tree only
+            from kdtree_tpu.models.tree import KDTree
+
+            tree = KDTree(
+                points=jnp.asarray(z["points"]),
+                node_point=jnp.asarray(z["node_point"]),
+                split_val=jnp.asarray(z["split_val"]),
+            )
+            return tree, meta
+        cls = _registry()[str(z["kind"])]
+        nchild = sum(1 for k in z.files if k.startswith("child_"))
+        children = tuple(jnp.asarray(z[f"child_{i}"]) for i in range(nchild))
+        aux = tuple(int(a) for a in z["aux"]) if "aux" in z.files else None
+        tree = cls.tree_unflatten(aux, children)
     return tree, meta

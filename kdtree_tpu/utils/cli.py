@@ -59,8 +59,11 @@ def print_result_line(point_id: int, distance: float, file=sys.stdout) -> None:
 
 
 def _generate(seed: int, dim: int, num_points: int, generator: str):
-    """(points, queries) by generator choice; mt19937 replays the reference
-    stream bit-exactly (native C++), threefry is the TPU-native default."""
+    """(points, queries, generator_used) by generator choice; mt19937 replays
+    the reference stream bit-exactly (native C++), threefry is the TPU-native
+    default. The returned generator name is what actually ran (the mt19937
+    path falls back to threefry without a toolchain) — checkpoint provenance
+    must record *that*, not the request."""
     if generator == "mt19937":
         from kdtree_tpu import native
 
@@ -71,21 +74,55 @@ def _generate(seed: int, dim: int, num_points: int, generator: str):
             import jax.numpy as jnp
 
             pts, qs = native.generate_problem_mt19937(seed, dim, num_points, NUM_QUERIES)
-            return jnp.asarray(pts), jnp.asarray(qs)
+            return jnp.asarray(pts), jnp.asarray(qs), "mt19937"
     from kdtree_tpu.ops.generate import generate_problem
 
-    return generate_problem(seed, dim, num_points, NUM_QUERIES)
+    pts, qs = generate_problem(seed, dim, num_points, NUM_QUERIES)
+    return pts, qs, "threefry"
+
+
+def _generate_queries(seed: int, dim: int, num_points: int, generator: str):
+    """Only the NUM_QUERIES query rows — never materializes the N points.
+
+    mt19937: the native generator supports arbitrary row windows, so rows
+    [N, N+10) come straight off the stream (the reference's MPI discard trick,
+    kdtree_mpi.cpp:19-41, generalized). threefry: generate_queries is
+    bit-identical to generate_problem's query block by construction.
+
+    Unlike generation at build time, there is NO fallback here: the points are
+    frozen in a checkpoint, so swapping generators could only produce queries
+    from a different problem — that must be an error, never a warning.
+    """
+    if generator == "mt19937":
+        from kdtree_tpu import native
+
+        if not native.available():
+            raise SystemExit(
+                "checkpoint was built with the mt19937 generator but the "
+                "native generator is unavailable here (no g++ toolchain); "
+                "refusing to answer queries from a different problem"
+            )
+        import jax.numpy as jnp
+
+        return jnp.asarray(native.generate_rows(seed, dim, num_points, NUM_QUERIES))
+    from kdtree_tpu.ops.generate import generate_queries
+
+    return generate_queries(seed, dim, NUM_QUERIES)
 
 
 def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None):
     """Returns (d2[Q,k], idx[Q,k]) by the chosen engine."""
     dim = points.shape[1]
     if engine == "auto":
-        engine = "tree" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
+        engine = "bucket" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
     if engine == "tree":
         from kdtree_tpu import build_jit, knn
 
         return knn(build_jit(points), queries, k=k)
+    if engine == "bucket":
+        from kdtree_tpu.ops.bucket import bucket_knn, build_bucket
+
+        return bucket_knn(build_bucket(points), queries, k=k)
     if engine == "bruteforce":
         from kdtree_tpu.ops import bruteforce
 
@@ -95,6 +132,12 @@ def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None
 
         mesh = make_mesh(mesh_devices)
         return ensemble_knn(points, queries, k=k, mesh=mesh)
+    if engine == "global":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_tree import global_build_knn
+
+        mesh = make_mesh(mesh_devices)
+        return global_build_knn(points, queries, k=k, mesh=mesh)
     raise SystemExit(f"unknown engine: {engine}")
 
 
@@ -112,11 +155,17 @@ def cmd_harness(args) -> None:
         # interactive mode (Utility.cpp:92-102)
         print("READY", flush=True)
         print("Specify seed ", file=sys.stderr, end="", flush=True)
-        seed = int(sys.stdin.readline())
+        try:
+            seed = int(sys.stdin.readline())
+        except ValueError:
+            # mirror the reference's cin>> failed-extraction path
+            # (Utility.cpp:95-97 leaves seed at its default): warn + seed 0
+            print("Invalid seed input; using default seed 0", file=sys.stderr)
+            seed = 0
         dim, num_points = HARNESS_DIM, HARNESS_NUM_POINTS
     _validate_input(seed, dim, num_points)
 
-    points, queries = _generate(seed, dim, num_points, args.generator)
+    points, queries, _ = _generate(seed, dim, num_points, args.generator)
     d2, _ = _solve(points, queries, k=1, engine=args.engine, mesh_devices=args.devices)
     dists = np.sqrt(np.asarray(d2[:, 0], dtype=np.float64))
     for q in range(NUM_QUERIES):
@@ -132,11 +181,11 @@ def cmd_bench(args) -> None:
     # warmup on a distinct seed: compiles everything, excluded from timing.
     # Timed repetitions use fresh seeds — re-running a jitted fn on the very
     # same arrays can report ~0s (see .claude/skills/verify/SKILL.md).
-    w_pts, w_qs = _generate(args.seed + 1000, args.dim, args.n, args.generator)
+    w_pts, w_qs, _ = _generate(args.seed + 1000, args.dim, args.n, args.generator)
     d2, _ = _solve(w_pts, w_qs, k=args.k, engine=args.engine, mesh_devices=args.devices)
     np.asarray(d2)  # host fetch = true barrier
     with timer.phase("generate") as h:
-        points, queries = _generate(args.seed, args.dim, args.n, args.generator)
+        points, queries, _ = _generate(args.seed, args.dim, args.n, args.generator)
         h += [points, queries]
     with timer.phase("build+query") as h:
         d2, idx = _solve(points, queries, k=args.k, engine=args.engine, mesh_devices=args.devices)
@@ -150,18 +199,55 @@ def cmd_bench(args) -> None:
     print(json.dumps(rep))
 
 
+def _build_tree_for_engine(points, engine: str, mesh_devices: int | None):
+    """Build the tree object matching the engine choice (for checkpointing).
+
+    "auto" resolves to the bucket tree — same as _solve's auto for low D, and
+    still the right checkpoint for high D (exact; a loaded tree answers with
+    bucket_knn even where the harness's auto would have used brute force)."""
+    if engine in ("auto", "bucket"):
+        from kdtree_tpu.ops.bucket import build_bucket
+
+        return build_bucket(points)
+    if engine == "tree":
+        from kdtree_tpu.ops.build import build_jit
+
+        return build_jit(points)
+    if engine == "global":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_tree import build_global
+
+        return build_global(points, mesh=make_mesh(mesh_devices))
+    raise SystemExit(f"engine {engine!r} does not produce a checkpointable tree")
+
+
+def _tree_knn(tree, queries, k: int):
+    """Dispatch k-NN on whichever tree type a checkpoint contained."""
+    from kdtree_tpu.models.tree import KDTree
+    from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn
+    from kdtree_tpu.parallel.global_tree import GlobalKDTree, global_knn
+
+    if isinstance(tree, BucketKDTree):
+        return bucket_knn(tree, queries, k=k)
+    if isinstance(tree, GlobalKDTree):
+        return global_knn(tree, queries, k=k)
+    assert isinstance(tree, KDTree)
+    from kdtree_tpu import knn
+
+    return knn(tree, queries, k=k)
+
+
 def cmd_build(args) -> None:
-    from kdtree_tpu import build_jit
     from kdtree_tpu.utils.checkpoint import save_tree
 
-    points, _ = _generate(args.seed, args.dim, args.n, args.generator)
-    tree = build_jit(points)
-    save_tree(args.out, tree, meta={"seed": args.seed, "generator": args.generator})
-    print(f"saved tree (n={tree.n}, dim={tree.dim}) to {args.out}")
+    points, _, gen_used = _generate(args.seed, args.dim, args.n, args.generator)
+    tree = _build_tree_for_engine(points, args.engine, args.devices)
+    save_tree(args.out, tree, meta={"seed": args.seed, "generator": gen_used})
+    n, dim = points.shape
+    print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to {args.out}")
 
 
 def cmd_query(args) -> None:
-    from kdtree_tpu import knn
     from kdtree_tpu.utils.checkpoint import load_tree
 
     tree, meta = load_tree(args.tree)
@@ -176,10 +262,11 @@ def cmd_query(args) -> None:
     if args.seed is not None and args.seed != seed:
         print(f"note: using checkpoint seed {seed} (ignoring --seed {args.seed})",
               file=sys.stderr)
-    _, queries = _generate(seed, tree.dim, tree.n, generator)
-    d2, idx = knn(tree, queries, k=args.k)
+    n = tree.n if hasattr(tree, "n") else tree.n_real
+    queries = _generate_queries(seed, tree.dim, n, generator)
+    d2, _ = _tree_knn(tree, queries, k=args.k)
     for q in range(queries.shape[0]):
-        print_result_line(tree.n + q, float(np.sqrt(d2[q, 0])))
+        print_result_line(n + q, float(np.sqrt(d2[q, 0])))
     print("DONE")
 
 
@@ -190,7 +277,9 @@ def main(argv=None) -> None:
                         "axon sitecustomize overrides the JAX_PLATFORMS env var")
     p.add_argument("--generator", choices=["threefry", "mt19937"], default="mt19937",
                    help="problem generator (mt19937 = bit-exact reference replay)")
-    p.add_argument("--engine", choices=["auto", "tree", "bruteforce", "ensemble"],
+    p.add_argument("--engine",
+                   choices=["auto", "tree", "bucket", "bruteforce", "ensemble",
+                            "global"],
                    default="auto")
     p.add_argument("--devices", type=int, default=None,
                    help="device count for ensemble engine (default: all)")

@@ -1,0 +1,85 @@
+"""Measure build/query path candidates on the real chip at 16M x 3D.
+
+Not a test — a one-off profiling aid for picking the headline bench chain.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import kdtree_tpu as kt
+from kdtree_tpu.ops.build_presort import build_presort
+from kdtree_tpu.ops.bucket import build_bucket, bucket_knn
+
+
+def sync(out):
+    # fetch only a few elements per leaf: forces the producer computation to
+    # finish without paying a 100+MB device->host transfer over the tunnel
+    jax.tree.map(
+        lambda x: np.asarray(x.ravel()[:4]) if hasattr(x, "shape") else x, out
+    )
+
+
+def timeit(label, fn, reps=3):
+    # warmup/compile
+    sync(fn(999))
+    ts = []
+    for seed in range(1, reps + 1):
+        t0 = time.perf_counter()
+        sync(fn(seed))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: best {min(ts):.3f}s  all {[round(t, 3) for t in ts]}", flush=True)
+    return min(ts)
+
+
+def main():
+    n, dim, nq = 1 << 24, 3, 10
+    print(f"platform={jax.devices()[0].platform} n={n} dim={dim}", flush=True)
+
+    def gen(seed):
+        return kt.generate_problem(seed=seed, dim=dim, num_points=n, num_queries=nq)
+
+    timeit("gen only", lambda s: gen(s)[0])
+
+    def chain_sort(seed):
+        pts, qs = gen(seed)
+        tree = kt.build_jit(pts)
+        return kt.nearest_neighbor(tree, qs)[0]
+
+    def chain_presort(seed):
+        pts, qs = gen(seed)
+        tree = build_presort(pts)
+        return kt.nearest_neighbor(tree, qs)[0]
+
+    def chain_bucket(seed):
+        pts, qs = gen(seed)
+        tree = build_bucket(pts)
+        return bucket_knn(tree, qs, k=1)[0]
+
+    timeit("gen+build_jit+10NN", chain_sort)
+    timeit("gen+build_presort+10NN", chain_presort)
+    timeit("gen+build_bucket+10NN", chain_bucket)
+
+    # build-only splits
+    def build_only(builder):
+        pts_cache = {}
+
+        def f(seed):
+            if seed not in pts_cache:
+                pts_cache[seed] = gen(seed)[0]
+                np.asarray(pts_cache[seed][:1])
+            return builder(pts_cache[seed])
+
+        return f
+
+    timeit("build_jit only", build_only(lambda p: kt.build_jit(p).node_point))
+    timeit("build_presort only", build_only(lambda p: build_presort(p).node_point))
+    timeit("build_bucket only", build_only(lambda p: build_bucket(p).node_gid))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Per-level sort-variant microbenchmark at 16M (one-off profiling aid).
+
+The build's per-level cost is one stable lax.sort over composite keys; this
+compares key/payload packings to pick the cheapest on real hardware.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def sync(out):
+    jax.tree.map(lambda x: np.asarray(x.ravel()[:4]) if hasattr(x, "shape") else x, out)
+
+
+def timeit(label, fn, *args, reps=3):
+    f = jax.jit(fn)
+    sync(f(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(f(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: best {min(ts)*1000:.1f}ms  all {[round(t*1000) for t in ts]}", flush=True)
+
+
+def main():
+    n = 1 << 24
+    print(f"platform={jax.devices()[0].platform} n={n}", flush=True)
+    key = jax.random.key(0)
+    coord = jax.random.uniform(key, (n,), jnp.float32, -100, 100)
+    xyz = jax.random.uniform(key, (n, 3), jnp.float32, -100, 100)
+    segkey = (jnp.arange(n, dtype=jnp.int32) >> 12) * 2
+    perm = jnp.arange(n, dtype=jnp.int32)
+    consume = jnp.asarray(np.random.default_rng(0).integers(0, 24, n, np.int32))
+
+    def sort3(segkey, coord, perm):
+        return lax.sort((segkey, coord, perm), num_keys=3, is_stable=True)[2]
+
+    def fkey(coord):
+        b = lax.bitcast_convert_type(coord, jnp.uint32)
+        return jnp.where(b >> 31 != 0, ~b, b | jnp.uint32(0x80000000))
+
+    def sort_u64(segkey, coord, perm):
+        packed = (segkey.astype(jnp.uint64) << 32) | fkey(coord).astype(jnp.uint64)
+        return lax.sort((packed, perm), num_keys=1, is_stable=True)[1]
+
+    def sort_u64_payload(segkey, xyz, perm):
+        packed = (segkey.astype(jnp.uint64) << 32) | fkey(xyz[:, 0]).astype(jnp.uint64)
+        out = lax.sort(
+            (packed, xyz[:, 0], xyz[:, 1], xyz[:, 2], perm), num_keys=1, is_stable=True
+        )
+        return out[4]
+
+    def sort2_u32(segkey, coord, perm):
+        return lax.sort((segkey, fkey(coord), perm), num_keys=2, is_stable=True)[2]
+
+    def gather_axis(perm, xyz):
+        return xyz[perm, 1]
+
+    def level_scans(consume):
+        lvl = 12
+        dead = (consume < lvl).astype(jnp.int32)
+        csum = jnp.cumsum(dead)
+        return 2 * csum - dead
+
+    timeit("sort 3-key (i32,f32,i32)", sort3, segkey, coord, perm)
+    timeit("sort 1-key u64 + i32 payload", sort_u64, segkey, coord, perm)
+    timeit("sort 1-key u64 + xyz+id payload", sort_u64_payload, segkey, xyz, perm)
+    timeit("sort 2-key (i32,u32) + i32", sort2_u32, segkey, coord, perm)
+    timeit("gather coords[perm]", gather_axis, perm, xyz)
+    timeit("segkey scans", level_scans, consume)
+    timeit("top_k 16 of 16M", lambda c: lax.top_k(c, 16)[0], coord)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,26 @@ def test_bucket_knn_matches_bruteforce(n, d, k, cap):
     np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "n,d,cap", [(100, 3, 8), (1000, 3, 16), (4096, 3, 128), (777, 5, 32), (513, 2, 4)]
+)
+def test_presort_strategy_identical_tree(n, d, cap):
+    pts, _ = generate_problem(seed=n + d, dim=d, num_points=n, num_queries=1)
+    a = build_bucket(pts, bucket_cap=cap, strategy="sort")
+    b = build_bucket(pts, bucket_cap=cap, strategy="presort")
+    np.testing.assert_array_equal(np.asarray(a.node_gid), np.asarray(b.node_gid))
+    np.testing.assert_array_equal(np.asarray(a.node_bucket), np.asarray(b.node_bucket))
+    np.testing.assert_array_equal(np.asarray(a.bucket_gid), np.asarray(b.bucket_gid))
+    np.testing.assert_array_equal(np.asarray(a.bucket_pts), np.asarray(b.bucket_pts))
+    np.testing.assert_array_equal(np.asarray(a.node_coords), np.asarray(b.node_coords))
+
+
+def test_bucket_cap_one_rejected():
+    pts, _ = generate_problem(seed=1, dim=3, num_points=64, num_queries=1)
+    with pytest.raises(ValueError):
+        build_bucket(pts, bucket_cap=1)
+
+
 def test_whole_tree_is_one_bucket():
     pts, qs = generate_problem(seed=9, dim=3, num_points=50, num_queries=5)
     tree = build_bucket(pts, bucket_cap=128)

@@ -87,7 +87,7 @@ def test_argv_mode_small():
 def test_argv_mode_engines_agree():
     """All engines are exact, so the protocol output is engine-independent."""
     outs = []
-    for engine in ("tree", "bruteforce", "ensemble"):
+    for engine in ("tree", "bucket", "bruteforce", "ensemble", "global"):
         # threefry generator: engine agreement must hold without a toolchain
         res = _run_cli(["--generator", "threefry", "--engine", engine,
                         "harness", "3", "3", "500"])
@@ -123,11 +123,14 @@ def test_malformed_spec():
     assert "Traceback" not in res.stderr
 
 
-def test_build_query_roundtrip(tmp_path):
-    """build saves provenance; query replays it regardless of --seed."""
+@pytest.mark.parametrize("engine", ["tree", "bucket", "global"])
+def test_build_query_roundtrip(tmp_path, engine):
+    """build saves provenance; query replays it regardless of --seed —
+    for every checkpointable engine (mirrors the reference's per-mode run
+    targets, Makefile:31-46)."""
     tree_path = str(tmp_path / "t.npz")
-    res = _run_cli(["--generator", "threefry", "build", "--seed", "7",
-                    "--dim", "3", "--n", "500", "--out", tree_path])
+    res = _run_cli(["--generator", "threefry", "--engine", engine, "build",
+                    "--seed", "7", "--dim", "3", "--n", "500", "--out", tree_path])
     assert res.returncode == 0, res.stderr[-2000:]
     res = _run_cli(["query", "--tree", tree_path, "--seed", "42"])
     assert res.returncode == 0, res.stderr[-2000:]

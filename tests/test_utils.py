@@ -19,6 +19,41 @@ def test_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
+def test_checkpoint_roundtrip_bucket(tmp_path):
+    from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn, build_bucket
+
+    pts, qs = generate_problem(seed=3, dim=3, num_points=500, num_queries=5)
+    tree = build_bucket(pts, bucket_cap=32)
+    path = str(tmp_path / "bucket.npz")
+    save_tree(path, tree, meta={"seed": 3, "generator": "threefry"})
+    tree2, meta = load_tree(path)
+    assert isinstance(tree2, BucketKDTree)
+    assert meta["seed"] == 3
+    assert (tree2.n_real, tree2.num_levels) == (tree.n_real, tree.num_levels)
+    d1, i1 = bucket_knn(tree, qs, k=3)
+    d2, i2 = bucket_knn(tree2, qs, k=3)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_checkpoint_roundtrip_global(tmp_path):
+    from kdtree_tpu.parallel import make_mesh
+    from kdtree_tpu.parallel.global_tree import (
+        GlobalKDTree, build_global, global_knn,
+    )
+
+    pts, qs = generate_problem(seed=4, dim=3, num_points=256, num_queries=5)
+    tree = build_global(pts, mesh=make_mesh(4))
+    path = str(tmp_path / "global.npz")
+    save_tree(path, tree, meta={"seed": 4})
+    tree2, meta = load_tree(path)
+    assert isinstance(tree2, GlobalKDTree)
+    d1, i1 = global_knn(tree, qs, k=2)
+    d2, i2 = global_knn(tree2, qs, k=2)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
 def test_phase_timer():
     t = PhaseTimer()
     with t.phase("a") as h:
