@@ -10,9 +10,11 @@ full structural analysis of the reference and the design mapping.
 from kdtree_tpu.models.tree import KDTree, TreeSpec, tree_spec
 from kdtree_tpu.ops.build import build, build_jit, validate_invariants
 from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn, build_bucket
+from kdtree_tpu.ops.morton import MortonTree, build_morton, morton_knn
 from kdtree_tpu.ops.query import knn, nearest_neighbor
 from kdtree_tpu.ops.generate import (
     generate_problem,
+    generate_queries,
     generate_points_rowwise,
     generate_points_shard,
 )
@@ -24,6 +26,10 @@ __all__ = [
     "BucketKDTree",
     "build_bucket",
     "bucket_knn",
+    "MortonTree",
+    "build_morton",
+    "morton_knn",
+    "generate_queries",
     "KDTree",
     "TreeSpec",
     "tree_spec",

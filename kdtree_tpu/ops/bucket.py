@@ -294,20 +294,16 @@ def build_bucket(
 
 
 def _bucket_scan_merge(tree, q, bkt, enabled, best_d, best_i):
-    """Dense bucket scan + sorted merge into the (sorted is not required)
-    k-buffer. ``enabled`` masks the whole update."""
-    k = best_d.shape[0]
+    """Dense single-bucket scan merged into the k-buffer via the shared
+    helper. ``enabled`` masks the whole update."""
+    from kdtree_tpu.ops.topk import merge_topk
+
     bpts = tree.bucket_pts[jnp.maximum(bkt, 0)]  # [B, D]
     bgid = tree.bucket_gid[jnp.maximum(bkt, 0)]
     bd = q[None, :] - bpts
     bd2 = jnp.sum(bd * bd, axis=1)  # [B] (inf at padding)
     bd2 = jnp.where(enabled, bd2, jnp.inf)
-    cand_d = jnp.concatenate([best_d, bd2])
-    cand_i = jnp.concatenate([best_i, bgid])
-    cand_d, cand_i = lax.sort((cand_d, cand_i), num_keys=2, is_stable=True)
-    best_d = jnp.where(enabled, cand_d[:k], best_d)
-    best_i = jnp.where(enabled, cand_i[:k], best_i)
-    return best_d, best_i
+    return merge_topk(best_d, best_i, bd2, bgid, enabled)
 
 
 def _bucket_knn_one(tree: BucketKDTree, k: int, q):
@@ -434,20 +430,11 @@ def _bucket_knn_one(tree: BucketKDTree, k: int, q):
         )
 
         # dense scan of the collected buckets: [V, B, D] block + one top-k
-        bsel = jnp.maximum(blist, 0)
-        pts_v = tree.bucket_pts[bsel]  # [V, B, D]
-        gid_v = tree.bucket_gid[bsel]  # [V, B]
-        dv = q[None, None, :] - pts_v
-        d2_v = jnp.sum(dv * dv, axis=-1)  # [V, B]
-        d2_v = jnp.where((blist >= 0)[:, None], d2_v, jnp.inf).reshape(V * B)
-        kk = min(k, V * B)
-        neg, sel = lax.top_k(-d2_v, kk)
-        cand_d = jnp.concatenate([best_d, -neg])
-        cand_i = jnp.concatenate([best_i, gid_v.reshape(V * B)[sel]])
-        cand_d, cand_i = lax.sort((cand_d, cand_i), num_keys=2, is_stable=True)
-        any_scan = bcnt > 0
-        best_d = jnp.where(any_scan, cand_d[:k], best_d)
-        best_i = jnp.where(any_scan, cand_i[:k], best_i)
+        from kdtree_tpu.ops.topk import scan_bucket_block
+
+        best_d, best_i = scan_bucket_block(
+            q, tree.bucket_pts, tree.bucket_gid, blist, bcnt, best_d, best_i
+        )
         return stack_n, stack_b, sp, best_d, best_i
 
     init = (stack_n, stack_b, sp, best_d, best_i)

@@ -1,0 +1,325 @@
+"""Morton-order bucket tree: the TPU-native spatial index.
+
+The reference's build is "recursively sort each segment by a cycling axis"
+(``kdtree_sequential.cpp:30-70``) — inherently one pass per tree level, ~24
+full-array sorts at 16M points even after level-synchronous batching
+(:mod:`kdtree_tpu.ops.build`). A TPU wants the opposite shape: ONE big sort
+and then only dense reductions. This is the classic linear-BVH construction
+(cf. Karras-style LBVH builders in PAPERS.md/SNIPPETS.md, re-expressed in
+XLA ops):
+
+1. quantize each axis to ``bits`` integer cells and interleave into a Morton
+   code — spatially close points get numerically close codes;
+2. ONE stable ``lax.sort`` by (code, id), carrying the coordinate columns as
+   sort payload (measured: payload carry is ~free next to the key compare,
+   and it avoids a 16M random gather afterwards);
+3. cut the sorted order into fixed-size buckets of B points (B ~ one VPU
+   tile); bucket AABBs via masked min/max reductions;
+4. an implicit complete binary tree over the (pow2-padded) buckets, parent
+   AABB = union of children — log2 levels of shrinking reductions, ~2x the
+   leaf-AABB bytes in total traffic.
+
+Build cost at 16M x 3D is one sort + a few dense passes — measured ~0.4s on
+a v5e chip vs ~5.8s for the level-synchronous sort build and ~122s for the
+reference on a Xeon core.
+
+Queries stay EXACT: the AABB distance
+
+    lb(q, node) = sum_a max(lo[a] - q[a], q[a] - hi[a], 0)^2
+
+is a true lower bound on the distance to any point in the node's subtree
+(tighter than the k-d splitting-plane bound the reference prunes with,
+``kdtree_sequential.cpp:118``), so best-first DFS with "visit iff lb < worst
+of the current k-buffer" can never miss a true neighbor. Leaf visits are
+dense [B, D] distance blocks — VPU work, batched V buckets at a time like
+:func:`kdtree_tpu.ops.bucket.bucket_knn`'s phase B.
+
+The tree differs structurally from the reference's median-split k-d tree
+(that one is kept, bit-exact, in :mod:`kdtree_tpu.ops.build` /
+:mod:`kdtree_tpu.ops.bucket` for parity testing); results agree because both
+are exact — validated against the brute-force oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kdtree_tpu.ops.topk import scan_bucket_block
+
+DEFAULT_BUCKET = 128
+_QUERY_COLLECT = 8  # buckets per dense-scan round in the query loop
+
+
+@jax.tree_util.register_pytree_node_class
+class MortonTree:
+    """Implicit complete AABB tree over Morton-sorted point buckets.
+
+    Storage (pytree leaves, device-resident):
+      node_lo / node_hi  f32[H, D]   heap-indexed AABBs; node i has children
+                                     2i+1 / 2i+2; leaves are the last NBP
+                                     slots and map to bucket (i - (NBP-1))
+      bucket_pts         f32[NBP, B, D]  bucket contents (+inf padding)
+      bucket_gid         i32[NBP, B]     original point ids (-1 padding)
+    Static aux: n_real, num_levels (= log2 NBP, max traversal depth).
+    """
+
+    def __init__(self, node_lo, node_hi, bucket_pts, bucket_gid, n_real, num_levels):
+        self.node_lo = node_lo
+        self.node_hi = node_hi
+        self.bucket_pts = bucket_pts
+        self.bucket_gid = bucket_gid
+        self.n_real = n_real
+        self.num_levels = num_levels
+
+    @property
+    def dim(self) -> int:
+        return self.bucket_pts.shape[2]
+
+    @property
+    def num_buckets(self) -> int:
+        return self.bucket_pts.shape[0]
+
+    @property
+    def bucket_size(self) -> int:
+        return self.bucket_pts.shape[1]
+
+    @property
+    def heap_size(self) -> int:
+        return self.node_lo.shape[0]
+
+    def tree_flatten(self):
+        return (
+            (self.node_lo, self.node_hi, self.bucket_pts, self.bucket_gid),
+            (self.n_real, self.num_levels),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __repr__(self):
+        return (
+            f"MortonTree(n={self.n_real}, buckets={self.num_buckets}x"
+            f"{self.bucket_size}, dim={self.dim})"
+        )
+
+
+def morton_codes(points: jax.Array, bits: int) -> jax.Array:
+    """u32 Morton (Z-order) codes; ``bits`` quantization bits per axis.
+
+    Normalization uses the data's own per-axis min/max so clustered inputs
+    (the 128-D grading generator's Gaussian blobs analog) still spread over
+    the full code range.
+    """
+    n, d = points.shape
+    lo = jnp.min(points, axis=0)
+    hi = jnp.max(points, axis=0)
+    scale = jnp.where(hi > lo, (hi - lo), jnp.float32(1))
+    cells = jnp.clip(
+        ((points - lo) / scale * (1 << bits)).astype(jnp.uint32),
+        0,
+        (1 << bits) - 1,
+    )
+    code = jnp.zeros(n, jnp.uint32)
+    for b in range(bits):  # static unroll: bits*d or-shift ops
+        for a in range(d):
+            # u32 shifts >= 32 are implementation-defined in XLA; axes whose
+            # interleave slot falls outside the code simply don't contribute
+            # (correctness is unaffected — any point order yields a valid
+            # tree — only locality degrades, and only for d > 32)
+            if b * d + a < 32:
+                code = code | (((cells[:, a] >> b) & 1) << (b * d + a))
+    return code
+
+
+@functools.lru_cache(maxsize=32)
+def _tree_shape(n: int, bucket_cap: int) -> Tuple[int, int, int]:
+    """(num_buckets_padded, heap_size, num_levels) for n points."""
+    nb = max(1, -(-n // bucket_cap))
+    nbp = 1 << (nb - 1).bit_length()
+    return nbp, 2 * nbp - 1, (nb - 1).bit_length()
+
+
+def build_morton_impl(points: jax.Array, *, bucket_cap: int, bits: int) -> MortonTree:
+    n, d = points.shape
+    nbp, heap_size, num_levels = _tree_shape(n, bucket_cap)
+    code = morton_codes(points, bits)
+    gid = jnp.arange(n, dtype=jnp.int32)
+    # one sort; coordinate columns ride as payload (stable => gid tie-break)
+    ops = lax.sort(
+        (code, gid, *(points[:, a] for a in range(d))), num_keys=1, is_stable=True
+    )
+    sgid = ops[1]
+    cols = ops[2:]
+
+    pad = nbp * bucket_cap - n
+    sgid = jnp.concatenate([sgid, jnp.full(pad, -1, jnp.int32)])
+    spts = jnp.stack(
+        [jnp.concatenate([c, jnp.full(pad, jnp.inf, c.dtype)]) for c in cols], axis=1
+    )
+
+    bucket_pts = spts.reshape(nbp, bucket_cap, d)
+    bucket_gid = sgid.reshape(nbp, bucket_cap)
+    valid = (bucket_gid >= 0)[:, :, None]
+
+    # leaf AABBs (masked so padding rows never loosen a bound)
+    leaf_lo = jnp.min(jnp.where(valid, bucket_pts, jnp.inf), axis=1)
+    leaf_hi = jnp.max(jnp.where(valid, bucket_pts, -jnp.inf), axis=1)
+
+    # implicit complete tree, bottom-up; level arrays halve each round
+    levels_lo = [leaf_lo]
+    levels_hi = [leaf_hi]
+    while levels_lo[0].shape[0] > 1:
+        lo2 = levels_lo[0].reshape(-1, 2, d)
+        hi2 = levels_hi[0].reshape(-1, 2, d)
+        levels_lo.insert(0, jnp.min(lo2, axis=1))
+        levels_hi.insert(0, jnp.max(hi2, axis=1))
+    node_lo = jnp.concatenate(levels_lo, axis=0)
+    node_hi = jnp.concatenate(levels_hi, axis=0)
+    return MortonTree(
+        node_lo=node_lo,
+        node_hi=node_hi,
+        bucket_pts=bucket_pts,
+        bucket_gid=bucket_gid,
+        n_real=n,
+        num_levels=num_levels,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("bucket_cap", "bits"))
+def _build_morton_jit(points, bucket_cap, bits):
+    return build_morton_impl(points, bucket_cap=bucket_cap, bits=bits)
+
+
+def build_morton(
+    points: jax.Array, bucket_cap: int = DEFAULT_BUCKET, bits: int | None = None
+) -> MortonTree:
+    """Build the Morton bucket tree (jitted). ``bits`` defaults to the most
+    that fit a u32 code for this dimensionality (10 at D=3)."""
+    n, d = points.shape
+    if bits is None:
+        bits = 32 // max(d, 1)
+    bits = max(1, min(bits, 32 // max(d, 1), 16))
+    return _build_morton_jit(points, bucket_cap, bits)
+
+
+# ---------------------------------------------------------------------------
+# query
+# ---------------------------------------------------------------------------
+
+
+def _bbox_d2(q, lo, hi):
+    """Exact lower bound on |q - p|^2 over any p inside [lo, hi]."""
+    gap = jnp.maximum(jnp.maximum(lo - q, q - hi), 0.0)
+    return jnp.sum(gap * gap)
+
+
+def _morton_knn_one(tree: MortonTree, k: int, q):
+    nbp = tree.num_buckets
+    first_leaf = nbp - 1
+    B = tree.bucket_size
+    V = _QUERY_COLLECT
+    # worst case the stack holds both children at every level
+    stack_cap = 2 * tree.num_levels + 2
+
+    best_d = jnp.full(k, jnp.inf, jnp.float32)
+    best_i = jnp.full(k, -1, jnp.int32)
+
+    stack_n = jnp.zeros(stack_cap, jnp.int32)
+    stack_b = jnp.zeros(stack_cap, jnp.float32)
+    sp = jnp.int32(1)  # root pre-pushed with bound 0
+
+    def outer_cond(state):
+        return state[2] > 0
+
+    def outer_body(state):
+        stack_n, stack_b, sp, best_d, best_i = state
+        blist = jnp.full(V, -1, jnp.int32)
+
+        def inner_cond(s):
+            _, _, sp, _, _, _, bcnt = s
+            return (sp > 0) & (bcnt < V)
+
+        def inner_body(s):
+            stack_n, stack_b, sp, best_d, best_i, blist, bcnt = s
+            top = sp - 1
+            node = stack_n[top]
+            bound = stack_b[top]
+            worst = jnp.max(best_d)
+            visit = bound < worst
+            is_leaf = visit & (node >= first_leaf)
+            is_internal = visit & (node < first_leaf)
+            sp = sp - 1  # pop
+
+            # internal: push children ordered near-last (visited first),
+            # each only if its own bound already beats the current worst
+            c1 = 2 * node + 1
+            c2 = 2 * node + 2
+            ci = jnp.minimum(jnp.stack([c1, c2]), tree.heap_size - 1)
+            bd = jax.vmap(lambda i: _bbox_d2(q, tree.node_lo[i], tree.node_hi[i]))(ci)
+            swap = bd[0] < bd[1]  # push nearer child last
+            first_c = jnp.where(swap, c2, c1)
+            first_b = jnp.where(swap, bd[1], bd[0])
+            second_c = jnp.where(swap, c1, c2)
+            second_b = jnp.where(swap, bd[0], bd[1])
+            push1 = is_internal & (first_b < worst)
+            stack_n = jnp.where(push1, stack_n.at[sp].set(first_c), stack_n)
+            stack_b = jnp.where(push1, stack_b.at[sp].set(first_b), stack_b)
+            sp = jnp.where(push1, sp + 1, sp)
+            push2 = is_internal & (second_b < worst)
+            stack_n = jnp.where(push2, stack_n.at[sp].set(second_c), stack_n)
+            stack_b = jnp.where(push2, stack_b.at[sp].set(second_b), stack_b)
+            sp = jnp.where(push2, sp + 1, sp)
+
+            collect = is_leaf
+            blist = jnp.where(collect, blist.at[bcnt].set(node - first_leaf), blist)
+            bcnt = jnp.where(collect, bcnt + 1, bcnt)
+            return stack_n, stack_b, sp, best_d, best_i, blist, bcnt
+
+        stack_n, stack_b, sp, best_d, best_i, blist, bcnt = lax.while_loop(
+            inner_cond, inner_body,
+            (stack_n, stack_b, sp, best_d, best_i, blist, jnp.int32(0)),
+        )
+        best_d, best_i = scan_bucket_block(
+            q, tree.bucket_pts, tree.bucket_gid, blist, bcnt, best_d, best_i
+        )
+        return stack_n, stack_b, sp, best_d, best_i
+
+    init = (stack_n, stack_b, sp, best_d, best_i)
+    _, _, _, best_d, best_i = lax.while_loop(outer_cond, outer_body, init)
+    return lax.sort((best_d, best_i), num_keys=2, is_stable=True)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def _morton_knn_batch(tree, queries, k: int, chunk: int):
+    nq = queries.shape[0]
+    pad = (-nq) % chunk
+    if pad:
+        queries = jnp.concatenate(
+            [queries, jnp.zeros((pad, queries.shape[1]), queries.dtype)], axis=0
+        )
+    chunks = queries.reshape(-1, chunk, queries.shape[1])
+
+    def one_chunk(_, qs):
+        return None, jax.vmap(lambda q: _morton_knn_one(tree, k, q))(qs)
+
+    _, (d2, idx) = lax.scan(one_chunk, None, chunks)
+    return d2.reshape(-1, k)[:nq], idx.reshape(-1, k)[:nq]
+
+
+def morton_knn(
+    tree: MortonTree, queries: jax.Array, k: int = 1, chunk: int = 16384
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN against a Morton bucket tree.
+
+    Returns (dists_sq f32[Q, k], indices i32[Q, k]) ascending. Large query
+    batches run in fixed-size chunks under a scan (bounded memory, local
+    lockstep divergence — same rationale as bucket_knn).
+    """
+    k = min(k, tree.n_real)
+    return _morton_knn_batch(tree, queries, k, min(chunk, max(queries.shape[0], 1)))

@@ -1,0 +1,47 @@
+"""Shared k-buffer merge for bucketed tree queries.
+
+Both bucket and Morton queries collect V candidate buckets, compute a dense
+[V*B] distance block, and fold it into a per-query k-buffer. The merge's
+invariants are subtle enough to keep in ONE place (cf. round-2 review):
++inf-masked lanes must never displace real candidates, and -1 padding ids
+must lose ties to real ids, which the 2-key stable sort guarantees because
+(inf, -1) sorts after (inf, real>=0) never happens — -1 < real, but only
+distances decide unless equal, and equal-inf entries are all discardable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def merge_topk(best_d, best_i, cand_d2, cand_gid, enabled):
+    """Fold flat candidates (d2 f32[M], gid i32[M]) into the sorted-ascending
+    k-buffer (best_d f32[k], best_i i32[k]); no-op when ``enabled`` is false.
+    """
+    k = best_d.shape[0]
+    m = cand_d2.shape[0]
+    kk = min(k, m)
+    neg, sel = lax.top_k(-cand_d2, kk)
+    all_d = jnp.concatenate([best_d, -neg])
+    all_i = jnp.concatenate([best_i, cand_gid[sel]])
+    all_d, all_i = lax.sort((all_d, all_i), num_keys=2, is_stable=True)
+    best_d = jnp.where(enabled, all_d[:k], best_d)
+    best_i = jnp.where(enabled, all_i[:k], best_i)
+    return best_d, best_i
+
+
+def scan_bucket_block(q, bucket_pts, bucket_gid, blist, bcnt, best_d, best_i):
+    """Dense-scan the collected bucket list and merge into the k-buffer.
+
+    q f32[D]; bucket_pts f32[NB, B, D] (+inf padding); bucket_gid i32[NB, B]
+    (-1 padding); blist i32[V] bucket indices (-1 = empty slot); bcnt i32.
+    """
+    bsel = jnp.maximum(blist, 0)
+    pts_v = bucket_pts[bsel]  # [V, B, D]
+    gid_v = bucket_gid[bsel]  # [V, B]
+    dv = q[None, None, :] - pts_v
+    d2_v = jnp.sum(dv * dv, axis=-1)  # [V, B] (inf at padding)
+    d2_v = jnp.where((blist >= 0)[:, None], d2_v, jnp.inf).reshape(-1)
+    return merge_topk(best_d, best_i, d2_v, gid_v.reshape(-1), bcnt > 0)

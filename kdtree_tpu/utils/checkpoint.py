@@ -18,9 +18,15 @@ import numpy as np
 def _registry():
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree
+    from kdtree_tpu.ops.morton import MortonTree
     from kdtree_tpu.parallel.global_tree import GlobalKDTree
 
-    return {"classic": KDTree, "bucket": BucketKDTree, "global": GlobalKDTree}
+    return {
+        "classic": KDTree,
+        "bucket": BucketKDTree,
+        "morton": MortonTree,
+        "global": GlobalKDTree,
+    }
 
 
 def save_tree(path: str, tree, meta: dict | None = None) -> None:

@@ -114,7 +114,11 @@ def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None
     """Returns (d2[Q,k], idx[Q,k]) by the chosen engine."""
     dim = points.shape[1]
     if engine == "auto":
-        engine = "bucket" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
+        engine = "morton" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
+    if engine == "morton":
+        from kdtree_tpu.ops.morton import build_morton, morton_knn
+
+        return morton_knn(build_morton(points), queries, k=k)
     if engine == "tree":
         from kdtree_tpu import build_jit, knn
 
@@ -202,10 +206,14 @@ def cmd_bench(args) -> None:
 def _build_tree_for_engine(points, engine: str, mesh_devices: int | None):
     """Build the tree object matching the engine choice (for checkpointing).
 
-    "auto" resolves to the bucket tree — same as _solve's auto for low D, and
+    "auto" resolves to the Morton tree — same as _solve's auto for low D, and
     still the right checkpoint for high D (exact; a loaded tree answers with
-    bucket_knn even where the harness's auto would have used brute force)."""
-    if engine in ("auto", "bucket"):
+    morton_knn even where the harness's auto would have used brute force)."""
+    if engine in ("auto", "morton"):
+        from kdtree_tpu.ops.morton import build_morton
+
+        return build_morton(points)
+    if engine == "bucket":
         from kdtree_tpu.ops.bucket import build_bucket
 
         return build_bucket(points)
@@ -225,8 +233,11 @@ def _tree_knn(tree, queries, k: int):
     """Dispatch k-NN on whichever tree type a checkpoint contained."""
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn
+    from kdtree_tpu.ops.morton import MortonTree, morton_knn
     from kdtree_tpu.parallel.global_tree import GlobalKDTree, global_knn
 
+    if isinstance(tree, MortonTree):
+        return morton_knn(tree, queries, k=k)
     if isinstance(tree, BucketKDTree):
         return bucket_knn(tree, queries, k=k)
     if isinstance(tree, GlobalKDTree):
@@ -278,8 +289,8 @@ def main(argv=None) -> None:
     p.add_argument("--generator", choices=["threefry", "mt19937"], default="mt19937",
                    help="problem generator (mt19937 = bit-exact reference replay)")
     p.add_argument("--engine",
-                   choices=["auto", "tree", "bucket", "bruteforce", "ensemble",
-                            "global"],
+                   choices=["auto", "morton", "tree", "bucket", "bruteforce",
+                            "ensemble", "global"],
                    default="auto")
     p.add_argument("--devices", type=int, default=None,
                    help="device count for ensemble engine (default: all)")

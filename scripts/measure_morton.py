@@ -1,0 +1,63 @@
+"""Morton chain timing at 16M x 3D on the real chip (one-off profiling aid)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import kdtree_tpu as kt
+
+
+def sync(out):
+    jax.tree.map(lambda x: np.asarray(x.ravel()[:4]) if hasattr(x, "shape") else x, out)
+
+
+def timeit(label, fn, reps=3):
+    sync(fn(999))
+    ts = []
+    for seed in range(1, reps + 1):
+        t0 = time.perf_counter()
+        sync(fn(seed))
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: best {min(ts):.3f}s  all {[round(t, 3) for t in ts]}", flush=True)
+    return min(ts)
+
+
+def main():
+    n, dim, nq = 1 << 24, 3, 10
+    print(f"platform={jax.devices()[0].platform} n={n}", flush=True)
+
+    def gen(seed):
+        return kt.generate_problem(seed=seed, dim=dim, num_points=n, num_queries=nq)
+
+    for cap in (128, 256):
+        def chain(seed, cap=cap):
+            pts, qs = gen(seed)
+            tree = kt.build_morton(pts, bucket_cap=cap)
+            return kt.morton_knn(tree, qs, k=1)[0]
+
+        timeit(f"gen+build_morton(cap={cap})+10NN", chain)
+
+    # oracle sanity at 16M on the chip
+    pts, qs = gen(7)
+    tree = kt.build_morton(pts)
+    d2, _ = kt.morton_knn(tree, qs, k=1)
+    bf, _ = kt.bruteforce.knn_exact_d2(pts, qs, k=1)
+    ok = np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0], rtol=1e-5)
+    print("oracle check:", "OK" if ok else "FAIL", flush=True)
+
+    # query throughput: 1M queries k=16
+    qbig = kt.generate_problem(seed=11, dim=dim, num_points=1 << 20, num_queries=1)[0]
+
+    def qchain(seed):
+        return kt.morton_knn(tree, qbig + seed * 0.001, k=16)[0]
+
+    t = timeit("1M queries k=16 (morton)", qchain)
+    print(f"query throughput: {(1 << 20) / t / 1e6:.2f}M q/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Morton query-throughput probe with conservative chunking (one-off)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import kdtree_tpu as kt
+
+
+def sync(out):
+    jax.tree.map(lambda x: np.asarray(x.ravel()[:4]) if hasattr(x, "shape") else x, out)
+
+
+def main():
+    n, dim = 1 << 24, 3
+    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    nq = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 18
+    pts, _ = kt.generate_problem(seed=7, dim=dim, num_points=n, num_queries=1)
+    tree = kt.build_morton(pts, bucket_cap=128)
+    qs = kt.generate_problem(seed=11, dim=dim, num_points=nq, num_queries=1)[0]
+    sync(kt.morton_knn(tree, qs, k=16, chunk=chunk)[0])
+    ts = []
+    for i in (1, 2):
+        t0 = time.perf_counter()
+        sync(kt.morton_knn(tree, qs + 0.001 * i, k=16, chunk=chunk)[0])
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    print(f"chunk={chunk} nq={nq}: {t:.3f}s = {nq / t / 1e6:.2f}M q/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Morton bucket tree: exactness vs the brute-force oracle (SURVEY.md §4
+test plan item 1 — the oracle is the only trustworthy reference, §3.5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_morton, generate_problem, morton_knn
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.morton import morton_codes
+
+
+@pytest.mark.parametrize(
+    "n,d,k,cap",
+    [
+        (100, 3, 1, 8),
+        (1000, 3, 16, 16),
+        (2048, 3, 4, 128),
+        (777, 5, 3, 32),
+        (50, 2, 1, 128),
+        (4096, 3, 1, 128),
+        (1000, 8, 4, 64),
+        (333, 1, 2, 16),
+    ],
+)
+def test_morton_knn_matches_bruteforce(n, d, k, cap):
+    pts, qs = generate_problem(seed=n * 31 + d, dim=d, num_points=n, num_queries=10)
+    tree = build_morton(pts, bucket_cap=cap)
+    d2, idx = morton_knn(tree, qs, k=k)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+    # returned indices must reproduce the returned distances
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - np.asarray(pts)[np.asarray(idx)]) ** 2, axis=-1
+    )
+    np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-6)
+    assert (np.asarray(idx) >= 0).all()
+
+
+def test_single_bucket_tree():
+    pts, qs = generate_problem(seed=9, dim=3, num_points=50, num_queries=5)
+    tree = build_morton(pts, bucket_cap=128)
+    assert tree.num_buckets == 1 and tree.num_levels == 0
+    d2, _ = morton_knn(tree, qs, k=2)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=2)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+
+
+def test_duplicate_points():
+    pts = jnp.zeros((300, 3), jnp.float32)
+    tree = build_morton(pts, bucket_cap=64)
+    d2, idx = morton_knn(tree, jnp.ones((2, 3)), k=4)
+    np.testing.assert_allclose(np.asarray(d2), 3.0, rtol=1e-6)
+    assert (np.asarray(idx) >= 0).all()
+
+
+def test_clustered_points_stay_exact():
+    """Morton quantization must not break exactness on skewed distributions
+    (the load-imbalance axis the course graded, Utility.cpp:98-99)."""
+    rng = np.random.default_rng(0)
+    centers = rng.uniform(-100, 100, (4, 3))
+    pts = jnp.asarray(
+        (centers[rng.integers(0, 4, 3000)] + rng.normal(0, 0.01, (3000, 3))).astype(
+            np.float32
+        )
+    )
+    qs = jnp.asarray(rng.uniform(-100, 100, (10, 3)).astype(np.float32))
+    tree = build_morton(pts, bucket_cap=32)
+    d2, _ = morton_knn(tree, qs, k=8)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=8)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+
+
+def test_k_larger_than_n():
+    pts, qs = generate_problem(seed=3, dim=3, num_points=10, num_queries=3)
+    d2, idx = morton_knn(build_morton(pts, bucket_cap=4), qs, k=50)
+    assert d2.shape == (3, 10)  # clamped to n
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=10)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+
+
+def test_morton_codes_locality():
+    """Codes must be monotone per axis cell and interleave all axes."""
+    pts = jnp.asarray(
+        np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0], [1.0, 1.0]], np.float32)
+    )
+    codes = np.asarray(morton_codes(pts, bits=1))
+    assert sorted(codes.tolist()) == [0, 1, 2, 3]
+    assert codes[0] == 0 and codes[3] == 3
+
+
+def test_non_pow2_and_tiny():
+    for n in (1, 2, 3, 129, 1025):
+        pts, qs = generate_problem(seed=n, dim=3, num_points=n, num_queries=4)
+        d2, _ = morton_knn(build_morton(pts, bucket_cap=128), qs, k=1)
+        bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
+        np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)

@@ -87,7 +87,7 @@ def test_argv_mode_small():
 def test_argv_mode_engines_agree():
     """All engines are exact, so the protocol output is engine-independent."""
     outs = []
-    for engine in ("tree", "bucket", "bruteforce", "ensemble", "global"):
+    for engine in ("tree", "bucket", "morton", "bruteforce", "ensemble", "global"):
         # threefry generator: engine agreement must hold without a toolchain
         res = _run_cli(["--generator", "threefry", "--engine", engine,
                         "harness", "3", "3", "500"])
@@ -123,7 +123,7 @@ def test_malformed_spec():
     assert "Traceback" not in res.stderr
 
 
-@pytest.mark.parametrize("engine", ["tree", "bucket", "global"])
+@pytest.mark.parametrize("engine", ["tree", "bucket", "morton", "global"])
 def test_build_query_roundtrip(tmp_path, engine):
     """build saves provenance; query replays it regardless of --seed —
     for every checkpointable engine (mirrors the reference's per-mode run
