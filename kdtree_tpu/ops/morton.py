@@ -117,14 +117,15 @@ def morton_codes(points: jax.Array, bits: int) -> jax.Array:
     the full code range.
     """
     n, d = points.shape
-    lo = jnp.min(points, axis=0)
-    hi = jnp.max(points, axis=0)
+    finite = jnp.isfinite(points)
+    lo = jnp.min(jnp.where(finite, points, jnp.inf), axis=0)
+    hi = jnp.max(jnp.where(finite, points, -jnp.inf), axis=0)
     scale = jnp.where(hi > lo, (hi - lo), jnp.float32(1))
-    cells = jnp.clip(
-        ((points - lo) / scale * (1 << bits)).astype(jnp.uint32),
-        0,
-        (1 << bits) - 1,
-    )
+    t = (points - lo) / scale * (1 << bits)
+    # +inf padding rows (sharded callers pad blocks with inf sentinels) land
+    # in the top cell so they sort to the end; NaN-safe via the finite test
+    t = jnp.where(jnp.all(finite, axis=1)[:, None], t, jnp.float32(1 << bits))
+    cells = jnp.clip(t.astype(jnp.uint32), 0, (1 << bits) - 1)
     code = jnp.zeros(n, jnp.uint32)
     for b in range(bits):  # static unroll: bits*d or-shift ops
         for a in range(d):

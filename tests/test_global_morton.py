@@ -1,0 +1,74 @@
+"""Global Morton forest (sample-sort all_to_all partition) on the virtual
+8-device CPU mesh — the --oversubscribe analog (SURVEY.md §4 item 4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+from kdtree_tpu.parallel.global_morton import global_morton_knn
+from kdtree_tpu.parallel.mesh import make_mesh
+
+
+def _oracle(seed, dim, n, nq, k):
+    pts = generate_points_rowwise(seed, dim, n)
+    qs = generate_queries(seed + 7777, dim, nq)
+    bf_d2, bf_i = bruteforce.knn_exact_d2(pts, qs, k=k)
+    return pts, qs, bf_d2, bf_i
+
+
+@pytest.mark.parametrize("p", [1, 2, 4, 8])
+@pytest.mark.parametrize("n,dim,k", [(2048, 3, 4), (1000, 2, 1)])
+def test_matches_bruteforce_any_device_count(p, n, dim, k):
+    pts, qs, bf_d2, _ = _oracle(31, dim, n, 8, k)
+    d2, gi = global_morton_knn(31, dim, n, qs, k=k, mesh=make_mesh(p))
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    # ids must reproduce the distances against the independently generated set
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - np.asarray(pts)[np.asarray(gi)]) ** 2, axis=-1
+    )
+    np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-5)
+
+
+def test_device_count_invariance():
+    """Same seed => same answers on 1, 2, 4, 8 devices (the determinism
+    guarantee the reference gets from its discard trick)."""
+    qs = generate_queries(99, 3, 6)
+    outs = [
+        np.asarray(global_morton_knn(5, 3, 1500, qs, k=3, mesh=make_mesh(p))[0])
+        for p in (1, 2, 4, 8)
+    ]
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], rtol=1e-6)
+
+
+def test_non_divisible_n():
+    """N not divisible by P: past-N rows must never contaminate answers."""
+    n, dim, k = 1037, 3, 5
+    pts, qs, bf_d2, _ = _oracle(13, dim, n, 8, k)
+    d2, gi = global_morton_knn(13, dim, n, qs, k=k, mesh=make_mesh(8))
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    assert int(np.asarray(gi).max()) < n
+
+
+def test_clustered_load_imbalance():
+    """Sample-sort splitters must keep clustered data balanced enough to fit
+    the slack capacity (the course's grading dimension, Utility.cpp:98-99).
+    The threefry uniform stream isn't clustered, so instead verify overflow
+    handling directly: tiny slack must raise, not silently drop points."""
+    qs = generate_queries(1, 3, 4)
+    with pytest.raises(RuntimeError, match="overflow"):
+        global_morton_knn(1, 3, 4096, qs, k=1, mesh=make_mesh(8), slack=0.05)
+
+
+def test_scale_512k_over_8_devices():
+    """VERDICT r1 item 10: a >=512k-point global build over 8 virtual devices
+    with nontrivial per-device work (64k rows/device)."""
+    n, dim, k = 1 << 19, 3, 4
+    qs = generate_queries(123, dim, 16)
+    d2, gi = global_morton_knn(77, dim, n, qs, k=k, mesh=make_mesh(8))
+    # oracle on the materialized problem (host-side, one-off)
+    pts = generate_points_rowwise(77, dim, n)
+    bf_d2, _ = bruteforce.knn(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)

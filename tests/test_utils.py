@@ -1,4 +1,5 @@
 import numpy as np
+import pytest
 
 from kdtree_tpu import build_jit, generate_problem, knn
 from kdtree_tpu.utils.checkpoint import load_tree, save_tree
@@ -78,4 +79,17 @@ def test_graft_entry():
     fn, args = ge.entry()
     d2, idx = jax.jit(fn)(*args)
     assert d2.shape == (64, 16)
+    # small scale keeps the default suite fast; the driver (and the slow
+    # marker below) run the full 1M-per-device default
+    ge.dryrun_multichip(8, points_per_device=1 << 14)
+
+
+@pytest.mark.slow
+def test_graft_entry_full_scale():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    import __graft_entry__ as ge
+
     ge.dryrun_multichip(8)
