@@ -109,23 +109,38 @@ class MortonTree:
         )
 
 
-def morton_codes(points: jax.Array, bits: int) -> jax.Array:
+def morton_codes(
+    points: jax.Array, bits: int, lo: jax.Array | None = None,
+    hi: jax.Array | None = None,
+) -> jax.Array:
     """u32 Morton (Z-order) codes; ``bits`` quantization bits per axis.
 
-    Normalization uses the data's own per-axis min/max so clustered inputs
-    (the 128-D grading generator's Gaussian blobs analog) still spread over
-    the full code range.
+    Normalization defaults to the data's own per-axis min/max so clustered
+    inputs (the 128-D grading generator's Gaussian blobs analog) still spread
+    over the full code range. Pass explicit ``lo``/``hi`` (broadcastable to
+    [D]) when several devices must quantize on the SAME grid — e.g. the
+    sample-sort splitters of the global Morton engine, where codes from
+    different devices are compared against shared splitters.
     """
     n, d = points.shape
     finite = jnp.isfinite(points)
-    lo = jnp.min(jnp.where(finite, points, jnp.inf), axis=0)
-    hi = jnp.max(jnp.where(finite, points, -jnp.inf), axis=0)
+    if lo is None:
+        lo = jnp.min(jnp.where(finite, points, jnp.inf), axis=0)
+    else:
+        lo = jnp.broadcast_to(jnp.asarray(lo, points.dtype), (d,))
+    if hi is None:
+        hi = jnp.max(jnp.where(finite, points, -jnp.inf), axis=0)
+    else:
+        hi = jnp.broadcast_to(jnp.asarray(hi, points.dtype), (d,))
     scale = jnp.where(hi > lo, (hi - lo), jnp.float32(1))
     t = (points - lo) / scale * (1 << bits)
     # +inf padding rows (sharded callers pad blocks with inf sentinels) land
     # in the top cell so they sort to the end; NaN-safe via the finite test
     t = jnp.where(jnp.all(finite, axis=1)[:, None], t, jnp.float32(1 << bits))
-    cells = jnp.clip(t.astype(jnp.uint32), 0, (1 << bits) - 1)
+    # clip BEFORE the cast: float->uint32 of out-of-range values (possible
+    # when an explicit lo/hi grid is narrower than the data) is
+    # implementation-defined in XLA, so clamp while still in float
+    cells = jnp.clip(t, 0.0, float((1 << bits) - 1)).astype(jnp.uint32)
     code = jnp.zeros(n, jnp.uint32)
     for b in range(bits):  # static unroll: bits*d or-shift ops
         for a in range(d):

@@ -104,19 +104,24 @@ def _partition_exchange(pts, gid, code, p: int, cap: int, axis_name: str):
     gid_s = gid[order]
     code_s = code[order]
 
-    # slot each row into its destination's fixed-capacity slice
+    # slot each row into its destination's fixed-capacity slice; padding rows
+    # (gid -1, e.g. the pre-masked past-N phantoms) are droppable — receivers
+    # already pad with inf/-1, so losing one is harmless and NOT an overflow
     rank_in_dest = jnp.arange(ln) - jnp.searchsorted(dest_s, dest_s, side="left")
-    overflow = jnp.sum((rank_in_dest >= cap).astype(jnp.int32))
-    slot = dest_s * cap + jnp.clip(rank_in_dest, 0, cap - 1)
-    ok = rank_in_dest < cap
+    real = gid_s >= 0
+    overflow = jnp.sum(((rank_in_dest >= cap) & real).astype(jnp.int32))
+    slot = dest_s * cap + rank_in_dest
+    ok = (rank_in_dest < cap) & real
 
     send_pts = jnp.full((p * cap, d), jnp.inf, pts.dtype)
     send_gid = jnp.full((p * cap,), -1, jnp.int32)
     send_code = jnp.zeros((p * cap,), code.dtype)
-    slot_ok = jnp.where(ok, slot, p * cap - 1)  # overflow rows dropped below
-    send_pts = send_pts.at[slot_ok].set(jnp.where(ok[:, None], pts_s, jnp.inf))
-    send_gid = send_gid.at[slot_ok].set(jnp.where(ok, gid_s, -1))
-    send_code = send_code.at[slot_ok].set(jnp.where(ok, code_s, 0))
+    # out-of-range index + mode="drop": dropped rows write nowhere instead of
+    # clobbering the last real slot
+    slot_ok = jnp.where(ok, slot, p * cap)
+    send_pts = send_pts.at[slot_ok].set(pts_s, mode="drop")
+    send_gid = send_gid.at[slot_ok].set(gid_s, mode="drop")
+    send_code = send_code.at[slot_ok].set(code_s, mode="drop")
 
     # one all_to_all each for coords / ids / codes
     recv_pts = lax.all_to_all(
@@ -141,13 +146,23 @@ def _partition_exchange(pts, gid, code, p: int, cap: int, axis_name: str):
 
 
 def _global_morton_local(
-    start, queries, *, seed: int, dim: int, rows: int, k: int, p: int, cap: int,
-    bucket_cap: int, bits: int, axis_name: str,
+    start, queries, *, seed: int, dim: int, rows: int, num_points: int, k: int,
+    p: int, cap: int, bucket_cap: int, bits: int, axis_name: str,
 ):
     """Per-device SPMD body: generate own rows -> exchange -> build -> query."""
     pts = _shard_points_fold(seed, dim, start[0], rows)
     gid = (start[0] + jnp.arange(rows)).astype(jnp.int32)
-    code = morton_codes(pts, bits)
+    # ceil-padding rows past num_points are PHANTOMS — real uniform draws that
+    # must never compete in k-NN. Mask them to the standard padding encoding
+    # (+inf coords, gid -1) BEFORE the exchange: morton_codes sends non-finite
+    # rows to the top cell, the pad_key sort pushes gid<0 rows to the end, and
+    # leaf scans see inf distances — the whole existing padding path applies.
+    valid = gid < num_points
+    pts = jnp.where(valid[:, None], pts, jnp.inf)
+    gid = jnp.where(valid, gid, -1)
+    # fixed quantization grid (the known generator domain) so every device's
+    # codes are comparable against the shared all_gathered splitters
+    code = morton_codes(pts, bits, lo=COORD_MIN, hi=COORD_MAX)
     pts, gid, overflow = _partition_exchange(pts, gid, code, p, cap, axis_name)
 
     tree = build_morton_impl(pts, bucket_cap=bucket_cap, bits=bits)
@@ -171,17 +186,18 @@ def _global_morton_local(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "seed", "dim", "rows", "k", "cap", "bucket_cap", "bits"
+        "mesh", "seed", "dim", "rows", "num_points", "k", "cap", "bucket_cap",
+        "bits",
     ),
 )
-def _global_morton_jit(starts, queries, mesh, seed, dim, rows, k, cap,
-                       bucket_cap, bits):
+def _global_morton_jit(starts, queries, mesh, seed, dim, rows, num_points, k,
+                       cap, bucket_cap, bits):
     p = mesh.shape[SHARD_AXIS]
     fn = jax.shard_map(
         functools.partial(
             _global_morton_local,
-            seed=seed, dim=dim, rows=rows, k=k, p=p, cap=cap,
-            bucket_cap=bucket_cap, bits=bits, axis_name=SHARD_AXIS,
+            seed=seed, dim=dim, rows=rows, num_points=num_points, k=k, p=p,
+            cap=cap, bucket_cap=bucket_cap, bits=bits, axis_name=SHARD_AXIS,
         ),
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(None, None)),
@@ -218,23 +234,20 @@ def global_morton_knn(
 
         mesh = make_mesh()
     p = mesh.shape[SHARD_AXIS]
-    rows = -(-num_points // p)  # ceil; the last shard generates past-N rows
-    # past-N rows are generated then marked padding by gid >= num_points
+    rows = -(-num_points // p)  # ceil; the last shard generates past-N rows,
+    # which _global_morton_local masks to padding BEFORE the exchange
     # (cheaper than ragged shards; the fold_in stream is defined for any row)
     bits = max(1, min(32 // max(dim, 1), 16))
     cap = max(1, int(rows / p * slack))
     k = min(k, num_points)
     starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
     d2, gi, overflow = _global_morton_jit(
-        starts, queries, mesh, seed, dim, rows, k, cap, bucket_cap, bits
+        starts, queries, mesh, seed, dim, rows, num_points, k, cap, bucket_cap,
+        bits,
     )
     if int(overflow[0]) > 0:
         raise RuntimeError(
             f"sample-sort capacity overflow ({int(overflow[0])} rows); "
             f"retry with slack > {slack}"
         )
-    # drop any past-N padding that slipped into the k-buffer (possible only
-    # when k is within p*bucket rounding of num_points)
-    d2 = jnp.where(gi < num_points, d2, jnp.inf)
-    gi = jnp.where(gi < num_points, gi, -1)
     return d2, gi

@@ -6,7 +6,11 @@ import numpy as np
 import pytest
 
 from kdtree_tpu.ops import bruteforce
-from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+from kdtree_tpu.ops.generate import (
+    generate_points_rowwise,
+    generate_points_shard,
+    generate_queries,
+)
 from kdtree_tpu.parallel.global_morton import global_morton_knn
 from kdtree_tpu.parallel.mesh import make_mesh
 
@@ -19,7 +23,7 @@ def _oracle(seed, dim, n, nq, k):
 
 
 @pytest.mark.parametrize("p", [1, 2, 4, 8])
-@pytest.mark.parametrize("n,dim,k", [(2048, 3, 4), (1000, 2, 1)])
+@pytest.mark.parametrize("n,dim,k", [(2048, 3, 4), (1000, 2, 1), (1037, 3, 3)])
 def test_matches_bruteforce_any_device_count(p, n, dim, k):
     pts, qs, bf_d2, _ = _oracle(31, dim, n, 8, k)
     d2, gi = global_morton_knn(31, dim, n, qs, k=k, mesh=make_mesh(p))
@@ -43,13 +47,34 @@ def test_device_count_invariance():
         np.testing.assert_allclose(o, outs[0], rtol=1e-6)
 
 
-def test_non_divisible_n():
+@pytest.mark.parametrize("seed", [0, 13, 31])
+def test_non_divisible_n(seed):
     """N not divisible by P: past-N rows must never contaminate answers."""
     n, dim, k = 1037, 3, 5
-    pts, qs, bf_d2, _ = _oracle(13, dim, n, 8, k)
-    d2, gi = global_morton_knn(13, dim, n, qs, k=k, mesh=make_mesh(8))
+    pts, qs, bf_d2, _ = _oracle(seed, dim, n, 8, k)
+    d2, gi = global_morton_knn(seed, dim, n, qs, k=k, mesh=make_mesh(8))
     np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
     assert int(np.asarray(gi).max()) < n
+
+
+@pytest.mark.parametrize("seed", [0, 13])
+def test_phantom_rows_adversarial(seed):
+    """Round-2 judge/advisor repro: queries placed EXACTLY at the phantom
+    rows' coordinates (rows [n, p*rows) that the ceil-padding shard generates
+    past num_points). With the pre-fix code a phantom wins the k-buffer at
+    distance 0 and the post-hoc filter turns it into (inf, -1), evicting a
+    true neighbor; the fixed code must match the brute-force oracle over the
+    first n rows exactly."""
+    n, dim, k, p = 1037, 3, 5, 8
+    rows = -(-n // p)  # 130 -> 3 phantom rows 1037..1039
+    phantom = generate_points_shard(seed, dim, n, p * rows - n)
+    pts = generate_points_rowwise(seed, dim, n)
+    bf_d2, bf_i = bruteforce.knn_exact_d2(pts, phantom, k=k)
+    d2, gi = global_morton_knn(seed, dim, n, phantom, k=k, mesh=make_mesh(p))
+    assert np.all(np.isfinite(np.asarray(d2))), "phantom row leaked as inf"
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    assert int(np.asarray(gi).max()) < n
+    assert int(np.asarray(gi).min()) >= 0
 
 
 def test_clustered_load_imbalance():
@@ -72,3 +97,14 @@ def test_scale_512k_over_8_devices():
     pts = generate_points_rowwise(77, dim, n)
     bf_d2, _ = bruteforce.knn(pts, qs, k=k)
     np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+
+
+def test_tiny_non_divisible_n_no_spurious_overflow():
+    """Masked phantom rows must not count toward sample-sort overflow: n=9 on
+    8 devices generates 7 phantoms that all carry the top Morton code, and
+    dropping a padding row is harmless (receivers pad with inf/-1 anyway)."""
+    n, dim, k = 9, 3, 2
+    pts, qs, bf_d2, _ = _oracle(0, dim, n, 4, k)
+    d2, gi = global_morton_knn(0, dim, n, qs, k=k, mesh=make_mesh(8), slack=8.0)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    assert int(np.asarray(gi).max()) < n

@@ -95,3 +95,14 @@ def test_non_pow2_and_tiny():
         d2, _ = morton_knn(build_morton(pts, bucket_cap=128), qs, k=1)
         bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
         np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-6)
+
+
+def test_morton_codes_explicit_grid_out_of_range():
+    """Points outside an explicit lo/hi grid must clamp to the edge cells
+    (float->uint32 of out-of-range values is implementation-defined in XLA,
+    so the clip has to happen float-side)."""
+    pts = jnp.asarray([[-150.0], [-100.0], [0.0], [100.0], [250.0]])
+    codes = np.asarray(morton_codes(pts, bits=8, lo=-100.0, hi=100.0))
+    assert codes[0] == codes[1] == 0  # below-grid clamps to cell 0
+    assert codes[4] == (1 << 8) - 1  # above-grid clamps to the top cell
+    assert codes[0] <= codes[2] <= codes[4]
