@@ -1,0 +1,107 @@
+"""Hilbert-curve codes: the jump-free space-filling order.
+
+Morton (Z-order) codes are cheap but discontinuous — the Z-curve teleports
+across the domain at high-bit boundaries, so a window of consecutive codes
+can span almost the whole space. That breaks the tiled query engine
+(:mod:`kdtree_tpu.ops.tile_query`), whose whole premise is "consecutive
+sorted queries are spatial neighbors": a tile straddling a Z-jump gets a
+domain-sized AABB and has to scan every bucket (measured: p99 candidate
+count 2051 vs median 76 on uniform data).
+
+The Hilbert curve has no jumps: consecutive cells along the curve are
+always face-adjacent, so ANY contiguous window of the sorted order is a
+connected region with diameter ~ (window/total)^(1/D). Encoding uses
+Skilling's transpose algorithm (public domain, Skilling 2004 "Programming
+the Hilbert curve"): per-axis cell coordinates are transformed in place by
+``bits`` rounds of conditional exchange/invert against axis 0, then
+Gray-decoded — all u32 bit ops, vectorized over N points, statically
+unrolled over ``bits * D`` rounds (no data-dependent control flow).
+
+The curve property is pinned by tests: enumerating every cell of a small
+grid and sorting by code must walk cells with L1 steps of exactly 1
+(``tests/test_hilbert.py``) — a convention-independent correctness oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _quantize(points: jax.Array, bits: int, lo, hi) -> list[jax.Array]:
+    """Per-axis u32 cell coords in [0, 2^bits); same conventions as
+    :func:`kdtree_tpu.ops.morton.morton_codes` (data-derived bounds by
+    default, non-finite rows to the top cell, float-side clip)."""
+    n, d = points.shape
+    finite = jnp.isfinite(points)
+    if lo is None:
+        lo = jnp.min(jnp.where(finite, points, jnp.inf), axis=0)
+    else:
+        lo = jnp.broadcast_to(jnp.asarray(lo, points.dtype), (d,))
+    if hi is None:
+        hi = jnp.max(jnp.where(finite, points, -jnp.inf), axis=0)
+    else:
+        hi = jnp.broadcast_to(jnp.asarray(hi, points.dtype), (d,))
+    scale = jnp.where(hi > lo, (hi - lo), jnp.asarray(1, points.dtype))
+    t = (points - lo) / scale * (1 << bits)
+    t = jnp.where(jnp.all(finite, axis=1)[:, None], t, jnp.float32(1 << bits))
+    cells = jnp.clip(t, 0.0, float((1 << bits) - 1)).astype(jnp.uint32)
+    return [cells[:, a] for a in range(d)]
+
+
+def hilbert_codes(
+    points: jax.Array,
+    bits: int,
+    lo: jax.Array | None = None,
+    hi: jax.Array | None = None,
+) -> jax.Array:
+    """u32 Hilbert indices; ``bits`` quantization bits per axis.
+
+    Requires ``bits * D <= 32`` (callers clamp bits the same way the Morton
+    path does). Higher code = later on the curve; consecutive codes are
+    face-adjacent cells.
+    """
+    n, d = points.shape
+    if bits * d > 32:
+        # order by the leading axes only (same graceful degradation as
+        # morton_codes for D > 32: ordering quality drops, correctness of
+        # consumers never depends on WHICH order, only that one exists)
+        d = max(32 // max(bits, 1), 1)
+        points = points[:, :d]
+    x = _quantize(points, bits, lo, hi)
+
+    if d == 1:
+        return x[0]
+
+    # Skilling: axes -> transposed Hilbert (in place, MSB down)
+    q = 1 << (bits - 1)
+    while q > 1:
+        p = jnp.uint32(q - 1)
+        for i in range(d):
+            high = (x[i] & q) != 0
+            # invert low bits of x[0]      OR exchange low bits x[0]<->x[i]
+            t = (x[0] ^ x[i]) & p
+            x0_inv = x[0] ^ p
+            x[0] = jnp.where(high, x0_inv, x[0] ^ t)
+            if i:
+                x[i] = jnp.where(high, x[i], x[i] ^ t)
+        q >>= 1
+
+    # Gray decode
+    for i in range(1, d):
+        x[i] = x[i] ^ x[i - 1]
+    t = jnp.zeros(n, jnp.uint32)
+    q = 1 << (bits - 1)
+    while q > 1:
+        t = jnp.where((x[d - 1] & q) != 0, t ^ jnp.uint32(q - 1), t)
+        q >>= 1
+    for i in range(d):
+        x[i] = x[i] ^ t
+
+    # interleave transposed bits: index bit (b*D-1) is bit (bits-1) of x[0]
+    code = jnp.zeros(n, jnp.uint32)
+    for b in range(bits):
+        for i in range(d):
+            pos = (bits - 1 - b) * d + (d - 1 - i)
+            code = code | (((x[i] >> (bits - 1 - b)) & 1) << pos)
+    return code

@@ -329,13 +329,36 @@ def _morton_knn_batch(tree, queries, k: int, chunk: int):
 
 
 def morton_knn(
-    tree: MortonTree, queries: jax.Array, k: int = 1, chunk: int = 16384
+    tree: MortonTree, queries: jax.Array, k: int = 1, chunk: int = 4096
 ) -> Tuple[jax.Array, jax.Array]:
-    """Exact k-NN against a Morton bucket tree.
+    """Exact k-NN against a Morton bucket tree (per-query best-first DFS).
 
-    Returns (dists_sq f32[Q, k], indices i32[Q, k]) ascending. Large query
-    batches run in fixed-size chunks under a scan (bounded memory, local
-    lockstep divergence — same rationale as bucket_knn).
+    Returns (dists_sq f32[Q, k], indices i32[Q, k]) ascending. Queries run
+    in fixed-size chunks, one device program per chunk: bounded memory,
+    local lockstep divergence, and no single program long enough to trip
+    an execution watchdog. For large Q prefer
+    :func:`kdtree_tpu.ops.tile_query.morton_knn_tiled` (dense, orders of
+    magnitude faster at scale); this DFS engine wins for small/sparse
+    batches.
     """
     k = min(k, tree.n_real)
-    return _morton_knn_batch(tree, queries, k, min(chunk, max(queries.shape[0], 1)))
+    q = queries.shape[0]
+    chunk = min(chunk, max(q, 1))
+    if q <= chunk:
+        return _morton_knn_batch(tree, queries, k, chunk)
+    # pad to a chunk multiple so every slice reuses ONE compiled program
+    # (a ragged tail shape would recompile the whole DFS kernel)
+    pad = (-q) % chunk
+    if pad:
+        queries = jnp.concatenate(
+            [queries, jnp.broadcast_to(queries[-1], (pad, queries.shape[1]))],
+            axis=0,
+        )
+    parts = [
+        _morton_knn_batch(tree, queries[i : i + chunk], k, chunk)
+        for i in range(0, queries.shape[0], chunk)
+    ]
+    return (
+        jnp.concatenate([p[0] for p in parts], axis=0)[:q],
+        jnp.concatenate([p[1] for p in parts], axis=0)[:q],
+    )

@@ -39,6 +39,13 @@ def save_tree(path: str, tree, meta: dict | None = None) -> None:
     children, aux = type(tree).tree_flatten(tree)
     payload = {f"child_{i}": np.asarray(c) for i, c in enumerate(children)}
     if aux is not None:
+        # the format stores aux as a flat i64 vector; anything richer (nested
+        # tuples, dtypes, strings) must fail HERE, not corrupt a later load
+        if not all(isinstance(a, (int, np.integer)) for a in aux):
+            raise TypeError(
+                f"{type(tree).__name__}.tree_flatten aux must be a flat tuple "
+                f"of ints for checkpointing, got {aux!r}"
+            )
         payload["aux"] = np.asarray(aux, dtype=np.int64)
     payload["kind"] = np.asarray(kind)
     payload.update({f"meta_{k}": np.asarray(v) for k, v in (meta or {}).items()})
@@ -63,10 +70,13 @@ def load_tree(path: str):
                 node_point=jnp.asarray(z["node_point"]),
                 split_val=jnp.asarray(z["split_val"]),
             )
-            return tree, meta
-        cls = _registry()[str(z["kind"])]
-        nchild = sum(1 for k in z.files if k.startswith("child_"))
-        children = tuple(jnp.asarray(z[f"child_{i}"]) for i in range(nchild))
-        aux = tuple(int(a) for a in z["aux"]) if "aux" in z.files else None
-        tree = cls.tree_unflatten(aux, children)
+        else:
+            cls = _registry()[str(z["kind"])]
+            nchild = sum(1 for k in z.files if k.startswith("child_"))
+            children = tuple(jnp.asarray(z[f"child_{i}"]) for i in range(nchild))
+            aux = tuple(int(a) for a in z["aux"]) if "aux" in z.files else None
+            tree = cls.tree_unflatten(aux, children)
+    from kdtree_tpu.utils.guards import validate_loaded_tree
+
+    validate_loaded_tree(tree)  # NaN in a checkpoint = corruption, fail here
     return tree, meta

@@ -1,0 +1,73 @@
+"""Input guards: fail loudly at the edges instead of silently mis-sorting.
+
+The framework's sorts and reductions treat +inf as PADDING by design
+(SURVEY.md §5 race/sanitizer plan) — but a NaN coordinate is never
+meaningful: NaN poisons Morton quantization (every comparison false), so a
+poisoned point lands in an arbitrary bucket and silently corrupts k-NN
+answers near it. The reference has no guards at all (``Utility.cpp`` exits
+only on bad argv); here every load/ingest boundary calls
+:func:`assert_no_nan`, and :func:`checked_build_morton` offers a
+checkify-instrumented build for debugging numeric corruption that appears
+mid-pipeline rather than at the edges.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def assert_no_nan(arr: jax.Array, name: str = "points") -> jax.Array:
+    """Raise ValueError if ``arr`` contains NaN (host-synced, edge use only).
+
+    +inf is allowed — it is the framework-wide padding sentinel; NaN never
+    is. Returns the array so call sites can stay expression-shaped.
+    """
+    if bool(jnp.any(jnp.isnan(arr))):
+        raise ValueError(
+            f"{name} contains NaN coordinates; refusing to build/query — "
+            "NaN breaks Morton quantization silently (every comparison is "
+            "false). Clean the input or drop the offending rows."
+        )
+    return arr
+
+
+def checked_build_morton(points: jax.Array, **kw):
+    """Debug entry point: the Morton build under ``checkify`` float checks.
+
+    Returns (error, tree); ``error.throw()`` raises with the location of the
+    first NaN produced anywhere INSIDE the traced build — for corruption
+    that appears mid-pipeline, where the edge guard can't see it. Not for
+    production paths (checkify instruments every float op).
+    """
+    from jax.experimental import checkify
+
+    from kdtree_tpu.ops.morton import build_morton_impl
+
+    n, d = points.shape
+    bits = kw.pop("bits", None) or max(1, min(32 // max(d, 1), 16))
+    bucket_cap = kw.pop("bucket_cap", 128)
+    # padding +inf rows are deliberate; limit to NaN checks
+    checked = checkify.checkify(
+        lambda p: build_morton_impl(p, bucket_cap=bucket_cap, bits=bits),
+        errors=checkify.nan_checks,
+    )
+    return checked(points)
+
+
+def validate_loaded_tree(tree) -> None:
+    """Checkpoint-load guard: NaN anywhere in a tree's arrays is corruption
+    (inf is legal padding in bucket/box arrays)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            if bool(jnp.any(jnp.isnan(leaf))):
+                raise ValueError(
+                    f"loaded tree contains NaN in a {leaf.shape} array — "
+                    "checkpoint is corrupt"
+                )
+
+
+def has_nan(arr) -> bool:
+    """Host-side NaN probe for numpy/jax arrays (no exception)."""
+    return bool(np.any(np.isnan(np.asarray(arr))))

@@ -50,3 +50,38 @@ def test_build_with_duplicate_points():
     pts = jnp.concatenate([base, 2.0 * base, base], axis=0)
     tree = build_jit(pts)
     validate_invariants(tree)
+
+
+def test_validator_rejects_corruption():
+    """A validator that cannot fail proves nothing: corrupt one split value
+    and one permutation slot and expect loud failure."""
+    from kdtree_tpu.models.tree import KDTree
+
+    pts, _ = generate_problem(seed=11, dim=3, num_points=500)
+    tree = build_jit(pts)
+    sval = np.asarray(tree.split_val).copy()
+    root_axis_vals = np.asarray(tree.points)[:, 0]
+    sval[0] = root_axis_vals.min() - 1.0  # root split below every left point
+    bad = KDTree(tree.points, tree.node_point, jnp.asarray(sval))
+    with pytest.raises(AssertionError):
+        validate_invariants(bad)
+
+    npnt = np.asarray(tree.node_point).copy()
+    npnt[1] = npnt[2]  # duplicate a point id -> not a permutation
+    bad = KDTree(tree.points, jnp.asarray(npnt), tree.split_val)
+    with pytest.raises(AssertionError):
+        validate_invariants(bad)
+
+
+@pytest.mark.slow
+def test_invariants_1m_points():
+    """VERDICT r2 item 8: the vectorized validator must handle 1M points in
+    seconds (the old per-node DFS was O(heap * subtree))."""
+    import time
+
+    pts, _ = generate_problem(seed=1, dim=3, num_points=1 << 20)
+    tree = build_jit(pts)
+    np.asarray(tree.split_val)  # materialize before timing
+    t0 = time.monotonic()
+    validate_invariants(tree)
+    assert time.monotonic() - t0 < 60.0

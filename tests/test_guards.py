@@ -1,0 +1,66 @@
+"""NaN guards (SURVEY.md §5 sanitizer plan): poisoned input must fail
+loudly, never silently mis-sort."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kdtree_tpu import build_morton, generate_problem, morton_knn
+from kdtree_tpu.utils.guards import (
+    assert_no_nan,
+    checked_build_morton,
+    validate_loaded_tree,
+)
+
+
+def test_assert_no_nan_rejects():
+    pts = np.ones((10, 3), np.float32)
+    pts[3, 1] = np.nan
+    with pytest.raises(ValueError, match="NaN"):
+        assert_no_nan(jnp.asarray(pts))
+
+
+def test_assert_no_nan_allows_inf_padding():
+    pts = np.ones((10, 3), np.float32)
+    pts[9] = np.inf  # padding sentinel is legal
+    assert_no_nan(jnp.asarray(pts))
+
+
+def test_checked_build_flags_nan():
+    pts = np.asarray(generate_problem(seed=1, dim=3, num_points=300)[0]).copy()
+    pts[17, 2] = np.nan
+    err, tree = checked_build_morton(jnp.asarray(pts))
+    with pytest.raises(Exception):
+        err.throw()
+
+
+def test_checked_build_clean_passes():
+    pts, _ = generate_problem(seed=2, dim=3, num_points=300)
+    err, tree = checked_build_morton(pts)
+    err.throw()  # no error
+    d2, _ = morton_knn(tree, pts[:4], k=1)
+    np.testing.assert_allclose(np.asarray(d2)[:, 0], 0.0, atol=1e-6)
+
+
+def test_checkpoint_load_rejects_nan(tmp_path):
+    from kdtree_tpu.utils.checkpoint import load_tree, save_tree
+
+    pts, _ = generate_problem(seed=3, dim=3, num_points=300)
+    tree = build_morton(pts)
+    p = str(tmp_path / "t.npz")
+    save_tree(p, tree)
+    tree2, _ = load_tree(p)  # clean round trip
+    validate_loaded_tree(tree2)
+
+    # poison one coordinate in the payload and expect a loud failure
+    z = dict(np.load(p))
+    for key, v in z.items():
+        if v.dtype == np.float32 and v.ndim >= 2:
+            v = v.copy()
+            v.reshape(-1)[0] = np.nan
+            z[key] = v
+            break
+    np.savez_compressed(p, **z)
+    with pytest.raises(ValueError, match="corrupt"):
+        load_tree(p)

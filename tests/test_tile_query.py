@@ -1,0 +1,105 @@
+"""Tiled batched k-NN vs the brute-force oracle (SURVEY.md §4 item 1: the
+oracle is the only trustworthy reference, §3.5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_morton, generate_problem
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+
+def _check(pts, qs, k, **kw):
+    tree = build_morton(pts)
+    d2, gi = morton_knn_tiled(tree, qs, k=k, **kw)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    # ids must reproduce the distances
+    gia = np.asarray(gi)
+    finite = np.isfinite(np.asarray(d2))
+    assert np.all((gia >= 0) == finite)
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - np.asarray(pts)[np.maximum(gia, 0)]) ** 2,
+        axis=-1,
+    )
+    np.testing.assert_allclose(
+        np.where(finite, gather, np.inf), np.asarray(d2), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize(
+    "n,d,k,q", [(4096, 3, 4, 1000), (20000, 2, 16, 513), (3000, 5, 1, 64)]
+)
+def test_matches_bruteforce(n, d, k, q):
+    pts, _ = generate_problem(seed=3, dim=d, num_points=n, num_queries=10)
+    qs, _ = generate_problem(seed=99, dim=d, num_points=q, num_queries=1)
+    _check(pts, qs, k)
+
+
+def test_query_count_not_multiple_of_tile():
+    pts, _ = generate_problem(seed=1, dim=3, num_points=5000, num_queries=1)
+    qs, _ = generate_problem(seed=2, dim=3, num_points=777, num_queries=1)
+    _check(pts, qs, 3, tile=256)
+
+
+def test_small_query_batch():
+    pts, qs = generate_problem(seed=4, dim=3, num_points=8192, num_queries=10)
+    _check(pts, qs, 5)
+
+
+def test_tiny_tree_collect_all():
+    pts, qs = generate_problem(seed=5, dim=3, num_points=100, num_queries=50)
+    _check(pts, qs, 7)
+
+
+def test_k_larger_than_bucket():
+    pts, qs = generate_problem(seed=6, dim=2, num_points=4096, num_queries=100)
+    _check(pts, qs, 200)  # k > bucket_cap=128 forces a wider scan chunk
+
+
+def test_k_larger_than_n():
+    pts, qs = generate_problem(seed=7, dim=3, num_points=37, num_queries=9)
+    tree = build_morton(pts)
+    d2, gi = morton_knn_tiled(tree, qs, k=50)
+    assert d2.shape == (9, 37)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=37)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+
+
+def test_duplicate_points():
+    pts = jnp.tile(jnp.asarray([[1.0, 2.0, 3.0]]), (600, 1))
+    qs = jnp.asarray([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]])
+    tree = build_morton(pts)
+    d2, gi = morton_knn_tiled(tree, qs, k=4)
+    np.testing.assert_allclose(np.asarray(d2)[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(d2)[1], 14.0, rtol=1e-6)
+    assert len(set(np.asarray(gi)[0].tolist())) == 4  # distinct ids for dups
+
+
+def test_clustered_queries_and_points():
+    """Clustered data (the grading config's load-imbalance analog): tight
+    blobs exercise the overflow->retry growth path."""
+    rng = np.random.default_rng(0)
+    centers = rng.uniform(-80, 80, (8, 3))
+    pts = jnp.asarray(
+        (centers[rng.integers(0, 8, 30000)] + rng.normal(0, 0.5, (30000, 3))),
+        jnp.float32,
+    )
+    qs = jnp.asarray(
+        centers[rng.integers(0, 8, 500)] + rng.normal(0, 0.5, (500, 3)),
+        jnp.float32,
+    )
+    _check(pts, qs, 8)
+
+
+def test_matches_per_query_dfs():
+    """Tiled and per-query DFS engines must agree on distances (both exact)."""
+    from kdtree_tpu import morton_knn
+
+    pts, _ = generate_problem(seed=8, dim=3, num_points=10000, num_queries=1)
+    qs, _ = generate_problem(seed=9, dim=3, num_points=333, num_queries=1)
+    tree = build_morton(pts)
+    td, _ = morton_knn_tiled(tree, qs, k=6)
+    dd, _ = morton_knn(tree, qs, k=6)
+    np.testing.assert_allclose(np.asarray(td), np.asarray(dd), rtol=1e-6)
