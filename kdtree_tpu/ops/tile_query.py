@@ -89,7 +89,7 @@ def _frontier(tree: MortonTree, box_lo, box_hi, bound, cap: int):
     at some level for tile t (caller must retry with a larger cap).
 
     Returns (bucket ids i32[T, cap] lb-ascending with -1 padding,
-    overflow bool[T]).
+    their lower bounds f32[T, cap] (+inf at padding), overflow bool[T]).
     """
     T = box_lo.shape[0]
     L = tree.num_levels
@@ -123,7 +123,7 @@ def _frontier(tree: MortonTree, box_lo, box_hi, bound, cap: int):
         ids, lb = cids[:, :cap], clb[:, :cap]
 
     bucket = jnp.where(jnp.isfinite(lb), ids - first_leaf, -1)
-    return bucket, overflow
+    return bucket, lb, overflow
 
 
 def _scan_tiles(tree: MortonTree, tq, cand, k: int, v: int, tb: int):
@@ -207,8 +207,13 @@ def _sort_queries(queries, bits: int, qpad: int):
     return queries[order], order
 
 
-@functools.partial(jax.jit, static_argnames=("k", "tile", "cmax", "seeds", "v"))
-def _tiled_batch(tree, sq, k: int, tile: int, cmax: int, seeds: int, v: int):
+@functools.partial(
+    jax.jit, static_argnames=("k", "tile", "cmax", "seeds", "v", "use_pallas")
+)
+def _tiled_batch(
+    tree, sq, k: int, tile: int, cmax: int, seeds: int, v: int,
+    use_pallas: bool = False,
+):
     """Seed + collect + scan for ONE batch of sorted queries.
 
     Kept deliberately bounded (caller slices the sorted order into batches):
@@ -223,12 +228,20 @@ def _tiled_batch(tree, sq, k: int, tile: int, cmax: int, seeds: int, v: int):
 
     tb = max(1, _SCAN_ROWS // tile)  # tiles per block: bound block ROWS
     inf_bound = jnp.full(T, jnp.inf, jnp.float32)
-    seed_cand, _ = _frontier(tree, box_lo, box_hi, inf_bound, seeds)
-    sd, _ = _scan_tiles(tree, tq, seed_cand, k, v, tb)
+    seed_cand, seed_lb, _ = _frontier(tree, box_lo, box_hi, inf_bound, seeds)
+    if use_pallas:
+        from kdtree_tpu.pallas.scan_knn import scan_tiles_fused
+
+        sd, _ = scan_tiles_fused(tree, tq, seed_cand, seed_lb, k)
+    else:
+        sd, _ = _scan_tiles(tree, tq, seed_cand, k, v, tb)
     tile_bound = jnp.max(sd[..., k - 1], axis=1)  # [T]
 
-    cand, overflow = _frontier(tree, box_lo, box_hi, tile_bound, cmax)
-    fd, fi = _scan_tiles(tree, tq, cand, k, v, tb)
+    cand, cand_lb, overflow = _frontier(tree, box_lo, box_hi, tile_bound, cmax)
+    if use_pallas:
+        fd, fi = scan_tiles_fused(tree, tq, cand, cand_lb, k)
+    else:
+        fd, fi = _scan_tiles(tree, tq, cand, k, v, tb)
     q = tq.shape[0] * tile
     return fd.reshape(q, k), fi.reshape(q, k), jnp.any(overflow)
 
@@ -270,6 +283,7 @@ def morton_knn_tiled(
     tile: int | None = None,
     cmax: int = DEFAULT_CMAX,
     seeds: int = DEFAULT_SEEDS,
+    use_pallas: bool | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact batched k-NN via Hilbert-sorted query tiles and dense scans.
 
@@ -277,7 +291,9 @@ def morton_knn_tiled(
     ids i32[Q, k], ascending), built for large Q. ``tile=None`` picks the
     tile size from query/point density; ``cmax`` doubles automatically (up
     to the bucket count) when a tile's candidate set overflows — geometry-
-    driven, rare for sane tiles.
+    driven, rare for sane tiles. ``use_pallas=None`` enables the fused
+    scan kernel (:mod:`kdtree_tpu.pallas.scan_knn`) on TPU backends and
+    uses the XLA scan elsewhere.
     """
     Q, D = queries.shape
     k = min(k, tree.n_real)
@@ -300,6 +316,10 @@ def morton_knn_tiled(
     bits = max(1, min(32 // max(D, 1), 16))
     # each scan chunk must expose at least k candidate slots to lax.top_k
     v = max(_SCAN_V, -(-k // tree.bucket_size))
+    if use_pallas is None:
+        # the fused kernel is Mosaic-TPU only; GPU and CPU run the XLA scan
+        # (tests force use_pallas=True, which interprets off-TPU)
+        use_pallas = jax.default_backend() == "tpu"
 
     # batches bound each device program's runtime (watchdog) and memory;
     # the global Hilbert sort happens ONCE, so batch slices stay coherent
@@ -313,7 +333,9 @@ def morton_knn_tiled(
         sb = lax.slice_in_dim(sq, b0, b0 + qbatch, axis=0)
         bcmax = cmax
         while True:
-            bd, bi, overflow = _tiled_batch(tree, sb, k, tile, bcmax, seeds, v)
+            bd, bi, overflow = _tiled_batch(
+                tree, sb, k, tile, bcmax, seeds, v, use_pallas
+            )
             if not bool(overflow) or bcmax >= tree.num_buckets:
                 break
             bcmax = min(bcmax * 2, tree.num_buckets)

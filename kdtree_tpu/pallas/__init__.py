@@ -1,0 +1,19 @@
+"""Hand-written TPU kernels (Pallas/Mosaic) for the framework's hot loops.
+
+SURVEY.md C18: the reference's bonus tier invites SIMD/GPU kernels
+(Project_KDTree.pdf p.5 Task 5; the Makefile already compiles with -mavx,
+Makefile:2,6). The TPU equivalents live here:
+
+- :mod:`scan_knn` — the fused bucket-scan + top-k fold for the tiled query
+  engine: per-tile DMA streaming of candidate buckets with scalar early
+  exit, distances on the VPU, in-register k-extraction. Replaces the XLA
+  gather -> top_k -> sort chain, which materializes every candidate block
+  in HBM and cannot stop early.
+
+Every kernel has an XLA reference implementation and an identity test
+(same algorithm, bit-comparable results) plus the brute-force oracle.
+"""
+
+from kdtree_tpu.pallas.scan_knn import scan_tiles_fused
+
+__all__ = ["scan_tiles_fused"]

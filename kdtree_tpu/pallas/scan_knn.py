@@ -1,0 +1,211 @@
+"""Fused bucket-scan + top-k Pallas kernel for the tiled query engine.
+
+One grid step = one query tile. The kernel walks the tile's candidate
+buckets (lb-ascending, as produced by ``tile_query._frontier``) with:
+
+- **scalar early exit**: stop as soon as the next bucket's box lower bound
+  cannot beat the tile's current worst k-th distance — data-dependent
+  control flow that costs one SMEM read per step here, but is impossible
+  in the XLA formulation (static scan over all C chunks);
+- **double-buffered DMA**: bucket ``b``'s coordinates/ids stream
+  HBM -> VMEM while bucket ``b-1`` computes;
+- **VPU distance blocks**: per axis, a [TQ, B] broadcast-subtract-square
+  accumulation (coordinates are stored transposed [D, B] per bucket so the
+  block's minor dims are (TQ, B) = clean (8, 128) f32 tiles);
+- **conditional in-register fold**: a [TQ, B] block is merged into the
+  [TQ, k] best-buffer only when its row-minimum beats some query's current
+  k-th (skipped folds cost one vector min + one scalar test); the merge is
+  k unrolled min/one-hot-extract passes over a [TQ, B+k] work buffer.
+
+Exactness matches the XLA scan path: identical candidate sets, identical
+distance arithmetic, ties broken by scan order (candidates are lb-sorted
+by the same frontier). The per-query result buffers come back ascending.
+
+The XLA reference path (``tile_query._scan_tiles``) stays as the identity
+oracle and the non-TPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _scan_kernel(
+    # per-tile SMEM blocks (a scalar-prefetch [T, C] array would need the
+    # WHOLE candidate table in the ~1MB SMEM; a (1, C) block per grid step
+    # streams in a few hundred bytes instead)
+    cand_ref,  # i32[1, 1, C] SMEM block
+    lb_ref,  # f32[1, 1, C] SMEM block
+    # array inputs
+    tqT_ref,  # f32[1, D, TQp] VMEM block (tile queries, transposed)
+    ptsT_hbm,  # f32[NBP, D, B] ANY (manual DMA)
+    gid_hbm,  # i32[NBP, 1, B] ANY (manual DMA)
+    # outputs
+    out_d_ref,  # f32[1, TQp, k]
+    out_i_ref,  # i32[1, TQp, k]
+    # scratch
+    pbuf,  # f32[2, D, B]
+    gbuf,  # i32[2, 1, B]
+    sems,  # DMA sems [2, 2]
+    work_d,  # f32[TQp, W]
+    work_i,  # i32[TQp, W]
+):
+    C = cand_ref.shape[2]
+    tqp, k = out_d_ref.shape[1], out_d_ref.shape[2]
+    D = pbuf.shape[1]
+    B = pbuf.shape[2]
+    W = work_d.shape[1]
+
+    out_d_ref[0] = jnp.full((tqp, k), jnp.inf, jnp.float32)
+    out_i_ref[0] = jnp.full((tqp, k), -1, jnp.int32)
+    # constant work-buffer tail (lanes >= B + k never hold candidates)
+    work_d[...] = jnp.full((tqp, W), jnp.inf, jnp.float32)
+    work_i[...] = jnp.full((tqp, W), -1, jnp.int32)
+
+    def dmas(c, slot):
+        b = jnp.maximum(cand_ref[0, 0, c], 0)  # padding never folds; clamp for DMA
+        return (
+            pltpu.make_async_copy(ptsT_hbm.at[b], pbuf.at[slot], sems.at[slot, 0]),
+            pltpu.make_async_copy(gid_hbm.at[b], gbuf.at[slot], sems.at[slot, 1]),
+        )
+
+    def start(c, slot):
+        cp, cg = dmas(c, slot)
+        cp.start()
+        cg.start()
+
+    def wait(c, slot):
+        cp, cg = dmas(c, slot)
+        cp.wait()
+        cg.wait()
+
+    start(0, 0)
+    lanes = lax.broadcasted_iota(jnp.int32, (tqp, W), 1)
+
+    def cond(c):
+        worst = jnp.max(out_d_ref[0, :, k - 1])
+        return (c < C) & (lb_ref[0, 0, c] < worst)
+
+    def body(c):
+        slot = lax.rem(c, 2)
+
+        @pl.when(c + 1 < C)
+        def _():
+            start(c + 1, lax.rem(c + 1, 2))
+
+        wait(c, slot)
+
+        acc = jnp.zeros((tqp, B), jnp.float32)
+        for d in range(D):
+            qd = tqT_ref[0, d, :].reshape(tqp, 1)
+            pd = pbuf[slot, d, :].reshape(1, B)
+            diff = qd - pd
+            acc = acc + diff * diff
+
+        kth = out_d_ref[0, :, k - 1]
+        need = jnp.any(jnp.min(acc, axis=1) < kth)
+
+        @pl.when(need)
+        def _():
+            work_d[:, :B] = acc
+            work_i[:, :B] = jnp.broadcast_to(gbuf[slot, 0, :].reshape(1, B), (tqp, B))
+            work_d[:, B : B + k] = out_d_ref[0]
+            work_i[:, B : B + k] = out_i_ref[0]
+            wd = work_d[...]
+            wi = work_i[...]
+            for j in range(k):
+                rm = jnp.min(wd, axis=1, keepdims=True)  # [TQ, 1]
+                ml = jnp.min(jnp.where(wd == rm, lanes, W), axis=1, keepdims=True)
+                onehot = lanes == ml
+                out_d_ref[0, :, j] = rm[:, 0]
+                out_i_ref[0, :, j] = jnp.sum(
+                    jnp.where(onehot, wi, 0), axis=1, dtype=jnp.int32
+                )
+                wd = jnp.where(onehot, jnp.inf, wd)
+
+        return c + 1
+
+    c_stop = lax.while_loop(cond, body, jnp.int32(0))
+
+    # the prologue (c=0) or the last body iteration's prefetch (c_stop) may
+    # have left a DMA in flight that no iteration waited on; a kernel must
+    # not exit with outstanding DMAs
+    @pl.when(c_stop < C)
+    def _():
+        wait(c_stop, lax.rem(c_stop, 2))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _scan_tiles_fused_impl(tqT, cand, lb, ptsT, gid3, k: int, interpret: bool):
+    T, D, tqp = tqT.shape
+    C = cand.shape[1]
+    B = ptsT.shape[2]
+    W = _round_up(B + k, _LANE)
+
+    return pl.pallas_call(
+        _scan_kernel,
+        grid=(T,),
+        in_specs=[
+            # [T, 1, C] with a (1, 1, C) block: the TPU lowering requires
+            # the last two block dims to be full (or (8,128)-aligned)
+            pl.BlockSpec((1, 1, C), lambda t: (t, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, C), lambda t: (t, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, D, tqp), lambda t: (t, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tqp, k), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, tqp, k), lambda t: (t, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, tqp, k), jnp.float32),
+            jax.ShapeDtypeStruct((T, tqp, k), jnp.int32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, D, B), jnp.float32),
+            pltpu.VMEM((2, 1, B), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((tqp, W), jnp.float32),
+            pltpu.VMEM((tqp, W), jnp.int32),
+        ],
+        interpret=interpret,
+    )(cand[:, None, :], lb[:, None, :], tqT, ptsT, gid3)
+
+
+def scan_tiles_fused(
+    tree, tq, cand, cand_lb, k: int, interpret: bool | None = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in for ``tile_query._scan_tiles`` on TPU.
+
+    tq f32[T, TQ, D]; cand i32[T, C] lb-ascending (-1 pad); cand_lb
+    f32[T, C] (+inf at pad). Returns (d2 f32[T, TQ, k], gid i32[T, TQ, k])
+    ascending per query.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T, TQ, D = tq.shape
+    k = min(k, tree.n_real)
+    tqp = max(TQ, 8)  # sublane floor; padding rows are duplicates, sliced off
+    if tqp != TQ:
+        tq = jnp.concatenate(
+            [tq, jnp.broadcast_to(tq[:, -1:, :], (T, tqp - TQ, D))], axis=1
+        )
+    tqT = jnp.swapaxes(tq, 1, 2)  # [T, D, TQp]
+    ptsT = jnp.swapaxes(tree.bucket_pts, 1, 2)  # [NBP, D, B]
+    gid3 = tree.bucket_gid[:, None, :]  # [NBP, 1, B]
+    d2, gi = _scan_tiles_fused_impl(tqT, cand, cand_lb, ptsT, gid3, k, interpret)
+    return d2[:, :TQ], gi[:, :TQ]

@@ -145,12 +145,83 @@ def _partition_exchange(pts, gid, code, p: int, cap: int, axis_name: str):
     return recv_pts[order2], recv_gid[order2], overflow_total
 
 
-def _global_morton_local(
-    start, queries, *, seed: int, dim: int, rows: int, num_points: int, k: int,
-    p: int, cap: int, bucket_cap: int, bits: int, axis_name: str,
-):
-    """Per-device SPMD body: generate own rows -> exchange -> build -> query."""
-    pts = _shard_points_fold(seed, dim, start[0], rows)
+@jax.tree_util.register_pytree_node_class
+class GlobalMortonForest:
+    """The scale-mode spatial index: P per-device Morton bucket trees over
+    one sample-sort partition of the global point set.
+
+    All tree arrays are stacked on a leading device axis (sharded over the
+    mesh in live use; dense host arrays after a checkpoint round trip).
+    ``bucket_gid`` holds GLOBAL point ids (-1 padding), so query results
+    need no per-device remapping. Static aux: num_points, dim, and the
+    build provenance (seed, bucket_cap, bits) for checkpoint/requery.
+    """
+
+    def __init__(self, node_lo, node_hi, bucket_pts, bucket_gid,
+                 num_points, seed, bucket_cap, bits):
+        self.node_lo = node_lo  # [P, H, D]
+        self.node_hi = node_hi
+        self.bucket_pts = bucket_pts  # [P, NBP, B, D]
+        self.bucket_gid = bucket_gid  # [P, NBP, B] global ids
+        self.num_points = num_points
+        self.seed = seed
+        self.bucket_cap = bucket_cap
+        self.bits = bits
+
+    @property
+    def devices(self) -> int:
+        return self.node_lo.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.bucket_pts.shape[3]
+
+    @property
+    def n_real(self) -> int:
+        return self.num_points
+
+    @property
+    def num_levels(self) -> int:
+        # NBP is a power of two by construction (ops/morton._tree_shape), so
+        # the traversal depth is encoded in the arrays — never stored aux
+        # that could desynchronize from them
+        return (self.bucket_pts.shape[1]).bit_length() - 1
+
+    def tree_flatten(self):
+        return (
+            (self.node_lo, self.node_hi, self.bucket_pts, self.bucket_gid),
+            (self.num_points, self.seed, self.bucket_cap, self.bits),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __repr__(self):
+        return (
+            f"GlobalMortonForest(n={self.num_points}, devices={self.devices}, "
+            f"dim={self.dim})"
+        )
+
+
+def _merge_partials(all_d, all_i, k: int):
+    """Merge P per-device k-buffers [P, Q, k] into exact global (d2, ids):
+    top-k over the concatenated candidates, then a stable (distance, id)
+    sort so ties break identically on every code path."""
+    q = all_d.shape[1]
+    cat_d = jnp.moveaxis(all_d, 0, 1).reshape(q, -1)
+    cat_i = jnp.moveaxis(all_i, 0, 1).reshape(q, -1)
+    kk = min(k, cat_d.shape[1])
+    neg, sel = lax.top_k(-cat_d, kk)
+    md = -neg
+    mi = jnp.take_along_axis(cat_i, sel, axis=1)
+    return lax.sort((md, mi), num_keys=2, is_stable=True)
+
+
+def _build_local(start, seed, *, dim, rows, num_points, p, cap, bucket_cap,
+                 bits, axis_name):
+    """Per-device SPMD build body: generate own rows -> exchange -> build."""
+    pts = _shard_points_fold(seed[0], dim, start[0], rows)
     gid = (start[0] + jnp.arange(rows)).astype(jnp.int32)
     # ceil-padding rows past num_points are PHANTOMS — real uniform draws that
     # must never compete in k-NN. Mask them to the standard padding encoding
@@ -166,45 +237,175 @@ def _global_morton_local(
     pts, gid, overflow = _partition_exchange(pts, gid, code, p, cap, axis_name)
 
     tree = build_morton_impl(pts, bucket_cap=bucket_cap, bits=bits)
-    # local gids are positions into `pts`; map back to global ids after query
-    d2, li = jax.vmap(lambda q: _morton_knn_one(tree, k, q))(queries)
-    gi = jnp.where(li >= 0, gid[jnp.maximum(li, 0)], -1)
-    # exact merge of the P partial k-buffers
+    # local tree gids are positions into `pts`; store GLOBAL ids in the forest
+    bg = tree.bucket_gid
+    bg = jnp.where(bg >= 0, gid[jnp.maximum(bg, 0)], -1)
+    return (
+        tree.node_lo[None],
+        tree.node_hi[None],
+        tree.bucket_pts[None],
+        bg[None],
+        overflow[None],
+    )
+
+
+def _query_local(node_lo, node_hi, bucket_pts, bucket_gid, queries, *,
+                 k, num_levels, num_points, axis_name):
+    """Per-device SPMD query body: local exact k-NN + all_gather merge."""
+    from kdtree_tpu.ops.morton import MortonTree
+
+    tree = MortonTree(
+        node_lo[0], node_hi[0], bucket_pts[0], bucket_gid[0],
+        n_real=num_points, num_levels=num_levels,
+    )
+    d2, gi = jax.vmap(lambda q: _morton_knn_one(tree, k, q))(queries)
+    # gids are already global; padding rows carry -1 and inf distances
     all_d = lax.all_gather(d2, axis_name)  # [P, Q, k]
     all_i = lax.all_gather(gi, axis_name)
-    q = queries.shape[0]
-    cat_d = jnp.moveaxis(all_d, 0, 1).reshape(q, -1)
-    cat_i = jnp.moveaxis(all_i, 0, 1).reshape(q, -1)
-    kk = min(k, cat_d.shape[1])
-    neg, sel = lax.top_k(-cat_d, kk)
-    md = -neg
-    mi = jnp.take_along_axis(cat_i, sel, axis=1)
-    md, mi = lax.sort((md, mi), num_keys=2, is_stable=True)
-    return md, mi, overflow[None]
+    return _merge_partials(all_d, all_i, k)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "seed", "dim", "rows", "num_points", "k", "cap", "bucket_cap",
-        "bits",
+        "mesh", "dim", "rows", "num_points", "cap", "bucket_cap", "bits",
     ),
 )
-def _global_morton_jit(starts, queries, mesh, seed, dim, rows, num_points, k,
-                       cap, bucket_cap, bits):
+def _build_jit(starts, seed, mesh, dim, rows, num_points, cap, bucket_cap,
+               bits):
+    # seed is a TRACED scalar (not static): a warmup run on one seed compiles
+    # the build for every seed
     p = mesh.shape[SHARD_AXIS]
     fn = jax.shard_map(
         functools.partial(
-            _global_morton_local,
-            seed=seed, dim=dim, rows=rows, num_points=num_points, k=k, p=p,
+            _build_local,
+            dim=dim, rows=rows, num_points=num_points, p=p,
             cap=cap, bucket_cap=bucket_cap, bits=bits, axis_name=SHARD_AXIS,
         ),
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(None, None)),
-        out_specs=(P(None, None), P(None, None), P(None)),
+        in_specs=(P(SHARD_AXIS), P(None)),
+        out_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(None),
+        ),
         check_vma=False,
     )
-    return fn(starts, queries)
+    return fn(starts, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "num_levels", "num_points"))
+def _query_meshfree_jit(node_lo, node_hi, bucket_pts, bucket_gid, queries, k,
+                        num_levels, num_points):
+    """vmap-over-devices query: same math as _query_local without a mesh.
+
+    Used for a checkpointed forest loaded on hardware with a different
+    device count (e.g. a forest built on the 8-device CPU test mesh queried
+    on a 1-chip TPU) — the P per-device trees are just stacked arrays, so
+    the all_gather merge becomes a plain vmap + top_k.
+    """
+    from kdtree_tpu.ops.morton import MortonTree
+
+    def one_device(nl, nh, bp, bg):
+        tree = MortonTree(nl, nh, bp, bg, n_real=num_points,
+                          num_levels=num_levels)
+        return jax.vmap(lambda q: _morton_knn_one(tree, k, q))(queries)
+
+    all_d, all_i = jax.vmap(one_device)(
+        node_lo, node_hi, bucket_pts, bucket_gid
+    )  # [P, Q, k]
+    return _merge_partials(all_d, all_i, k)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "k", "num_levels", "num_points")
+)
+def _query_jit(node_lo, node_hi, bucket_pts, bucket_gid, queries, mesh, k,
+               num_levels, num_points):
+    fn = jax.shard_map(
+        functools.partial(
+            _query_local,
+            k=k, num_levels=num_levels, num_points=num_points,
+            axis_name=SHARD_AXIS,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(None, None),
+        ),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    return fn(node_lo, node_hi, bucket_pts, bucket_gid, queries)
+
+
+def build_global_morton(
+    seed: int,
+    dim: int,
+    num_points: int,
+    mesh: Mesh | None = None,
+    bucket_cap: int = 128,
+    slack: float = DEFAULT_SLACK,
+) -> GlobalMortonForest:
+    """Build the scale-mode index: shard-local generation, ONE all_to_all
+    sample-sort partition, per-device Morton trees. No [N, D] array ever
+    exists on any single device.
+
+    Raises RuntimeError on sample-sort capacity overflow (retry with higher
+    ``slack``).
+    """
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    p = mesh.shape[SHARD_AXIS]
+    rows = -(-num_points // p)  # ceil; past-N rows masked in _build_local
+    bits = max(1, min(32 // max(dim, 1), 16))
+    cap = max(1, int(rows / p * slack))
+    starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
+    node_lo, node_hi, bucket_pts, bucket_gid, overflow = _build_jit(
+        starts, jnp.asarray([seed], jnp.int32), mesh, dim, rows, num_points,
+        cap, bucket_cap, bits
+    )
+    if int(overflow[0]) > 0:
+        raise RuntimeError(
+            f"sample-sort capacity overflow ({int(overflow[0])} rows); "
+            f"retry with slack > {slack}"
+        )
+    return GlobalMortonForest(
+        node_lo, node_hi, bucket_pts, bucket_gid,
+        num_points=num_points, seed=seed, bucket_cap=bucket_cap, bits=bits,
+    )
+
+
+def global_morton_query(
+    forest: GlobalMortonForest,
+    queries: jax.Array,
+    k: int = 1,
+    mesh: Mesh | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN against a forest: replicated queries, per-device local
+    answers, one all_gather + top-k merge (exact because the code ranges
+    partition the point set). Returns (d2 f32[Q, k], global ids i32[Q, k]).
+
+    If the available hardware doesn't match ``forest.devices`` (e.g. a
+    checkpointed forest loaded elsewhere), falls back to a mesh-free
+    vmap-over-devices query — same answers, no collectives.
+    """
+    if mesh is None and len(jax.devices()) >= forest.devices:
+        from .mesh import make_mesh
+
+        mesh = make_mesh(forest.devices)
+    k = min(k, forest.num_points)
+    if mesh is not None and mesh.shape[SHARD_AXIS] == forest.devices:
+        return _query_jit(
+            forest.node_lo, forest.node_hi, forest.bucket_pts,
+            forest.bucket_gid, queries, mesh, k, forest.num_levels,
+            forest.num_points,
+        )
+    return _query_meshfree_jit(
+        forest.node_lo, forest.node_hi, forest.bucket_pts, forest.bucket_gid,
+        queries, k, forest.num_levels, forest.num_points,
+    )
 
 
 def global_morton_knn(
@@ -233,21 +434,7 @@ def global_morton_knn(
         from .mesh import make_mesh
 
         mesh = make_mesh()
-    p = mesh.shape[SHARD_AXIS]
-    rows = -(-num_points // p)  # ceil; the last shard generates past-N rows,
-    # which _global_morton_local masks to padding BEFORE the exchange
-    # (cheaper than ragged shards; the fold_in stream is defined for any row)
-    bits = max(1, min(32 // max(dim, 1), 16))
-    cap = max(1, int(rows / p * slack))
-    k = min(k, num_points)
-    starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
-    d2, gi, overflow = _global_morton_jit(
-        starts, queries, mesh, seed, dim, rows, num_points, k, cap, bucket_cap,
-        bits,
+    forest = build_global_morton(
+        seed, dim, num_points, mesh=mesh, bucket_cap=bucket_cap, slack=slack
     )
-    if int(overflow[0]) > 0:
-        raise RuntimeError(
-            f"sample-sort capacity overflow ({int(overflow[0])} rows); "
-            f"retry with slack > {slack}"
-        )
-    return d2, gi
+    return global_morton_query(forest, queries, k=k, mesh=mesh)

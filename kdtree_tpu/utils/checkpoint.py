@@ -19,6 +19,7 @@ def _registry():
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree
     from kdtree_tpu.ops.morton import MortonTree
+    from kdtree_tpu.parallel.global_morton import GlobalMortonForest
     from kdtree_tpu.parallel.global_tree import GlobalKDTree
 
     return {
@@ -26,6 +27,7 @@ def _registry():
         "bucket": BucketKDTree,
         "morton": MortonTree,
         "global": GlobalKDTree,
+        "global-morton": GlobalMortonForest,
     }
 
 

@@ -110,39 +110,102 @@ def _generate_queries(seed: int, dim: int, num_points: int, generator: str):
     return generate_queries(seed, dim, NUM_QUERIES)
 
 
-def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None):
-    """Returns (d2[Q,k], idx[Q,k]) by the chosen engine."""
-    dim = points.shape[1]
+def _resolve_engine(engine: str, dim: int) -> str:
     if engine == "auto":
-        engine = "morton" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
-    if engine == "morton":
-        from kdtree_tpu.ops.morton import build_morton, morton_knn
+        return "morton" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
+    return engine
 
-        return morton_knn(build_morton(points), queries, k=k)
+
+def _build_index(points, engine: str, mesh_devices: int | None = None,
+                 problem=None):
+    """Build phase: the index object for an engine.
+
+    ``problem`` = (seed, dim, num_points) is required by the generative
+    ``global-morton`` engine, whose build NEVER materializes the [N, D]
+    array (shard-local generation is fused into the build; ``points`` is
+    ignored there and may be None).
+    """
+    if engine in ("morton", "tiled"):
+        from kdtree_tpu.ops.morton import build_morton
+
+        return build_morton(points)
     if engine == "tree":
-        from kdtree_tpu import build_jit, knn
+        from kdtree_tpu.ops.build import build_jit
 
-        return knn(build_jit(points), queries, k=k)
+        return build_jit(points)
     if engine == "bucket":
-        from kdtree_tpu.ops.bucket import bucket_knn, build_bucket
+        from kdtree_tpu.ops.bucket import build_bucket
 
-        return bucket_knn(build_bucket(points), queries, k=k)
+        return build_bucket(points)
+    if engine == "bruteforce":
+        return points  # the index IS the point array (MXU distance scans)
+    if engine == "global":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_tree import build_global
+
+        return build_global(points, mesh=make_mesh(mesh_devices))
+    if engine == "global-morton":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_morton import build_global_morton
+
+        seed, dim, num_points = problem
+        return build_global_morton(
+            seed, dim, num_points, mesh=make_mesh(mesh_devices)
+        )
+    raise SystemExit(f"engine {engine!r} has no split build phase")
+
+
+def _query_index(index, queries, k: int, engine: str,
+                 mesh_devices: int | None = None):
+    """Query phase against the object _build_index returned."""
+    if engine == "morton":
+        from kdtree_tpu.ops.morton import morton_knn
+
+        return morton_knn(index, queries, k=k)
+    if engine == "tiled":
+        from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+        return morton_knn_tiled(index, queries, k=k)
+    if engine == "tree":
+        from kdtree_tpu.ops.query import knn
+
+        return knn(index, queries, k=k)
+    if engine == "bucket":
+        from kdtree_tpu.ops.bucket import bucket_knn
+
+        return bucket_knn(index, queries, k=k)
     if engine == "bruteforce":
         from kdtree_tpu.ops import bruteforce
 
-        return bruteforce.knn(points, queries, k=k)
+        return bruteforce.knn(index, queries, k=k)
+    if engine == "global":
+        from kdtree_tpu.parallel.global_tree import global_knn
+
+        return global_knn(index, queries, k=k)
+    if engine == "global-morton":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_morton import global_morton_query
+
+        return global_morton_query(
+            index, queries, k=k, mesh=make_mesh(mesh_devices)
+        )
+    raise SystemExit(f"engine {engine!r} has no split query phase")
+
+
+def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None,
+           problem=None):
+    """Returns (d2[Q,k], idx[Q,k]) by the chosen engine."""
+    dim = queries.shape[1]
+    engine = _resolve_engine(engine, dim)
     if engine == "ensemble":
+        # deliberately fused: local build + query + merge is ONE SPMD program
+        # (the reference MPI semantics, kdtree_mpi.cpp:204-253)
         from kdtree_tpu.parallel import ensemble_knn, make_mesh
 
         mesh = make_mesh(mesh_devices)
         return ensemble_knn(points, queries, k=k, mesh=mesh)
-    if engine == "global":
-        from kdtree_tpu.parallel import make_mesh
-        from kdtree_tpu.parallel.global_tree import global_build_knn
-
-        mesh = make_mesh(mesh_devices)
-        return global_build_knn(points, queries, k=k, mesh=mesh)
-    raise SystemExit(f"unknown engine: {engine}")
+    index = _build_index(points, engine, mesh_devices, problem=problem)
+    return _query_index(index, queries, k, engine, mesh_devices)
 
 
 def cmd_harness(args) -> None:
@@ -169,8 +232,24 @@ def cmd_harness(args) -> None:
         dim, num_points = HARNESS_DIM, HARNESS_NUM_POINTS
     _validate_input(seed, dim, num_points)
 
-    points, queries, _ = _generate(seed, dim, num_points, args.generator)
-    d2, _ = _solve(points, queries, k=1, engine=args.engine, mesh_devices=args.devices)
+    engine = _resolve_engine(args.engine, dim)
+    if engine == "global-morton":
+        # generative engine: the point set is the threefry row stream,
+        # shard-generated inside the build — never materialized here
+        if args.generator != "threefry":
+            print("note: global-morton defines its points by the threefry "
+                  "row stream (shard-local generation); using threefry "
+                  "queries", file=sys.stderr)
+        from kdtree_tpu.ops.generate import generate_queries
+
+        queries = generate_queries(seed, dim, NUM_QUERIES)
+        d2, _ = _solve(None, queries, k=1, engine=engine,
+                       mesh_devices=args.devices,
+                       problem=(seed, dim, num_points))
+    else:
+        points, queries, _ = _generate(seed, dim, num_points, args.generator)
+        d2, _ = _solve(points, queries, k=1, engine=engine,
+                       mesh_devices=args.devices)
     dists = np.sqrt(np.asarray(d2[:, 0], dtype=np.float64))
     for q in range(NUM_QUERIES):
         # reference query ids are num_points + q (kdtree_sequential.cpp:170)
@@ -179,53 +258,83 @@ def cmd_harness(args) -> None:
 
 
 def cmd_bench(args) -> None:
+    import contextlib
+
     from kdtree_tpu.utils.timing import PhaseTimer
 
-    timer = PhaseTimer()
+    engine = _resolve_engine(args.engine, args.dim)
+    fused_gen = engine == "global-morton"  # generation IS part of the build
+    fused_bq = engine == "ensemble"  # one SPMD program by design
+
+    def run(seed: int, timer: PhaseTimer | None):
+        t = timer or PhaseTimer()
+        problem = (seed, args.dim, args.n)
+        if fused_gen:
+            from kdtree_tpu.ops.generate import generate_queries
+
+            with t.phase("generate") as h:
+                queries = generate_queries(seed, args.dim, NUM_QUERIES)
+                h += [queries]
+            points = None
+        else:
+            with t.phase("generate") as h:
+                points, queries, _ = _generate(seed, args.dim, args.n,
+                                               args.generator)
+                h += [points, queries]
+        if fused_bq:
+            with t.phase("build+query") as h:
+                d2, idx = _solve(points, queries, k=args.k, engine=engine,
+                                 mesh_devices=args.devices)
+                h += [d2, idx]
+        else:
+            with t.phase("build") as h:
+                index = _build_index(points, engine, args.devices,
+                                     problem=problem)
+                h += [index]
+            with t.phase("query") as h:
+                d2, idx = _query_index(index, queries, args.k, engine,
+                                       args.devices)
+                h += [d2, idx]
+        return d2
+
     # warmup on a distinct seed: compiles everything, excluded from timing.
-    # Timed repetitions use fresh seeds — re-running a jitted fn on the very
-    # same arrays can report ~0s (see .claude/skills/verify/SKILL.md).
-    w_pts, w_qs, _ = _generate(args.seed + 1000, args.dim, args.n, args.generator)
-    d2, _ = _solve(w_pts, w_qs, k=args.k, engine=args.engine, mesh_devices=args.devices)
-    np.asarray(d2)  # host fetch = true barrier
-    with timer.phase("generate") as h:
-        points, queries, _ = _generate(args.seed, args.dim, args.n, args.generator)
-        h += [points, queries]
-    with timer.phase("build+query") as h:
-        d2, idx = _solve(points, queries, k=args.k, engine=args.engine, mesh_devices=args.devices)
-        h += [d2, idx]
+    # Timed run uses a fresh seed — re-running a jitted fn on the very same
+    # arrays can report ~0s (see .claude/skills/verify/SKILL.md).
+    np.asarray(run(args.seed + 1000, None))
+
+    import jax
+
+    timer = PhaseTimer()
+    trace = (jax.profiler.trace(args.trace) if getattr(args, "trace", None)
+             else contextlib.nullcontext())
+    with trace:
+        run(args.seed, timer)
     rep = timer.report()
-    bq = rep["build+query"]
+    # pts/s excludes generation for every engine (for global-morton the
+    # "generate" phase is only the 10 queries; its point generation is fused
+    # into the build by design and cannot be excluded)
+    solve_s = rep["total"] - rep["generate"]
     rep.update(
-        n=args.n, dim=args.dim, k=args.k, engine=args.engine,
-        pts_per_sec=(args.n / bq) if bq > 0 else None,
+        n=args.n, dim=args.dim, k=args.k, engine=engine,
+        pts_per_sec=(args.n / solve_s) if solve_s > 0 else None,
     )
     print(json.dumps(rep))
 
 
-def _build_tree_for_engine(points, engine: str, mesh_devices: int | None):
+def _build_tree_for_engine(points, engine: str, mesh_devices: int | None,
+                           problem=None):
     """Build the tree object matching the engine choice (for checkpointing).
 
     "auto" resolves to the Morton tree — same as _solve's auto for low D, and
     still the right checkpoint for high D (exact; a loaded tree answers with
-    morton_knn even where the harness's auto would have used brute force)."""
-    if engine in ("auto", "morton"):
+    morton_knn even where the harness's auto would have used brute force).
+    "tiled" shares the Morton tree (it is a query strategy, not an index)."""
+    if engine in ("auto", "morton", "tiled"):
         from kdtree_tpu.ops.morton import build_morton
 
         return build_morton(points)
-    if engine == "bucket":
-        from kdtree_tpu.ops.bucket import build_bucket
-
-        return build_bucket(points)
-    if engine == "tree":
-        from kdtree_tpu.ops.build import build_jit
-
-        return build_jit(points)
-    if engine == "global":
-        from kdtree_tpu.parallel import make_mesh
-        from kdtree_tpu.parallel.global_tree import build_global
-
-        return build_global(points, mesh=make_mesh(mesh_devices))
+    if engine in ("bucket", "tree", "global", "global-morton"):
+        return _build_index(points, engine, mesh_devices, problem=problem)
     raise SystemExit(f"engine {engine!r} does not produce a checkpointable tree")
 
 
@@ -234,8 +343,15 @@ def _tree_knn(tree, queries, k: int):
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn
     from kdtree_tpu.ops.morton import MortonTree, morton_knn
+    from kdtree_tpu.parallel.global_morton import (
+        GlobalMortonForest, global_morton_query,
+    )
     from kdtree_tpu.parallel.global_tree import GlobalKDTree, global_knn
 
+    if isinstance(tree, GlobalMortonForest):
+        # falls back to the mesh-free query when the local device count
+        # doesn't match the forest's build mesh
+        return global_morton_query(tree, queries, k=k)
     if isinstance(tree, MortonTree):
         return morton_knn(tree, queries, k=k)
     if isinstance(tree, BucketKDTree):
@@ -251,10 +367,24 @@ def _tree_knn(tree, queries, k: int):
 def cmd_build(args) -> None:
     from kdtree_tpu.utils.checkpoint import save_tree
 
-    points, _, gen_used = _generate(args.seed, args.dim, args.n, args.generator)
-    tree = _build_tree_for_engine(points, args.engine, args.devices)
+    if args.engine == "global-morton":
+        # generative: never materialize [N, D]; provenance = threefry rows
+        if args.generator != "threefry":
+            print("note: global-morton defines its points by the threefry "
+                  "row stream (shard-local generation); --generator "
+                  f"{args.generator} does not apply", file=sys.stderr)
+        tree = _build_tree_for_engine(
+            None, args.engine, args.devices,
+            problem=(args.seed, args.dim, args.n),
+        )
+        gen_used = "threefry"
+        n, dim = args.n, args.dim
+    else:
+        points, _, gen_used = _generate(args.seed, args.dim, args.n,
+                                        args.generator)
+        tree = _build_tree_for_engine(points, args.engine, args.devices)
+        n, dim = points.shape
     save_tree(args.out, tree, meta={"seed": args.seed, "generator": gen_used})
-    n, dim = points.shape
     print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to {args.out}")
 
 
@@ -289,11 +419,16 @@ def main(argv=None) -> None:
     p.add_argument("--generator", choices=["threefry", "mt19937"], default="mt19937",
                    help="problem generator (mt19937 = bit-exact reference replay)")
     p.add_argument("--engine",
-                   choices=["auto", "morton", "tree", "bucket", "bruteforce",
-                            "ensemble", "global"],
-                   default="auto")
+                   choices=["auto", "morton", "tiled", "tree", "bucket",
+                            "bruteforce", "ensemble", "global",
+                            "global-morton"],
+                   default="auto",
+                   help="tiled = Morton tree + Hilbert-tiled batched scan "
+                        "(large query counts); global-morton = the scale "
+                        "engine (shard-local generation + one all_to_all "
+                        "sample-sort partition)")
     p.add_argument("--devices", type=int, default=None,
-                   help="device count for ensemble engine (default: all)")
+                   help="device count for sharded engines (default: all)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     h = sub.add_parser("harness", help="course grading protocol (READY/DONE)")
@@ -306,6 +441,9 @@ def main(argv=None) -> None:
     b.add_argument("--dim", type=int, default=3)
     b.add_argument("--n", type=int, default=1 << 20)
     b.add_argument("--k", type=int, default=1)
+    b.add_argument("--trace", default=None, metavar="DIR",
+                   help="write a jax.profiler trace (Perfetto) of the timed "
+                        "run; phases appear as named TraceAnnotations")
     b.set_defaults(fn=cmd_bench)
 
     bu = sub.add_parser("build", help="build a tree and save to npz")

@@ -31,7 +31,9 @@ class PhaseTimer:
         holder: list[Any] = []
         t0 = time.perf_counter()
         try:
-            yield holder
+            # names the phase in a jax.profiler trace (no-op when not tracing)
+            with jax.profiler.TraceAnnotation(name):
+                yield holder
         finally:
             if holder:
                 jax.block_until_ready(holder)

@@ -99,6 +99,37 @@ def test_scale_512k_over_8_devices():
     np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
 
 
+def test_forest_build_query_split_and_checkpoint(tmp_path):
+    """First-class scale engine (VERDICT r2 item 3): a built forest is a
+    checkpointable object; build+query composition == the fused entry; the
+    round-tripped forest answers identically; the mesh-free query (loaded
+    forest on different hardware) agrees with the mesh query."""
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton, global_morton_query,
+    )
+    from kdtree_tpu.utils.checkpoint import load_tree, save_tree
+
+    n, dim, k, p = 1037, 3, 4, 8
+    pts, qs, bf_d2, _ = _oracle(13, dim, n, 8, k)
+    mesh = make_mesh(p)
+    forest = build_global_morton(13, dim, n, mesh=mesh)
+    d2, gi = global_morton_query(forest, qs, k=k, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+
+    path = str(tmp_path / "forest.npz")
+    save_tree(path, forest, meta={"seed": 13, "generator": "threefry"})
+    loaded, meta = load_tree(path)
+    assert meta["seed"] == 13
+    assert loaded.num_points == n and loaded.devices == p
+    d2b, gib = global_morton_query(loaded, qs, k=k, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(d2b), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(gib), np.asarray(gi))
+
+    # mesh-free path (what a 1-chip load of an 8-device forest runs)
+    d2c, gic = global_morton_query(loaded, qs, k=k, mesh=make_mesh(1))
+    np.testing.assert_allclose(np.asarray(d2c), np.asarray(d2), rtol=1e-6)
+
+
 def test_tiny_non_divisible_n_no_spurious_overflow():
     """Masked phantom rows must not count toward sample-sort overflow: n=9 on
     8 devices generates 7 phantoms that all carry the top Morton code, and

@@ -87,7 +87,8 @@ def test_argv_mode_small():
 def test_argv_mode_engines_agree():
     """All engines are exact, so the protocol output is engine-independent."""
     outs = []
-    for engine in ("tree", "bucket", "morton", "bruteforce", "ensemble", "global"):
+    for engine in ("tree", "bucket", "morton", "tiled", "bruteforce",
+                   "ensemble", "global"):
         # threefry generator: engine agreement must hold without a toolchain
         res = _run_cli(["--generator", "threefry", "--engine", engine,
                         "harness", "3", "3", "500"])
@@ -123,6 +124,37 @@ def test_malformed_spec():
     assert "Traceback" not in res.stderr
 
 
+def test_global_morton_engine_protocol():
+    """The scale engine is a first-class CLI citizen (VERDICT r2 item 3):
+    harness output must equal the brute-force oracle over its own point set
+    (the threefry row stream — shard-generated, never materialized)."""
+    res = _run_cli(["--engine", "global-morton", "--devices", "8",
+                    "harness", "11", "3", "777"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    ids, dists = _parse(res.stdout)
+    assert ids == list(range(777, 787))
+
+    from kdtree_tpu.ops import bruteforce
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+
+    pts = generate_points_rowwise(11, 3, 777)
+    qs = generate_queries(11, 3, 10)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
+    np.testing.assert_allclose(dists, np.sqrt(np.asarray(bf)[:, 0]), rtol=1e-4)
+
+
+def test_bench_reports_three_phases():
+    """VERDICT r2 item 7: bench reports gen/build/query separately."""
+    import json
+
+    res = _run_cli(["--generator", "threefry", "--engine", "morton",
+                    "bench", "--n", "400", "--dim", "3"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    rep = json.loads(res.stdout.strip().splitlines()[-1])
+    for phase in ("generate", "build", "query", "total", "pts_per_sec"):
+        assert phase in rep, rep
+
+
 @pytest.mark.parametrize("engine", ["tree", "bucket", "morton", "global"])
 def test_build_query_roundtrip(tmp_path, engine):
     """build saves provenance; query replays it regardless of --seed —
@@ -142,6 +174,30 @@ def test_build_query_roundtrip(tmp_path, engine):
     from kdtree_tpu.ops import bruteforce
 
     pts, qs = generate_problem(7, 3, 500, 10)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
+    got = [float(ln.split(" \t DISTANCE: ")[1]) for ln in lines[:-1]]
+    np.testing.assert_allclose(got, np.sqrt(np.asarray(bf)[:, 0]), rtol=1e-4)
+
+
+def test_build_query_roundtrip_global_morton(tmp_path):
+    """Forest checkpoint via the CLI; its problem is the threefry row
+    stream (not generate_problem's block draws), so the oracle differs from
+    test_build_query_roundtrip's."""
+    tree_path = str(tmp_path / "f.npz")
+    res = _run_cli(["--engine", "global-morton", "--devices", "8", "build",
+                    "--seed", "7", "--dim", "3", "--n", "500",
+                    "--out", tree_path])
+    assert res.returncode == 0, res.stderr[-2000:]
+    res = _run_cli(["query", "--tree", tree_path])
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = res.stdout.strip().splitlines()
+    assert lines[-1] == "DONE" and len(lines) == 11
+
+    from kdtree_tpu.ops import bruteforce
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+
+    pts = generate_points_rowwise(7, 3, 500)
+    qs = generate_queries(7, 3, 10)
     bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
     got = [float(ln.split(" \t DISTANCE: ")[1]) for ln in lines[:-1]]
     np.testing.assert_allclose(got, np.sqrt(np.asarray(bf)[:, 0]), rtol=1e-4)
