@@ -56,6 +56,41 @@ def generate_queries(
     )
 
 
+def generate_clustered(
+    seed: int,
+    dim: int,
+    num_points: int,
+    num_queries: int = 10,
+    num_clusters: int = 8,
+    stddev: float = 2.0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Gaussian-mixture problem: the load-imbalance stress configuration
+    (BASELINE.json configs[4]; the course grades on the 128-D shape,
+    ``Utility.cpp:98-99``, where clustering is what makes median splits and
+    sample-sort partitions uneven).
+
+    ``num_clusters`` centers are drawn uniformly from the generator domain;
+    each point/query is a center plus isotropic N(0, stddev²) noise —
+    tightly clustered relative to the [-100, 100) domain, so spatial
+    density varies by orders of magnitude. Queries come from the same
+    mixture (the adversarial case: every query lands in a dense region).
+    """
+    kc, ka, kn, kqa, kqn = jax.random.split(jax.random.key(seed), 5)
+    centers = jax.random.uniform(
+        kc, (num_clusters, dim), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX
+    )
+    assign = jax.random.randint(ka, (num_points,), 0, num_clusters)
+    points = centers[assign] + stddev * jax.random.normal(
+        kn, (num_points, dim), dtype=dtype
+    )
+    qassign = jax.random.randint(kqa, (num_queries,), 0, num_clusters)
+    queries = centers[qassign] + stddev * jax.random.normal(
+        kqn, (num_queries, dim), dtype=dtype
+    )
+    return points, queries
+
+
 def generate_points_shard(
     seed: int, dim: int, shard_start: int, shard_rows: int, dtype=jnp.float32
 ) -> jax.Array:
@@ -68,10 +103,14 @@ def generate_points_shard(
     bit-identical to :func:`generate_points_rowwise` (NOT to
     :func:`generate_problem`, which draws the whole (N, D) block from one key
     in a single call and therefore produces different bits).
+
+    ``seed`` and ``shard_start`` may be traced values (``shard_rows`` must be
+    static) — this is what lets every sharded engine generate its own rows
+    inside one jitted SPMD program.
     """
     kp, _ = jax.random.split(jax.random.key(seed), 2)
     row_keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(
-        jnp.arange(shard_start, shard_start + shard_rows)
+        shard_start + jnp.arange(shard_rows)
     )
     return jax.vmap(
         lambda k: jax.random.uniform(k, (dim,), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX)

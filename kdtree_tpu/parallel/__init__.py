@@ -1,7 +1,14 @@
-from kdtree_tpu.parallel.ensemble import ensemble_knn
+from kdtree_tpu.parallel.ensemble import ensemble_knn, ensemble_knn_gen
+from kdtree_tpu.parallel.global_morton import (
+    GlobalMortonForest,
+    build_global_morton,
+    global_morton_knn,
+    global_morton_query,
+)
 from kdtree_tpu.parallel.global_tree import (
     GlobalKDTree,
     build_global,
+    build_global_gen,
     global_build_knn,
     global_knn,
 )
@@ -9,10 +16,16 @@ from kdtree_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
 __all__ = [
     "ensemble_knn",
+    "ensemble_knn_gen",
     "make_mesh",
     "SHARD_AXIS",
     "GlobalKDTree",
     "build_global",
+    "build_global_gen",
     "global_build_knn",
     "global_knn",
+    "GlobalMortonForest",
+    "build_global_morton",
+    "global_morton_knn",
+    "global_morton_query",
 ]

@@ -80,6 +80,77 @@ def _ensemble_jit(points, queries, structure, k: int, mesh: Mesh, pad_value: flo
     return d2, jnp.where(gidx < n, gidx, -1).astype(jnp.int32)
 
 
+def _local_gen_build_query(start, seed, queries, structure, *, dim: int,
+                           rows: int, num_points: int, k: int,
+                           num_levels: int, axis_name: str):
+    """Generative per-device program: each device draws ONLY its own rows
+    (the threefry analog of the reference's discard trick,
+    ``kdtree_mpi.cpp:19-41``) — no [N, D] array exists anywhere. Past-N rows
+    of the ceil-padded last shard are masked to the +inf padding encoding
+    BEFORE the build, so they build into inf-leaves that can never win."""
+    from kdtree_tpu.ops.generate import generate_points_shard
+
+    from .global_morton import _merge_partials
+
+    pts = generate_points_shard(seed[0], dim, start[0], rows)
+    gid0 = start[0] + jnp.arange(rows, dtype=jnp.int32)
+    valid = gid0 < num_points
+    pts = jnp.where(valid[:, None], pts, jnp.inf)
+    tree = build_impl(pts, *structure, num_levels=num_levels)
+    d2, idx = _knn_batch(tree.node_point, tree.points, queries, k, num_levels)
+    gidx = jnp.where((idx >= 0) & (idx + start[0] < num_points),
+                     idx + start[0], -1)
+    all_d = lax.all_gather(d2, axis_name)  # [P, Q, k]
+    all_i = lax.all_gather(gidx, axis_name)
+    return _merge_partials(all_d, all_i, k)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "mesh", "dim", "rows", "num_points", "num_levels"),
+)
+def _ensemble_gen_jit(starts, seed, queries, structure, k, mesh, dim, rows,
+                      num_points, num_levels):
+    fn = jax.shard_map(
+        functools.partial(
+            _local_gen_build_query, dim=dim, rows=rows,
+            num_points=num_points, k=k, num_levels=num_levels,
+            axis_name=SHARD_AXIS,
+        ),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(None), P(None, None), P(None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    return fn(starts, seed, queries, structure)
+
+
+def ensemble_knn_gen(
+    seed: int, dim: int, num_points: int, queries: jax.Array, k: int = 1,
+    mesh: Mesh | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Ensemble mode with shard-local generation (VERDICT r1 item 4 / r2
+    item 5): takes (seed, dim, num_points) like :func:`global_morton_knn`,
+    never materializes the [N, D] array, and answers exactly over the
+    threefry row stream (``generate_points_rowwise`` is the oracle's view of
+    the same point set). Returns (d2 f32[Q, k], ids i32[Q, k]) ascending.
+    """
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    p = mesh.shape[SHARD_AXIS]
+    rows = -(-num_points // p)
+    structure = spec_arrays(rows, dim)
+    num_levels = tree_spec(rows).num_levels
+    k = min(k, num_points)
+    starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
+    return _ensemble_gen_jit(
+        starts, jnp.asarray([seed], jnp.int32), queries, structure, k, mesh,
+        dim, rows, num_points, num_levels,
+    )
+
+
 def ensemble_knn(
     points: jax.Array, queries: jax.Array, k: int = 1, mesh: Mesh | None = None
 ) -> Tuple[jax.Array, jax.Array]:

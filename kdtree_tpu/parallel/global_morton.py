@@ -51,28 +51,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kdtree_tpu.ops.morton import build_morton_impl, morton_codes, _morton_knn_one
-from kdtree_tpu.ops.generate import COORD_MAX, COORD_MIN
+from kdtree_tpu.ops.generate import COORD_MAX, COORD_MIN, generate_points_shard
 
 from .mesh import SHARD_AXIS
 
 DEFAULT_SAMPLES = 256
 DEFAULT_SLACK = 2.0
-
-
-def _shard_points_fold(seed: int, dim: int, start, rows: int, dtype=jnp.float32):
-    """Rows [start, start+rows) of the global problem, traceable start.
-
-    Same per-row fold_in derivation as generate_points_shard (bit-identical
-    union across any device count)."""
-    kp, _ = jax.random.split(jax.random.key(seed), 2)
-    row_keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(
-        start + jnp.arange(rows)
-    )
-    return jax.vmap(
-        lambda k: jax.random.uniform(
-            k, (dim,), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX
-        )
-    )(row_keys)
 
 
 def _partition_exchange(pts, gid, code, p: int, cap: int, axis_name: str):
@@ -221,7 +205,7 @@ def _merge_partials(all_d, all_i, k: int):
 def _build_local(start, seed, *, dim, rows, num_points, p, cap, bucket_cap,
                  bits, axis_name):
     """Per-device SPMD build body: generate own rows -> exchange -> build."""
-    pts = _shard_points_fold(seed[0], dim, start[0], rows)
+    pts = generate_points_shard(seed[0], dim, start[0], rows)
     gid = (start[0] + jnp.arange(rows)).astype(jnp.int32)
     # ceil-padding rows past num_points are PHANTOMS — real uniform draws that
     # must never compete in k-NN. Mask them to the standard padding encoding

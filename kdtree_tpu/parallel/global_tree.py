@@ -26,8 +26,17 @@ single-chip tree over the same global array (tested).
 
 The built tree is returned as a node-coordinate heap (coords + global id per
 heap slot), assembled by a psum-scatter of each device's owned positions.
-Replicating the heap bounds this mode's N by per-chip HBM; a heap-sharded
-query path is the next scaling step.
+
+**Role (decided in round 3, VERDICT r2 item 3):** this mode is the
+framework's *structural-identity oracle* — the only engine whose output tree
+is node-for-node identical to the single-chip exact median-split build, which
+is what the tests use it for. It is NOT the scale engine: the replicated
+O(N) node heap and the O(N/P·log²P)-per-level bitonic exchanges bound it to
+problems that fit one chip's HBM. For N beyond that, use
+:mod:`kdtree_tpu.parallel.global_morton` (O(N/P) state, one all_to_all).
+:func:`build_global_gen` below removes the central [N, D] materialization
+(shard-local generation); the O(N) static position arrays (consume/posnode,
+i32 each) and the replicated heap remain — accepted for an oracle.
 """
 
 from __future__ import annotations
@@ -282,6 +291,80 @@ def build_global(points: jax.Array, mesh: Mesh | None = None) -> GlobalKDTree:
         node_gid=node_gid,
         node_traversable=trav,
         n_real=n,
+        num_levels=spec.num_levels,
+    )
+
+
+def _global_gen_local(start, seed, consume_local, posnode_local, *, dim: int,
+                      rows: int, num_points: int, **kw):
+    """Generative wrapper over _global_build_local: draw own rows, mask the
+    ceil-padding past-N rows to (+inf coords, gid -1) — the same padding
+    encoding build_global produces for its pad block."""
+    from kdtree_tpu.ops.generate import generate_points_shard
+
+    pts = generate_points_shard(seed[0], dim, start[0], rows)
+    gid = (start[0] + jnp.arange(rows)).astype(jnp.int32)
+    valid = gid < num_points
+    pts = jnp.where(valid[:, None], pts, jnp.inf)
+    gid = jnp.where(valid, gid, -1)
+    return _global_build_local(pts, gid, consume_local, posnode_local, **kw)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "dim", "rows", "num_points", "num_levels",
+                     "heap_size"),
+)
+def _build_global_gen_jit(starts, seed, consume, posnode, mesh, dim, rows,
+                          num_points, num_levels, heap_size):
+    p = mesh.shape[SHARD_AXIS]
+    fn = jax.shard_map(
+        functools.partial(
+            _global_gen_local,
+            dim=dim, rows=rows, num_points=num_points,
+            num_levels=num_levels, heap_size=heap_size, num_devices=p,
+            axis_name=SHARD_AXIS,
+        ),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(None), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(None, None), P(None)),
+        check_vma=False,
+    )
+    return fn(starts, seed, consume, posnode)
+
+
+def build_global_gen(
+    seed: int, dim: int, num_points: int, mesh: Mesh | None = None
+) -> GlobalKDTree:
+    """build_global with shard-local generation: takes (seed, dim, n) and
+    never materializes the [N, D] array — each device draws its own rows of
+    the threefry row stream (``generate_points_rowwise`` is the oracle's
+    view of the same set). The resulting tree is identical to
+    ``build_global(generate_points_rowwise(seed, dim, n), mesh)`` (tested).
+    """
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    p = mesh.shape[SHARD_AXIS]
+    if p & (p - 1):
+        raise ValueError(f"global-tree mode needs a power-of-2 device count, got {p}")
+    rows = -(-num_points // p)
+    n_pad = p * rows
+    spec = tree_spec(n_pad)
+    consume = jnp.asarray(spec.consume_level)
+    posnode = jnp.asarray(spec.position_node)
+    starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
+    node_coords, node_gid = _build_global_gen_jit(
+        starts, jnp.asarray([seed], jnp.int32), consume, posnode, mesh, dim,
+        rows, num_points, spec.num_levels, spec.heap_size,
+    )
+    trav = jnp.asarray(_traversable_mask(n_pad, num_points))
+    return GlobalKDTree(
+        node_coords=node_coords,
+        node_gid=node_gid,
+        node_traversable=trav,
+        n_real=num_points,
         num_levels=spec.num_levels,
     )
 

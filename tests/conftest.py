@@ -14,6 +14,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import pytest
+
+
+@pytest.fixture
+def mesh8():
+    from kdtree_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
+
 import jax  # noqa: E402
 
 # The container's sitecustomize force-registers the axon TPU backend and

@@ -1,0 +1,81 @@
+"""Clustered (Gaussian-mixture) and high-D configs — the load-imbalance
+dimension the course grades on (BASELINE.json configs[4]; Utility.cpp:98-99
+hardcodes the 128-D shape). Every engine must stay EXACT under heavy skew;
+the curse of dimensionality may only cost speed, never correctness
+(SURVEY.md §3.5: in high D the reference's prune bug was masked — ours must
+have nothing to mask)."""
+
+import numpy as np
+import pytest
+
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.ops.generate import generate_clustered
+from kdtree_tpu.ops.morton import build_morton, morton_knn
+from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+
+def test_mixture_is_clustered():
+    """Sanity on the generator: mixture points concentrate mass far more
+    than uniform draws (nearest-neighbor distances orders of magnitude
+    smaller than the domain scale)."""
+    pts, qs = generate_clustered(1, 3, 4000, num_queries=16)
+    assert pts.shape == (4000, 3) and qs.shape == (16, 3)
+    d2, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
+    # dense clusters: NN distance ~stddev, domain scale is 200
+    assert float(np.median(np.sqrt(np.asarray(d2)))) < 5.0
+
+
+@pytest.mark.parametrize("dim", [3, 16, 128])
+def test_clustered_morton_exact(dim):
+    """Morton tree exactness under skew, incl. the 128-D grading dimension
+    (bits-per-axis degrades above D=32 — locality may die, answers not)."""
+    pts, qs = generate_clustered(2, dim, 3000, num_queries=12)
+    d2, gi = morton_knn(build_morton(pts), qs, k=5)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=5)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-5)
+
+
+def test_clustered_128d_tiled_engine():
+    """Tiled engine at 128-D clustered: frontier + dense scans stay exact
+    when every query tile lands in a dense cluster."""
+    pts, qs = generate_clustered(3, 128, 2000, num_queries=64)
+    tree = build_morton(pts)
+    d2, _ = morton_knn_tiled(tree, qs, k=4)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=4)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-5)
+
+
+def test_clustered_128d_ensemble(mesh8):
+    """Sharded ensemble on clustered 128-D input arrays."""
+    from kdtree_tpu.parallel import ensemble_knn
+
+    pts, qs = generate_clustered(4, 128, 1999, num_queries=10)
+    d2, idx = ensemble_knn(pts, qs, k=3, mesh=mesh8)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=3)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-5)
+    assert int(np.asarray(idx).max()) < 1999
+
+
+def test_clustered_128d_matmul_refined():
+    """The MXU (matmul-identity) brute-force path must survive clustered
+    high-D data, where |x|^2 >> d^2 makes the identity cancel
+    catastrophically in f32 — the refine pass (exact rescoring of k+slack
+    coarse candidates) is what buys this."""
+    pts, qs = generate_clustered(6, 128, 5000, num_queries=32)
+    d2m, im = bruteforce.knn(pts, qs, k=5, method="matmul")
+    bf, bi = bruteforce.knn_exact_d2(pts, qs, k=5)
+    np.testing.assert_allclose(np.asarray(d2m), np.asarray(bf), rtol=1e-5)
+
+
+def test_clustered_bucket_and_classic():
+    """The remaining single-chip engines at a clustered mid-D shape."""
+    from kdtree_tpu.ops.bucket import bucket_knn, build_bucket
+    from kdtree_tpu.ops.build import build_jit
+    from kdtree_tpu.ops.query import knn
+
+    pts, qs = generate_clustered(5, 8, 2500, num_queries=10)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=3)
+    d2b, _ = bucket_knn(build_bucket(pts), qs, k=3)
+    np.testing.assert_allclose(np.asarray(d2b), np.asarray(bf), rtol=1e-5)
+    d2c, _ = knn(build_jit(pts), qs, k=3)
+    np.testing.assert_allclose(np.asarray(d2c), np.asarray(bf), rtol=1e-5)

@@ -17,11 +17,6 @@ from kdtree_tpu.ops import bruteforce
 from kdtree_tpu.parallel import build_global, global_build_knn, global_knn, make_mesh
 
 
-@pytest.fixture(scope="module")
-def mesh8():
-    return make_mesh(8)
-
-
 @pytest.mark.parametrize("n,d", [(512, 3), (1024, 5), (256, 2)])
 def test_structural_identity_with_single_chip(mesh8, n, d):
     pts, _ = generate_problem(seed=n + d, dim=d, num_points=n)
@@ -73,3 +68,22 @@ def test_non_power_of_two_mesh_rejected():
     with pytest.raises(ValueError):
         pts, _ = generate_problem(seed=1, dim=3, num_points=64)
         build_global(pts, mesh=make_mesh(3))
+
+
+def test_build_global_gen_structural_identity(mesh8):
+    """Generative global build (VERDICT r2 item 5): shard-local generation
+    must produce the IDENTICAL tree to build_global over the materialized
+    row stream — node ids and coordinates, divisible and non-divisible N."""
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+    from kdtree_tpu.parallel import build_global, build_global_gen
+
+    for n in (256, 251):
+        ref = build_global(generate_points_rowwise(17, 3, n), mesh=mesh8)
+        gen = build_global_gen(17, 3, n, mesh=mesh8)
+        assert gen.n_real == ref.n_real == n
+        np.testing.assert_array_equal(
+            np.asarray(gen.node_gid), np.asarray(ref.node_gid)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(gen.node_coords), np.asarray(ref.node_coords)
+        )
