@@ -49,7 +49,9 @@ def bench_build(kt, n: int, dim: int, nq: int):
 
     _fetch(run(999)[2])  # warmup/compile on a fresh seed
     times, last = [], None
-    for seed in (1, 2, 3):
+    # min over 5 fresh-seed runs: each run is ~0.2 s on TPU while the axon
+    # tunnel adds ~0.1 s of per-dispatch noise, so the min needs samples
+    for seed in (1, 2, 3, 4, 5):
         t0 = time.perf_counter()
         out = run(seed)
         _fetch(out[2])

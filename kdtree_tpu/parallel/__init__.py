@@ -1,4 +1,10 @@
 from kdtree_tpu.parallel.ensemble import ensemble_knn, ensemble_knn_gen
+from kdtree_tpu.parallel.global_exact import (
+    GlobalExactTree,
+    build_global_exact,
+    global_exact_knn,
+    global_exact_query,
+)
 from kdtree_tpu.parallel.global_morton import (
     GlobalMortonForest,
     build_global_morton,
@@ -28,4 +34,8 @@ __all__ = [
     "build_global_morton",
     "global_morton_knn",
     "global_morton_query",
+    "GlobalExactTree",
+    "build_global_exact",
+    "global_exact_knn",
+    "global_exact_query",
 ]

@@ -1,0 +1,474 @@
+"""Scalable EXACT median-split global k-d tree (SURVEY.md §7(b)).
+
+The capability hole the round-2 verdict named: an exact median-split tree
+whose N scales with the mesh. The bitonic ``global_tree`` is exact but
+replicates an O(N) heap per chip; the Morton ``global_morton`` forest scales
+but is not the median-split tree. This engine is both:
+
+- **Top log2(P) levels: true global exact medians.** Each level, every live
+  segment spans a contiguous device group. The segment's exact median (by
+  the same (coordinate, id) composite order as the single-chip build — f32
+  ties break identically) is found by a **distributed radix select**: 32
+  bit-rounds over a monotone u32 image of the axis coordinate, then 31
+  rounds over ids among ties; each round is one ``lax.psum`` of a
+  [segments]-vector, so all segments of a level select simultaneously.
+  The selected medians ARE the single-chip tree's top nodes — verified
+  node-for-node against ``build_jit`` (tests/test_global_exact.py).
+- **One mirror ppermute per level.** After classification against the
+  median, rows that sit in the wrong half of their device group cross to
+  the mirror device (``p ^ half``) in ONE ``lax.ppermute``; rows in the
+  right half stay put. No slot bookkeeping, no all_to_all matrix: total
+  exchanged ≈ log2(P) · N/(2P) rows per device — the "top levels
+  redistribute, rest is chip-local" shape §7(b) promised. Fixed-capacity
+  buffers with overflow detection (uniform data stays ~balanced; heavy
+  skew raises with a retry hint, the same contract as ``global_morton``).
+- **Chip-local exact build below.** After log2(P) levels each device owns
+  exactly one segment (~N/P rows) and builds it with the same
+  ``build_impl`` as the single-chip path — one algorithm core. Padding
+  rows (+inf) follow the ensemble-mode convention; sub-tree medians are
+  medians of the padded local segment (documented deviation — the top
+  L levels are the exact global medians, which is what balance and
+  routing depend on).
+
+Query: replicated queries; each device answers its local subtree exactly
+(AABB-less classic prune via ``_knn_batch``), the P partial k-buffers plus
+the top-heap node points merge through one all_gather + top-k — exact
+because segments partition the point set and top nodes are explicitly
+scanned.
+
+State per chip: O(N/P) rows + a 2P-node replicated top heap. Communication:
+64-ish scalar-vector psum rounds + one ~N/(2P)-row ppermute per top level.
+
+Generative like ``global_morton_knn``: takes (seed, dim, num_points), each
+device draws only its own rows (``kdtree_mpi.cpp:19-41``'s discard trick,
+counter-based); no [N, D] array ever exists.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kdtree_tpu.models.tree import tree_spec
+from kdtree_tpu.ops.build import build_impl, spec_arrays
+from kdtree_tpu.ops.generate import generate_points_shard
+from kdtree_tpu.ops.query import _knn_batch
+
+from .global_morton import _merge_partials
+from .mesh import SHARD_AXIS
+
+DEFAULT_SLACK = 1.6
+
+
+# ---------------------------------------------------------------------------
+# static layout: sizes of the top-level segments (exact split arithmetic)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _top_layout(n: int, p: int) -> Tuple[Tuple[int, ...], ...]:
+    """Per top level, the static segment sizes in position order. Mirrors
+    the reference's split arithmetic (left c//2, median 1, right c-c//2-1,
+    ``kdtree_sequential.cpp:51-56``)."""
+    levels = []
+    sizes = [n]
+    L = p.bit_length() - 1
+    for _ in range(L):
+        levels.append(tuple(sizes))
+        nxt = []
+        for c in sizes:
+            m = c // 2
+            nxt.append(m)
+            nxt.append(max(c - m - 1, 0))
+        sizes = nxt
+    return tuple(levels)
+
+
+def _f32_key(x):
+    """Monotone u32 image of f32 (total order; +inf maps to the top)."""
+    b = lax.bitcast_convert_type(x, jnp.uint32)
+    neg = (b >> 31) == 1
+    return jnp.where(neg, ~b, b | jnp.uint32(0x80000000))
+
+
+def _radix_select(key_u32, tie_i32, valid, seg, k_by_seg, S, axis_name):
+    """Distributed (key, tie) lexicographic k-th smallest per segment.
+
+    key_u32/tie_i32/valid: this device's rows; ``seg``: this device's
+    (static) segment index; ``k_by_seg``: i32[S] replicated 0-based ranks.
+    Returns (med_key u32[S], med_tie i32[S]) replicated on every device.
+    All devices run identical control flow; counts flow through one psum of
+    an [S]-vector per bit round.
+    """
+    onehot = (jnp.arange(S) == seg).astype(jnp.int32)  # [S]
+
+    def count_seg(mask):
+        cnt = jnp.sum(mask.astype(jnp.int32))
+        return lax.psum(cnt * onehot, axis_name)  # [S] per-segment totals
+
+    def select(bits_from, values, candidates, krem):
+        """MSB-first radix select of the krem-th smallest ``values`` among
+        ``candidates``; bit masks are static Python ints (unrolled)."""
+        prefix = jnp.zeros(S, values.dtype)
+        for b in range(bits_from, -1, -1):
+            above = (~((1 << (b + 1)) - 1)) & 0xFFFFFFFF
+            cand0 = (
+                candidates
+                & ((values & values.dtype.type(above)) == (prefix[seg] & values.dtype.type(above)))
+                & (((values >> b) & 1) == 0)
+            )
+            cnt = count_seg(cand0)
+            take1 = krem >= cnt
+            prefix = jnp.where(take1, prefix | values.dtype.type(1 << b), prefix)
+            krem = jnp.where(take1, krem - cnt, krem)
+        return prefix, krem
+
+    med_key, krem = select(31, key_u32, valid, k_by_seg)
+    # rank among exact key ties, by id (ids are unique, >= 0, < 2^31)
+    tie_u = tie_i32.astype(jnp.uint32)
+    eq = valid & (key_u32 == med_key[seg])
+    med_tie, _ = select(30, tie_u, eq, krem)
+    return med_key, med_tie.astype(jnp.int32)
+
+
+def _mirror_exchange(pts, gid, ship, keep, cap: int, half: int,
+                     axis_name: str, p_total: int):
+    """Send ``ship``-marked rows to device ``p ^ half`` in one ppermute;
+    merge ``keep`` rows + received into a same-width buffer. Returns
+    (pts, gid, overflow) where overflow counts rows dropped by EITHER the
+    ship buffer cap or the merge width — both detected, never silent."""
+    W, d = pts.shape
+
+    # pack shipped rows into [cap]
+    ship_rank = jnp.cumsum(ship.astype(jnp.int32)) - 1
+    over_ship = jnp.sum((ship & (ship_rank >= cap)).astype(jnp.int32))
+    slot = jnp.where(ship & (ship_rank < cap), ship_rank, cap)
+    send_pts = jnp.full((cap + 1, d), jnp.inf, pts.dtype).at[slot].set(
+        jnp.where(ship[:, None], pts, jnp.inf), mode="drop"
+    )[:cap]
+    send_gid = jnp.full((cap + 1,), -1, jnp.int32).at[slot].set(
+        jnp.where(ship, gid, -1), mode="drop"
+    )[:cap]
+
+    perm = [(i, i ^ half) for i in range(p_total)]
+    recv_pts = lax.ppermute(send_pts, axis_name, perm)
+    recv_gid = lax.ppermute(send_gid, axis_name, perm)
+
+    # survivors first (stable), then received; compact back to width W
+    all_pts = jnp.concatenate([jnp.where(keep[:, None], pts, jnp.inf), recv_pts])
+    all_gid = jnp.concatenate([jnp.where(keep, gid, -1), recv_gid])
+    order = jnp.argsort(jnp.where(all_gid < 0, 1, 0), stable=True)
+    n_valid = jnp.sum((all_gid >= 0).astype(jnp.int32))
+    over_merge = jnp.maximum(n_valid - W, 0)
+    pts2 = all_pts[order][:W]
+    gid2 = all_gid[order][:W]
+    overflow = lax.psum(over_ship + over_merge, axis_name)
+    return pts2, gid2, overflow
+
+
+@jax.tree_util.register_pytree_node_class
+class GlobalExactTree:
+    """The scalable exact-median tree: a replicated 2P-node top heap (true
+    global medians) over P chip-local classic k-d trees.
+
+    Stacked leading-device-axis arrays (sharded in live use; dense after a
+    checkpoint load): local_pts/node_point/split_val are the per-device
+    ``KDTree`` columns, local_gid maps local rows to global point ids.
+    """
+
+    def __init__(self, top_pts, top_gid, local_pts, local_node, local_split,
+                 local_gid, num_points, seed):
+        self.top_pts = top_pts        # [Htop, D] node coordinates (inf if absent)
+        self.top_gid = top_gid        # [Htop] global ids (-1 if absent)
+        self.local_pts = local_pts    # [P, W, D]
+        self.local_node = local_node  # [P, H]
+        self.local_split = local_split  # [P, H]
+        self.local_gid = local_gid    # [P, W]
+        self.num_points = num_points
+        self.seed = seed
+
+    @property
+    def devices(self) -> int:
+        return self.local_pts.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.local_pts.shape[2]
+
+    @property
+    def n_real(self) -> int:
+        return self.num_points
+
+    def tree_flatten(self):
+        return (
+            (self.top_pts, self.top_gid, self.local_pts, self.local_node,
+             self.local_split, self.local_gid),
+            (self.num_points, self.seed),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __repr__(self):
+        return (
+            f"GlobalExactTree(n={self.num_points}, devices={self.devices}, "
+            f"dim={self.dim})"
+        )
+
+
+def _build_local_body(start, seed, structure, *, dim, rows, width, num_points,
+                      p, cap, htop, num_levels, axis_name, med_ks):
+    """SPMD body: generate own rows -> L levels of (select median, mirror
+    exchange) -> local classic build."""
+    L = p.bit_length() - 1
+    W = width
+    # generate this device's `rows` real rows into a W-wide work buffer:
+    # the extra width is headroom for exchange-occupancy fluctuation
+    # (binomial ~sqrt(rows) per level), never real data
+    pts = generate_points_shard(seed[0], dim, start[0], W)
+    gid = (start[0] + jnp.arange(W)).astype(jnp.int32)
+    valid0 = (jnp.arange(W) < rows) & (gid < num_points)
+    pts = jnp.where(valid0[:, None], pts, jnp.inf)
+    gid = jnp.where(valid0, gid, -1)
+
+    rank = lax.axis_index(axis_name)
+    top_pts = jnp.full((htop, dim), jnp.inf, pts.dtype)
+    top_gid = jnp.full((htop,), -1, jnp.int32)
+    overflow = jnp.int32(0)
+
+    for lvl in range(L):
+        S = 1 << lvl
+        seg = rank >> (L - lvl)  # high bits of rank = segment in position order
+        axis = lvl % dim
+        k_by_seg = jnp.asarray(med_ks[lvl], jnp.int32)  # [S] static medians
+        key = _f32_key(pts[:, axis])
+        valid = gid >= 0
+        med_key, med_gid = _radix_select(
+            key, gid, valid, seg, k_by_seg, S, axis_name
+        )
+        # emit this level's nodes into the replicated top heap (the median
+        # row exists on exactly ONE device; everyone else contributes zeros,
+        # so the psum lands each node's coords/gid exactly once)
+        is_med = valid & (key == med_key[seg]) & (gid == med_gid[seg])
+        node = (S - 1) + seg  # heap id: level-order complete numbering
+        contrib_p = jnp.where(is_med[:, None], pts, 0.0).sum(axis=0)  # [D]
+        contrib_g = jnp.where(is_med, gid + 1, 0).sum()
+        tp = lax.psum(jnp.zeros((htop, dim), pts.dtype).at[node].set(contrib_p),
+                      axis_name)
+        tg = lax.psum(jnp.zeros((htop,), jnp.int32).at[node].set(contrib_g),
+                      axis_name)
+        top_pts = jnp.where((tg > 0)[:, None], tp, top_pts)
+        top_gid = jnp.where(tg > 0, tg - 1, top_gid)
+
+        # classify against (med_key, med_gid), lexicographic; the consumed
+        # median is neither kept nor shipped — it lives in the top heap now
+        mk, mg = med_key[seg], med_gid[seg]
+        left = valid & ((key < mk) | ((key == mk) & (gid < mg)))
+        right = valid & ~left & ~is_med
+        half = 1 << (L - lvl - 1)  # device-distance to the mirror half
+        in_left_half = (rank & half) == 0
+        ship = jnp.where(in_left_half, right, left)
+        keep = valid & ~ship & ~is_med
+        pts, gid, ov = _mirror_exchange(
+            pts, gid, ship, keep, cap, half, axis_name, p
+        )
+        overflow = overflow + ov
+
+    tree = build_impl(pts, *structure, num_levels=num_levels)
+    return (
+        top_pts,
+        top_gid,
+        tree.points[None],
+        tree.node_point[None],
+        tree.split_val[None],
+        gid[None],
+        overflow[None],
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "dim", "rows", "width", "num_points", "cap",
+                     "htop", "num_levels"),
+)
+def _build_jit(starts, seed, structure, mesh, dim, rows, width, num_points,
+               cap, htop, num_levels):
+    p = mesh.shape[SHARD_AXIS]
+    med_ks = tuple(
+        tuple(c // 2 for c in sizes) for sizes in _top_layout(num_points, p)
+    )
+    fn = jax.shard_map(
+        functools.partial(
+            _build_local_body,
+            dim=dim, rows=rows, width=width, num_points=num_points, p=p,
+            cap=cap, htop=htop, num_levels=num_levels, axis_name=SHARD_AXIS,
+            med_ks=med_ks,
+        ),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(None), P(None)),
+        out_specs=(
+            P(None, None), P(None), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS), P(SHARD_AXIS), P(None),
+        ),
+        check_vma=False,
+    )
+    return fn(starts, seed, structure)
+
+
+def build_global_exact(
+    seed: int,
+    dim: int,
+    num_points: int,
+    mesh: Mesh | None = None,
+    slack: float = DEFAULT_SLACK,
+) -> GlobalExactTree:
+    """Build the scalable exact-median global tree; generative (shard-local
+    row generation, no [N, D] anywhere). P must be a power of two.
+
+    Raises RuntimeError on mirror-exchange capacity overflow (heavily
+    skewed data; retry with higher ``slack``).
+    """
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    p = mesh.shape[SHARD_AXIS]
+    if p & (p - 1):
+        raise ValueError(f"global-exact needs a power-of-2 device count, got {p}")
+    rows = -(-num_points // p)
+    # work width: per-device occupancy after an exchange is mean `rows` with
+    # ~sqrt(rows) binomial fluctuation per level — give it ~5-sigma headroom
+    # (tail cases are detected as overflow and retried with higher slack)
+    width = rows + max(16, int(4 * rows ** 0.5 * max(slack / DEFAULT_SLACK, 1.0)))
+    cap = max(1, min(width, int(width / 2 * slack)))
+    htop = max(p - 1, 1)
+    structure = spec_arrays(width, dim)
+    num_levels = tree_spec(width).num_levels
+    starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
+    (top_pts, top_gid, lpts, lnode, lsplit, lgid, overflow) = _build_jit(
+        starts, jnp.asarray([seed], jnp.int32), structure, mesh, dim, rows,
+        width, num_points, cap, htop, num_levels,
+    )
+    if int(overflow[0]) > 0:
+        raise RuntimeError(
+            f"mirror-exchange capacity overflow ({int(overflow[0])} rows); "
+            f"retry with slack > {slack}"
+        )
+    return GlobalExactTree(
+        top_pts, top_gid, lpts, lnode, lsplit, lgid,
+        num_points=num_points, seed=seed,
+    )
+
+
+def _fold_top(md, mi, top_pts, top_gid, queries, k: int):
+    """Fold the top-heap node points (which live in no local tree) into
+    merged (d2, id) buffers: dense distances to the tiny [Htop] heap, then
+    one more top-k + the framework-standard stable (distance, id) sort."""
+    diff = queries[:, None, :] - top_pts[None]  # [Q, Htop, D]
+    td2 = jnp.sum(diff * diff, axis=-1)
+    td2 = jnp.where((top_gid >= 0)[None, :], td2, jnp.inf)
+    cat_d = jnp.concatenate([md, td2], axis=1)
+    cat_i = jnp.concatenate(
+        [mi, jnp.broadcast_to(top_gid[None], td2.shape)], axis=1
+    )
+    kk = min(k, cat_d.shape[1])
+    neg, sel = lax.top_k(-cat_d, kk)
+    return lax.sort((-neg, jnp.take_along_axis(cat_i, sel, axis=1)),
+                    num_keys=2, is_stable=True)
+
+
+def _query_local_body(top_pts, top_gid, lpts, lnode, lsplit, lgid, queries,
+                      *, k, num_levels, axis_name):
+    d2, li = _knn_batch(lnode[0], lpts[0], queries, k, num_levels)
+    gi = jnp.where(li >= 0, lgid[0][jnp.maximum(li, 0)], -1)
+    d2 = jnp.where(gi >= 0, d2, jnp.inf)
+    all_d = lax.all_gather(d2, axis_name)  # [P, Q, k]
+    all_i = lax.all_gather(gi, axis_name)
+    md, mi = _merge_partials(all_d, all_i, k)
+    return _fold_top(md, mi, top_pts, top_gid, queries, k)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "num_levels"))
+def _query_jit(tree_arrays, queries, mesh, k, num_levels):
+    fn = jax.shard_map(
+        functools.partial(
+            _query_local_body, k=k, num_levels=num_levels,
+            axis_name=SHARD_AXIS,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(None, None), P(None), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS), P(SHARD_AXIS), P(None, None),
+        ),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    return fn(*tree_arrays, queries)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "num_levels"))
+def _query_meshfree_jit(top_pts, top_gid, lpts, lnode, lsplit, lgid, queries,
+                        k, num_levels):
+    """vmap-over-devices query for a checkpointed tree on other hardware."""
+
+    def one_device(pts_, node_, gid_):
+        d2, li = _knn_batch(node_, pts_, queries, k, num_levels)
+        gi = jnp.where(li >= 0, gid_[jnp.maximum(li, 0)], -1)
+        return jnp.where(gi >= 0, d2, jnp.inf), gi
+
+    all_d, all_i = jax.vmap(one_device)(lpts, lnode, lgid)
+    md, mi = _merge_partials(all_d, all_i, k)
+    return _fold_top(md, mi, top_pts, top_gid, queries, k)
+
+
+def global_exact_query(
+    tree: GlobalExactTree,
+    queries: jax.Array,
+    k: int = 1,
+    mesh: Mesh | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN against the scalable exact-median tree. Falls back to a
+    mesh-free vmap query when the hardware doesn't match ``tree.devices``
+    (checkpoint portability). Returns (d2 f32[Q, k], ids i32[Q, k])."""
+    rows = tree.local_pts.shape[1]
+    num_levels = tree_spec(rows).num_levels
+    k = min(k, tree.num_points)
+    if mesh is None and len(jax.devices()) >= tree.devices:
+        from .mesh import make_mesh
+
+        mesh = make_mesh(tree.devices)
+    if mesh is not None and mesh.shape[SHARD_AXIS] == tree.devices:
+        return _query_jit(
+            (tree.top_pts, tree.top_gid, tree.local_pts, tree.local_node,
+             tree.local_split, tree.local_gid),
+            queries, mesh, k, num_levels,
+        )
+    return _query_meshfree_jit(
+        tree.top_pts, tree.top_gid, tree.local_pts, tree.local_node,
+        tree.local_split, tree.local_gid, queries, k, num_levels,
+    )
+
+
+def global_exact_knn(
+    seed: int,
+    dim: int,
+    num_points: int,
+    queries: jax.Array,
+    k: int = 1,
+    mesh: Mesh | None = None,
+    slack: float = DEFAULT_SLACK,
+) -> Tuple[jax.Array, jax.Array]:
+    """Build + query in one call (generative, like ``global_morton_knn``)."""
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    tree = build_global_exact(seed, dim, num_points, mesh=mesh, slack=slack)
+    return global_exact_query(tree, queries, k=k, mesh=mesh)

@@ -19,6 +19,7 @@ def _registry():
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree
     from kdtree_tpu.ops.morton import MortonTree
+    from kdtree_tpu.parallel.global_exact import GlobalExactTree
     from kdtree_tpu.parallel.global_morton import GlobalMortonForest
     from kdtree_tpu.parallel.global_tree import GlobalKDTree
 
@@ -28,6 +29,7 @@ def _registry():
         "morton": MortonTree,
         "global": GlobalKDTree,
         "global-morton": GlobalMortonForest,
+        "global-exact": GlobalExactTree,
     }
 
 

@@ -152,6 +152,14 @@ def _build_index(points, engine: str, mesh_devices: int | None = None,
         return build_global_morton(
             seed, dim, num_points, mesh=make_mesh(mesh_devices)
         )
+    if engine == "global-exact":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_exact import build_global_exact
+
+        seed, dim, num_points = problem
+        return build_global_exact(
+            seed, dim, num_points, mesh=make_mesh(mesh_devices)
+        )
     raise SystemExit(f"engine {engine!r} has no split build phase")
 
 
@@ -187,6 +195,13 @@ def _query_index(index, queries, k: int, engine: str,
         from kdtree_tpu.parallel.global_morton import global_morton_query
 
         return global_morton_query(
+            index, queries, k=k, mesh=make_mesh(mesh_devices)
+        )
+    if engine == "global-exact":
+        from kdtree_tpu.parallel import make_mesh
+        from kdtree_tpu.parallel.global_exact import global_exact_query
+
+        return global_exact_query(
             index, queries, k=k, mesh=make_mesh(mesh_devices)
         )
     raise SystemExit(f"engine {engine!r} has no split query phase")
@@ -233,11 +248,11 @@ def cmd_harness(args) -> None:
     _validate_input(seed, dim, num_points)
 
     engine = _resolve_engine(args.engine, dim)
-    if engine == "global-morton":
+    if engine in ("global-morton", "global-exact"):
         # generative engine: the point set is the threefry row stream,
         # shard-generated inside the build — never materialized here
         if args.generator != "threefry":
-            print("note: global-morton defines its points by the threefry "
+            print(f"note: {engine} defines its points by the threefry "
                   "row stream (shard-local generation); using threefry "
                   "queries", file=sys.stderr)
         from kdtree_tpu.ops.generate import generate_queries
@@ -263,7 +278,7 @@ def cmd_bench(args) -> None:
     from kdtree_tpu.utils.timing import PhaseTimer
 
     engine = _resolve_engine(args.engine, args.dim)
-    fused_gen = engine == "global-morton"  # generation IS part of the build
+    fused_gen = engine in ("global-morton", "global-exact")  # gen is fused into the build
     fused_bq = engine == "ensemble"  # one SPMD program by design
 
     def run(seed: int, timer: PhaseTimer | None):
@@ -333,7 +348,7 @@ def _build_tree_for_engine(points, engine: str, mesh_devices: int | None,
         from kdtree_tpu.ops.morton import build_morton
 
         return build_morton(points)
-    if engine in ("bucket", "tree", "global", "global-morton"):
+    if engine in ("bucket", "tree", "global", "global-morton", "global-exact"):
         return _build_index(points, engine, mesh_devices, problem=problem)
     raise SystemExit(f"engine {engine!r} does not produce a checkpointable tree")
 
@@ -343,6 +358,9 @@ def _tree_knn(tree, queries, k: int):
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn
     from kdtree_tpu.ops.morton import MortonTree, morton_knn
+    from kdtree_tpu.parallel.global_exact import (
+        GlobalExactTree, global_exact_query,
+    )
     from kdtree_tpu.parallel.global_morton import (
         GlobalMortonForest, global_morton_query,
     )
@@ -352,6 +370,9 @@ def _tree_knn(tree, queries, k: int):
         # falls back to the mesh-free query when the local device count
         # doesn't match the forest's build mesh
         return global_morton_query(tree, queries, k=k)
+    if isinstance(tree, GlobalExactTree):
+        # same mesh-free portability contract as the Morton forest
+        return global_exact_query(tree, queries, k=k)
     if isinstance(tree, MortonTree):
         return morton_knn(tree, queries, k=k)
     if isinstance(tree, BucketKDTree):
@@ -367,10 +388,10 @@ def _tree_knn(tree, queries, k: int):
 def cmd_build(args) -> None:
     from kdtree_tpu.utils.checkpoint import save_tree
 
-    if args.engine == "global-morton":
+    if args.engine in ("global-morton", "global-exact"):
         # generative: never materialize [N, D]; provenance = threefry rows
         if args.generator != "threefry":
-            print("note: global-morton defines its points by the threefry "
+            print(f"note: {args.engine} defines its points by the threefry "
                   "row stream (shard-local generation); --generator "
                   f"{args.generator} does not apply", file=sys.stderr)
         tree = _build_tree_for_engine(
@@ -421,12 +442,14 @@ def main(argv=None) -> None:
     p.add_argument("--engine",
                    choices=["auto", "morton", "tiled", "tree", "bucket",
                             "bruteforce", "ensemble", "global",
-                            "global-morton"],
+                            "global-morton", "global-exact"],
                    default="auto",
                    help="tiled = Morton tree + Hilbert-tiled batched scan "
                         "(large query counts); global-morton = the scale "
                         "engine (shard-local generation + one all_to_all "
-                        "sample-sort partition)")
+                        "sample-sort partition); global-exact = the scalable "
+                        "exact-median tree (distributed radix-select medians "
+                        "for the top log2 P levels, chip-local below)")
     p.add_argument("--devices", type=int, default=None,
                    help="device count for sharded engines (default: all)")
     sub = p.add_subparsers(dest="cmd", required=True)
