@@ -10,10 +10,12 @@ Covers both reference entry modes (SURVEY.md C10) plus framework subcommands:
 - ``bench``: per-phase timing (gen/build/query) with compile separated.
 - ``build`` / ``query``: build-and-save / load-and-query (npz checkpoint).
 
-Engine selection is honest about hardware: in high D the k-d prune almost
-never fires (the curse of dimensionality that masked the reference's sort bug,
-SURVEY.md §3.5), so ``auto`` uses the MXU brute-force path for D > 16 and the
-tree for low D. All engines are exact, so results agree.
+Engine selection is honest about hardware: ``auto`` picks by measured
+crossovers (see ``_resolve_engine``) — MXU brute force in high D (the
+curse-of-dimensionality regime that masked the reference's sort bug,
+SURVEY.md §3.5) and for small scan jobs, the tiled Pallas engine for dense
+low-D query batches, the Morton tree otherwise. All engines are exact, so
+results agree.
 """
 
 from __future__ import annotations
@@ -110,10 +112,31 @@ def _generate_queries(seed: int, dim: int, num_points: int, generator: str):
     return generate_queries(seed, dim, NUM_QUERIES)
 
 
-def _resolve_engine(engine: str, dim: int) -> str:
-    if engine == "auto":
-        return "morton" if dim <= AUTO_TREE_DIM_MAX else "bruteforce"
-    return engine
+def _resolve_engine(engine: str, dim: int, q: int | None = None,
+                    n: int | None = None) -> str:
+    """Q-aware engine choice, grounded in v5e measurements (round 3,
+    n=1M..16M, exactness identical across engines so only speed differs):
+
+    - high D: the k-d prune is dead (curse of dimensionality), and the MXU
+      brute scan beat the DFS tree by 64x at D=16 / Q=4096 — brute force.
+    - dense low-D batches (Q >= n/64): the Hilbert-tiled Pallas engine won
+      4x over brute at the north-star shape (1M queries, 16M pts, D=3);
+      sparse batches invert (tiled lost 15x at Q=4096 over 1M pts) because
+      each sparse tile's box covers most buckets — so density gates it.
+    - small jobs (Q*n*D scan work under ~2e13 madds, i.e. sub-second):
+      brute force; a tree build cannot pay for itself.
+    - remainder (big sparse low-D): the Morton DFS tree.
+    """
+    if engine != "auto":
+        return engine
+    if dim > AUTO_TREE_DIM_MAX:
+        return "bruteforce"
+    if q is not None and n is not None:
+        if q >= 512 and q * 64 >= n and dim <= 6:
+            return "tiled"
+        if q * n * dim <= 2e13:
+            return "bruteforce"
+    return "morton"
 
 
 def _build_index(points, engine: str, mesh_devices: int | None = None,
@@ -211,7 +234,8 @@ def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None
            problem=None):
     """Returns (d2[Q,k], idx[Q,k]) by the chosen engine."""
     dim = queries.shape[1]
-    engine = _resolve_engine(engine, dim)
+    n = points.shape[0] if points is not None else (problem[2] if problem else None)
+    engine = _resolve_engine(engine, dim, q=queries.shape[0], n=n)
     if engine == "ensemble":
         # deliberately fused: local build + query + merge is ONE SPMD program
         # (the reference MPI semantics, kdtree_mpi.cpp:204-253)
@@ -247,7 +271,7 @@ def cmd_harness(args) -> None:
         dim, num_points = HARNESS_DIM, HARNESS_NUM_POINTS
     _validate_input(seed, dim, num_points)
 
-    engine = _resolve_engine(args.engine, dim)
+    engine = _resolve_engine(args.engine, dim, q=NUM_QUERIES, n=num_points)
     if engine in ("global-morton", "global-exact"):
         # generative engine: the point set is the threefry row stream,
         # shard-generated inside the build — never materialized here
@@ -277,7 +301,7 @@ def cmd_bench(args) -> None:
 
     from kdtree_tpu.utils.timing import PhaseTimer
 
-    engine = _resolve_engine(args.engine, args.dim)
+    engine = _resolve_engine(args.engine, args.dim, q=NUM_QUERIES, n=args.n)
     fused_gen = engine in ("global-morton", "global-exact")  # gen is fused into the build
     fused_bq = engine == "ensemble"  # one SPMD program by design
 
