@@ -55,8 +55,16 @@ def _block_d2_matmul(queries: jax.Array, ptile: jax.Array) -> jax.Array:
     return jnp.maximum(qn + pn[None, :] - 2.0 * cross, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "tile", "method"))
-def _knn_scan(points, queries, k: int, tile: int, method: str):
+@functools.partial(jax.jit, static_argnames=("k", "tile", "method", "axis_name"))
+def _knn_scan(points, queries, k: int, tile: int, method: str,
+              axis_name: str | None = None):
+    """Streaming top-k scan. With ``axis_name`` set, each block's distances
+    are PARTIAL sums (the caller holds a feature-axis column shard) and one
+    psum over the mesh completes them — the D-sharded TP analog
+    (kdtree_tpu.parallel.dsharded) reuses this exact skeleton; only
+    method='exact' composes with partial sums (the matmul refine pass
+    rescans columns it doesn't hold)."""
+    assert axis_name is None or method == "exact"
     n, d = points.shape
     q = queries.shape[0]
     block = _block_d2_exact if method == "exact" else _block_d2_matmul
@@ -72,7 +80,10 @@ def _knn_scan(points, queries, k: int, tile: int, method: str):
     def step(carry, ptile):
         best_d, best_i, base = carry
         real = base + jnp.arange(tile) < n  # positional mask, not data-dependent
-        d2 = jnp.where(real[None, :], block(queries, ptile), jnp.inf)
+        d2_blk = block(queries, ptile)
+        if axis_name is not None:
+            d2_blk = lax.psum(d2_blk, axis_name)
+        d2 = jnp.where(real[None, :], d2_blk, jnp.inf)
         # the matmul identity qn+pn-2q.p cancels catastrophically when |x|^2
         # >> d^2 (clustered data far from the origin: f32 absolute error
         # ~eps*|x|^2 can exceed the NN distance). So the MXU pass is only a

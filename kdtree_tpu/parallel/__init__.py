@@ -1,3 +1,4 @@
+from kdtree_tpu.parallel.dsharded import dsharded_knn
 from kdtree_tpu.parallel.ensemble import ensemble_knn, ensemble_knn_gen
 from kdtree_tpu.parallel.global_exact import (
     GlobalExactTree,
@@ -21,6 +22,7 @@ from kdtree_tpu.parallel.global_tree import (
 from kdtree_tpu.parallel.mesh import SHARD_AXIS, make_mesh
 
 __all__ = [
+    "dsharded_knn",
     "ensemble_knn",
     "ensemble_knn_gen",
     "make_mesh",

@@ -1,0 +1,105 @@
+"""Feature-axis (D) sharded brute-force k-NN — the TP analog.
+
+SURVEY.md §2's parallelism inventory names one tensor-parallel-shaped
+opportunity in this domain: sharding the FEATURE axis for high-dimensional
+distance work (the 128-D grading configuration, ``Utility.cpp:98-99``).
+Squared Euclidean distance is a sum over coordinates, so it partitions
+perfectly across a mesh: each device holds a [N, D/P] column block of the
+points (and the matching query columns), computes partial squared
+distances for its columns, and ONE ``lax.psum`` over the mesh yields exact
+full-dimensional distances — the same additive-partial-sums structure as
+tensor-parallel matmul shards. Selection (top-k) then runs replicated.
+
+The scan itself IS the single-chip brute-force engine
+(:func:`kdtree_tpu.ops.bruteforce._knn_scan` with ``axis_name`` set): one
+skeleton, one tile/mask/merge implementation, two deployment shapes.
+
+When to use it: D large enough that a single chip's HBM can't hold [N, D]
+(N x 128-D f32 at billions of rows), or to put P chips' bandwidth behind
+one scan. Per-device state is O(N*D/P + Q*D/P); communication is one
+[Q, tile]-partials psum per point tile, riding ICI.
+
+Like every engine here it is exact (direct subtraction per column block —
+no matmul-identity cancellation), and oracle-tested on the virtual
+8-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kdtree_tpu.ops.bruteforce import _knn_scan
+
+from .mesh import SHARD_AXIS
+
+
+def _local_body(points_cols, queries_cols, *, n: int, k: int, tile: int,
+                axis_name: str):
+    best_d, best_i = _knn_scan(
+        points_cols, queries_cols, k, tile, "exact", axis_name
+    )
+    # framework-standard stable (distance, id) tie order
+    return lax.sort((best_d, best_i), num_keys=2, is_stable=True)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "tile"))
+def _dsharded_jit(points, queries, mesh, k, tile):
+    n = points.shape[0]
+    p = mesh.shape[SHARD_AXIS]
+    dpad = (-points.shape[1]) % p
+    if dpad:
+        # zero columns contribute nothing to any distance; padding inside
+        # the jit lets XLA shard it instead of materializing padded copies
+        points = jnp.concatenate(
+            [points, jnp.zeros((n, dpad), points.dtype)], axis=1
+        )
+        queries = jnp.concatenate(
+            [queries, jnp.zeros((queries.shape[0], dpad), queries.dtype)],
+            axis=1,
+        )
+    fn = jax.shard_map(
+        functools.partial(
+            _local_body, n=n, k=k, tile=tile, axis_name=SHARD_AXIS
+        ),
+        mesh=mesh,
+        in_specs=(P(None, SHARD_AXIS), P(None, SHARD_AXIS)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )
+    return fn(points, queries)
+
+
+def dsharded_knn(
+    points: jax.Array,
+    queries: jax.Array,
+    k: int = 1,
+    mesh: Mesh | None = None,
+    tile: int = 1 << 16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN with the FEATURE axis sharded over the mesh.
+
+    Args:
+      points: f32[N, D]; the D axis is partitioned across devices (padded
+        to a multiple of P with zero columns inside the jit).
+      queries: f32[Q, D], sharded the same way.
+      k: neighbors per query (clamped to N).
+      mesh: 1-D mesh over ``"shards"`` (default: all devices).
+      tile: point rows per scan step (bounds the [Q, tile] block).
+
+    Returns:
+      (dists_sq f32[Q, k], indices i32[Q, k]) ascending, replicated.
+    """
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh()
+    n = points.shape[0]
+    k = min(k, n)
+    tile = min(tile, max(k, ((n + 127) // 128) * 128))
+    return _dsharded_jit(points, queries, mesh, k, tile)
