@@ -47,83 +47,116 @@ def _scan_kernel(
     # per-tile SMEM blocks (a scalar-prefetch [T, C] array would need the
     # WHOLE candidate table in the ~1MB SMEM; a (1, C) block per grid step
     # streams in a few hundred bytes instead)
-    cand_ref,  # i32[1, 1, C] SMEM block
-    lb_ref,  # f32[1, 1, C] SMEM block
+    cand_ref,  # i32[1, 1, Cp] SMEM block (Cp = V-padded candidate count)
+    lb_ref,  # f32[1, 1, Cp] SMEM block
     # array inputs
     tqT_ref,  # f32[1, D, TQp] VMEM block (tile queries, transposed)
-    ptsT_hbm,  # f32[NBP, D, B] ANY (manual DMA)
+    ptsT_hbm,  # f32[NBP, 1, D*B] ANY (manual DMA; flat [D, B] rows —
+    #           lane slices at d*B are 128-aligned for any D, which the
+    #           [NBP, D, B] layout is NOT when D isn't sublane-tile-sized)
     gid_hbm,  # i32[NBP, 1, B] ANY (manual DMA)
     # outputs
     out_d_ref,  # f32[1, TQp, k]
     out_i_ref,  # i32[1, TQp, k]
     # scratch
-    pbuf,  # f32[2, D, B]
-    gbuf,  # i32[2, 1, B]
-    sems,  # DMA sems [2, 2]
-    work_d,  # f32[TQp, W]
+    pbuf,  # f32[2, V, 1, D*B]
+    gbuf,  # i32[2, V, 1, B]
+    sems,  # DMA sems [2, V, 2]
+    work_d,  # f32[TQp, W]  (W >= V*B + k)
     work_i,  # i32[TQp, W]
+    *,
+    V: int,
 ):
-    C = cand_ref.shape[2]
+    """Candidates are walked in GROUPS of V buckets: V DMAs issue together
+    and one k-extraction fold covers V*B candidates. Measured at the
+    north-star shape with B=128 this was throughput-NEUTRAL (the scan is
+    bound by per-candidate DMA/scalar overhead, not the fold — see
+    DEFAULT_V), so V defaults to 1; the grouping stays for shapes where
+    folds dominate (re-measure before relying on it, especially at larger
+    B where fold cost doubles). Early exit checks the group's first
+    (lowest) lower bound; in-group padding (cand -1) is masked to +inf
+    before the fold."""
+    Cp = cand_ref.shape[2]
+    G = Cp // V  # number of groups
     tqp, k = out_d_ref.shape[1], out_d_ref.shape[2]
-    D = pbuf.shape[1]
-    B = pbuf.shape[2]
+    D = tqT_ref.shape[1]
+    B = gbuf.shape[3]
     W = work_d.shape[1]
 
     out_d_ref[0] = jnp.full((tqp, k), jnp.inf, jnp.float32)
     out_i_ref[0] = jnp.full((tqp, k), -1, jnp.int32)
-    # constant work-buffer tail (lanes >= B + k never hold candidates)
+    # constant work-buffer tail (lanes >= V*B + k never hold candidates)
     work_d[...] = jnp.full((tqp, W), jnp.inf, jnp.float32)
     work_i[...] = jnp.full((tqp, W), -1, jnp.int32)
 
-    def dmas(c, slot):
-        b = jnp.maximum(cand_ref[0, 0, c], 0)  # padding never folds; clamp for DMA
+    def dmas(g, v, slot):
+        b = jnp.maximum(cand_ref[0, 0, g * V + v], 0)  # clamp padding for DMA
         return (
-            pltpu.make_async_copy(ptsT_hbm.at[b], pbuf.at[slot], sems.at[slot, 0]),
-            pltpu.make_async_copy(gid_hbm.at[b], gbuf.at[slot], sems.at[slot, 1]),
+            pltpu.make_async_copy(
+                ptsT_hbm.at[b], pbuf.at[slot, v], sems.at[slot, v, 0]
+            ),
+            pltpu.make_async_copy(
+                gid_hbm.at[b], gbuf.at[slot, v], sems.at[slot, v, 1]
+            ),
         )
 
-    def start(c, slot):
-        cp, cg = dmas(c, slot)
-        cp.start()
-        cg.start()
+    def start_group(g, slot):
+        for v in range(V):
+            cp, cg = dmas(g, v, slot)
+            cp.start()
+            cg.start()
 
-    def wait(c, slot):
-        cp, cg = dmas(c, slot)
-        cp.wait()
-        cg.wait()
+    def wait_group(g, slot):
+        for v in range(V):
+            cp, cg = dmas(g, v, slot)
+            cp.wait()
+            cg.wait()
 
-    start(0, 0)
+    start_group(0, 0)
     lanes = lax.broadcasted_iota(jnp.int32, (tqp, W), 1)
 
-    def cond(c):
+    def cond(g):
         worst = jnp.max(out_d_ref[0, :, k - 1])
-        return (c < C) & (lb_ref[0, 0, c] < worst)
+        return (g < G) & (lb_ref[0, 0, g * V] < worst)
 
-    def body(c):
-        slot = lax.rem(c, 2)
+    def body(g):
+        slot = lax.rem(g, 2)
 
-        @pl.when(c + 1 < C)
+        @pl.when(g + 1 < G)
         def _():
-            start(c + 1, lax.rem(c + 1, 2))
+            start_group(g + 1, lax.rem(g + 1, 2))
 
-        wait(c, slot)
+        wait_group(g, slot)
 
-        acc = jnp.zeros((tqp, B), jnp.float32)
-        for d in range(D):
-            qd = tqT_ref[0, d, :].reshape(tqp, 1)
-            pd = pbuf[slot, d, :].reshape(1, B)
-            diff = qd - pd
-            acc = acc + diff * diff
+        best = jnp.full((tqp,), jnp.inf, jnp.float32)
+        accs = []
+        for v in range(V):
+            acc = jnp.zeros((tqp, B), jnp.float32)
+            for d in range(D):
+                qd = tqT_ref[0, d, :].reshape(tqp, 1)
+                pd = pbuf[slot, v, 0, d * B : (d + 1) * B].reshape(1, B)
+                diff = qd - pd
+                acc = acc + diff * diff
+            # in-group padding buckets must never compete
+            pad = cand_ref[0, 0, g * V + v] < 0
+            acc = jnp.where(pad, jnp.inf, acc)
+            accs.append(acc)
+            best = jnp.minimum(best, jnp.min(acc, axis=1))
 
         kth = out_d_ref[0, :, k - 1]
-        need = jnp.any(jnp.min(acc, axis=1) < kth)
+        need = jnp.any(best < kth)
 
+        # work-buffer stores happen ONLY when a fold fires — a skipped
+        # bucket group costs just the register accs + one vector min
         @pl.when(need)
         def _():
-            work_d[:, :B] = acc
-            work_i[:, :B] = jnp.broadcast_to(gbuf[slot, 0, :].reshape(1, B), (tqp, B))
-            work_d[:, B : B + k] = out_d_ref[0]
-            work_i[:, B : B + k] = out_i_ref[0]
+            for v in range(V):
+                work_d[:, v * B : (v + 1) * B] = accs[v]
+                work_i[:, v * B : (v + 1) * B] = jnp.broadcast_to(
+                    gbuf[slot, v, 0, :].reshape(1, B), (tqp, B)
+                )
+            work_d[:, V * B : V * B + k] = out_d_ref[0]
+            work_i[:, V * B : V * B + k] = out_i_ref[0]
             wd = work_d[...]
             wi = work_i[...]
             for j in range(k):
@@ -136,33 +169,45 @@ def _scan_kernel(
                 )
                 wd = jnp.where(onehot, jnp.inf, wd)
 
-        return c + 1
+        return g + 1
 
-    c_stop = lax.while_loop(cond, body, jnp.int32(0))
+    g_stop = lax.while_loop(cond, body, jnp.int32(0))
 
-    # the prologue (c=0) or the last body iteration's prefetch (c_stop) may
-    # have left a DMA in flight that no iteration waited on; a kernel must
-    # not exit with outstanding DMAs
-    @pl.when(c_stop < C)
+    # the prologue (g=0) or the last body iteration's prefetch (g_stop) may
+    # have left a DMA group in flight that no iteration waited on; a kernel
+    # must not exit with outstanding DMAs
+    @pl.when(g_stop < G)
     def _():
-        wait(c_stop, lax.rem(c_stop, 2))
+        wait_group(g_stop, lax.rem(g_stop, 2))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "interpret"))
-def _scan_tiles_fused_impl(tqT, cand, lb, ptsT, gid3, k: int, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("k", "V", "interpret"))
+def _scan_tiles_fused_impl(tqT, cand, lb, ptsT, gid3, k: int, V: int,
+                           interpret: bool):
     T, D, tqp = tqT.shape
-    C = cand.shape[1]
-    B = ptsT.shape[2]
-    W = _round_up(B + k, _LANE)
+    B = gid3.shape[2]
+    W = _round_up(V * B + k, _LANE)
+    # pad the candidate axis to a multiple of V (-1 / +inf = the standard
+    # padding encoding; in-group pads are masked, whole-pad groups never
+    # run because their first lb is +inf)
+    cpad = (-cand.shape[1]) % V
+    if cpad:
+        cand = jnp.concatenate(
+            [cand, jnp.full((T, cpad), -1, cand.dtype)], axis=1
+        )
+        lb = jnp.concatenate(
+            [lb, jnp.full((T, cpad), jnp.inf, lb.dtype)], axis=1
+        )
+    Cp = cand.shape[1]
 
     return pl.pallas_call(
-        _scan_kernel,
+        functools.partial(_scan_kernel, V=V),
         grid=(T,),
         in_specs=[
-            # [T, 1, C] with a (1, 1, C) block: the TPU lowering requires
+            # [T, 1, Cp] with a (1, 1, Cp) block: the TPU lowering requires
             # the last two block dims to be full (or (8,128)-aligned)
-            pl.BlockSpec((1, 1, C), lambda t: (t, 0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, C), lambda t: (t, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, Cp), lambda t: (t, 0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, Cp), lambda t: (t, 0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, D, tqp), lambda t: (t, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -176,9 +221,9 @@ def _scan_tiles_fused_impl(tqT, cand, lb, ptsT, gid3, k: int, interpret: bool):
             jax.ShapeDtypeStruct((T, tqp, k), jnp.int32),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, D, B), jnp.float32),
-            pltpu.VMEM((2, 1, B), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, V, 1, D * B), jnp.float32),
+            pltpu.VMEM((2, V, 1, B), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, V, 2)),
             pltpu.VMEM((tqp, W), jnp.float32),
             pltpu.VMEM((tqp, W), jnp.int32),
         ],
@@ -186,17 +231,28 @@ def _scan_tiles_fused_impl(tqT, cand, lb, ptsT, gid3, k: int, interpret: bool):
     )(cand[:, None, :], lb[:, None, :], tqT, ptsT, gid3)
 
 
+DEFAULT_V = 1  # buckets per fold group. Measured at the north-star shape:
+               # V in {1, 2, 4, 8} is throughput-neutral (57.8k vs 56.5k
+               # q/s) — the scan is bound by per-candidate scalar/DMA
+               # overhead with the early exit gated by the tile-max k-th,
+               # not by the fold — so keep the simplest configuration; the
+               # grouping stays available for shapes where folds dominate.
+
+
 def scan_tiles_fused(
-    tree, tq, cand, cand_lb, k: int, interpret: bool | None = None
+    tree, tq, cand, cand_lb, k: int, interpret: bool | None = None,
+    V: int | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Drop-in for ``tile_query._scan_tiles`` on TPU.
 
     tq f32[T, TQ, D]; cand i32[T, C] lb-ascending (-1 pad); cand_lb
     f32[T, C] (+inf at pad). Returns (d2 f32[T, TQ, k], gid i32[T, TQ, k])
-    ascending per query.
+    ascending per query. ``V`` groups that many buckets per DMA/fold round.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if V is None:
+        V = DEFAULT_V
     T, TQ, D = tq.shape
     k = min(k, tree.n_real)
     tqp = max(TQ, 8)  # sublane floor; padding rows are duplicates, sliced off
@@ -205,7 +261,13 @@ def scan_tiles_fused(
             [tq, jnp.broadcast_to(tq[:, -1:, :], (T, tqp - TQ, D))], axis=1
         )
     tqT = jnp.swapaxes(tq, 1, 2)  # [T, D, TQp]
-    ptsT = jnp.swapaxes(tree.bucket_pts, 1, 2)  # [NBP, D, B]
+    nbp, B = tree.bucket_gid.shape
+    # flat [NBP, 1, D*B]: the kernel lane-slices at d*B offsets, which is
+    # Mosaic-legal only when B is a lane-tile multiple
+    assert B % _LANE == 0, f"bucket size must be a multiple of {_LANE}, got {B}"
+    ptsT = jnp.swapaxes(tree.bucket_pts, 1, 2).reshape(nbp, 1, D * B)
     gid3 = tree.bucket_gid[:, None, :]  # [NBP, 1, B]
-    d2, gi = _scan_tiles_fused_impl(tqT, cand, cand_lb, ptsT, gid3, k, interpret)
+    d2, gi = _scan_tiles_fused_impl(
+        tqT, cand, cand_lb, ptsT, gid3, k, V, interpret
+    )
     return d2[:, :TQ], gi[:, :TQ]
