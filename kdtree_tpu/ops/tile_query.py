@@ -349,9 +349,13 @@ def morton_knn_tiled(
     Qp = sq.shape[0]
 
     parts_d, parts_i = [], []
+    # the candidate cap grows monotonically ACROSS batches: a tile geometry
+    # that overflowed cap C in one batch will overflow it in similar
+    # batches too, and every doubling costs a recompile + a full re-run —
+    # resetting per batch turned one unlucky batch into dozens
+    bcmax = cmax
     for b0 in range(0, Qp, qbatch):
         sb = lax.slice_in_dim(sq, b0, b0 + qbatch, axis=0)
-        bcmax = cmax
         while True:
             bd, bi, overflow = _tiled_batch(
                 tree, sb, k, tile, bcmax, seeds, v, use_pallas
