@@ -93,6 +93,43 @@ def test_clustered_queries_and_points():
     _check(pts, qs, 8)
 
 
+def test_multibatch_async_dispatch_and_retry(monkeypatch):
+    """Exercise the multi-batch driver: >1 sub-batch program, the stacked
+    overflow fetch, and mid-stream doubling retries (the production-scale
+    path that default _BATCH_Q=65536 hides from small CI shapes).
+
+    The first batch (Hilbert order puts the corner cluster there) settles a
+    small cap; the later uniform batches need more candidate buckets, so
+    they must overflow at the settled cap and go through the
+    stacked-flags retry rounds. Results are oracle-checked either way."""
+    import kdtree_tpu.ops.tile_query as tqm
+
+    monkeypatch.setattr(tqm, "_BATCH_Q", 256)
+    calls = []
+    real = tqm._tiled_batch
+
+    def spy(*a, **kw):
+        calls.append(a[4])  # the cmax this batch ran at
+        return real(*a, **kw)
+
+    monkeypatch.setattr(tqm, "_tiled_batch", spy)
+
+    rng = np.random.default_rng(42)
+    pts, _ = generate_problem(seed=11, dim=2, num_points=30000, num_queries=1)
+    # 300 queries tightly clustered at the domain corner (cheap tiles, sorted
+    # first) + 724 uniform queries (wide tiles, need many candidate buckets)
+    corner = -100.0 + rng.random((300, 2)).astype(np.float32)
+    spread = rng.uniform(-100, 100, (724, 2)).astype(np.float32)
+    qs = jnp.asarray(np.concatenate([corner, spread]))
+    tree = build_morton(pts)
+    d2, gi = tqm.morton_knn_tiled(tree, qs, k=4, tile=8, cmax=2)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=4)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    n_batches = 1024 // 256
+    assert len(calls) > n_batches, "no retry round ran — weaken the setup"
+    assert len(set(calls)) > 1, "cap never grew across retries"
+
+
 def test_matches_per_query_dfs():
     """Tiled and per-query DFS engines must agree on distances (both exact)."""
     from kdtree_tpu import morton_knn
