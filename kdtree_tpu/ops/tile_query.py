@@ -54,7 +54,10 @@ DEFAULT_SEEDS = 8
 _SCAN_V = 8  # buckets per dense-scan fold
 _SCAN_ROWS = 8192  # queries per scan block (bounds the [TB, TQ, V*B] block)
 _SCAN_TB = 32  # fallback tiles per scan block for explicit calls
-_BATCH_Q = 1 << 16  # queries per device program (watchdog + memory bound)
+_BATCH_Q = 1 << 16  # queries per device program (watchdog + memory bound);
+# measured at the 10M-query north-star shape with async dispatch: 2^16 ->
+# 365k q/s, 2^17 -> 333k, 2^18 -> 291k — bigger programs don't amortize
+# anything further once dispatch is async, they just coarsen retries
 
 
 def _gathered_box_lb(tree, box_lo, box_hi, ids):
@@ -271,7 +274,10 @@ def _auto_tile(Q, n, k, D, nbp, B, cmax, use_pallas=False):
     (3/4 of the 1024-slot candidate budget) and size cmax to 2x the
     estimate — measured at the 16M/1M/k=16 north-star shape this is 3x
     faster than the small-tile choice, and the margin avoids the
-    overflow-retry recompile cliff."""
+    overflow-retry recompile cliff. The 128 ceiling is itself measured:
+    the kernel's k-extraction fold is O(TQ * W) per fired bucket, so past
+    TQ=128 the fold cost outgrows the DMA savings (same shape, v5e:
+    tile 64/128/256/512 -> 111/125/79/48 k q/s)."""
     est = lambda tq: (
         ((tq / Q) ** (1.0 / D) + 2.0 * (k / max(n, 1)) ** (1.0 / D)) ** D
         * nbp
