@@ -55,8 +55,11 @@ def _format_distance(d: float) -> str:
     return f"{d:g}"
 
 
-def print_result_line(point_id: int, distance: float, file=sys.stdout) -> None:
-    # exact byte layout of Utility.cpp:123: "ID: <id> \t DISTANCE: <d>"
+def print_result_line(point_id: int, distance: float, file=None) -> None:
+    # exact byte layout of Utility.cpp:123: "ID: <id> \t DISTANCE: <d>".
+    # file=None resolves to sys.stdout at CALL time (a def-time sys.stdout
+    # default would bypass contextlib.redirect_stdout for in-process
+    # drivers of main())
     print(f"ID: {point_id} \t DISTANCE: {_format_distance(distance)}", file=file)
 
 
