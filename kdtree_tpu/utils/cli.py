@@ -412,10 +412,46 @@ def _tree_knn(tree, queries, k: int):
     return knn(tree, queries, k=k)
 
 
+def _load_array(path: str, what: str) -> "np.ndarray":
+    """Load a user-supplied [N, D] f32 array (.npy, or .npz key 'points'/
+    'queries'/first array). Rejects NaN rows loudly (SURVEY §5 guards)."""
+    arr = np.load(path, allow_pickle=False)
+    if hasattr(arr, "files"):  # npz
+        for key in (what, "points", "queries"):
+            if key in arr.files:
+                arr = arr[key]
+                break
+        else:
+            arr = arr[arr.files[0]]
+    arr = np.asarray(arr, dtype=np.float32)
+    if arr.ndim != 2:
+        print(f"{what} file {path} must be [N, D], got shape {arr.shape}",
+              file=sys.stderr)
+        sys.exit(1)
+    if not np.isfinite(arr).all():
+        print(f"{what} file {path} contains non-finite values", file=sys.stderr)
+        sys.exit(1)
+    return arr
+
+
 def cmd_build(args) -> None:
     from kdtree_tpu.utils.checkpoint import save_tree
 
-    if args.engine in ("global-morton", "global-exact"):
+    if getattr(args, "points", None):
+        # user data, not a seeded problem: build over an arbitrary point set
+        # (the reference can only generate; a framework must also ingest)
+        if args.engine in ("global-morton", "global-exact"):
+            print(f"engine {args.engine} is generative (shard-local row "
+                  "streams); use a materialized engine for --points",
+                  file=sys.stderr)
+            sys.exit(1)
+        import jax.numpy as jnp
+
+        points = jnp.asarray(_load_array(args.points, "points"))
+        tree = _build_tree_for_engine(points, args.engine, args.devices)
+        n, dim = points.shape
+        meta = {"generator": "file"}
+    elif args.engine in ("global-morton", "global-exact"):
         # generative: never materialize [N, D]; provenance = threefry rows
         if args.generator != "threefry":
             print(f"note: {args.engine} defines its points by the threefry "
@@ -425,14 +461,15 @@ def cmd_build(args) -> None:
             None, args.engine, args.devices,
             problem=(args.seed, args.dim, args.n),
         )
-        gen_used = "threefry"
         n, dim = args.n, args.dim
+        meta = {"seed": args.seed, "generator": "threefry"}
     else:
         points, _, gen_used = _generate(args.seed, args.dim, args.n,
                                         args.generator)
         tree = _build_tree_for_engine(points, args.engine, args.devices)
         n, dim = points.shape
-    save_tree(args.out, tree, meta={"seed": args.seed, "generator": gen_used})
+        meta = {"seed": args.seed, "generator": gen_used}
+    save_tree(args.out, tree, meta=meta)
     print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to {args.out}")
 
 
@@ -440,6 +477,35 @@ def cmd_query(args) -> None:
     from kdtree_tpu.utils.checkpoint import load_tree
 
     tree, meta = load_tree(args.tree)
+    n = tree.n if hasattr(tree, "n") else tree.n_real
+    if getattr(args, "queries", None):
+        # user-supplied query set; results go to --out (npz: d2, ids) or,
+        # without --out, to stdout in the protocol line format
+        import jax.numpy as jnp
+
+        qarr = _load_array(args.queries, "queries")
+        if qarr.shape[1] != tree.dim:
+            print(f"queries are {qarr.shape[1]}-D but the tree is "
+                  f"{tree.dim}-D", file=sys.stderr)
+            sys.exit(1)
+        if args.k > 1 and not args.out:
+            # protocol lines carry only the nearest distance per query —
+            # silently dropping the other k-1 neighbors (and every real
+            # neighbor id) would misrepresent the answer
+            print("k > 1 results need --out FILE (npz with d2[Q, k] and "
+                  "ids[Q, k]); protocol lines only carry the nearest "
+                  "distance", file=sys.stderr)
+            sys.exit(1)
+        d2, ids = _tree_knn(tree, jnp.asarray(qarr), k=args.k)
+        if args.out:
+            np.savez(args.out, d2=np.asarray(d2), ids=np.asarray(ids))
+            print(f"saved d2[{d2.shape[0]}, {d2.shape[1]}] + ids to {args.out}")
+            return
+        dists = np.sqrt(np.asarray(d2[:, 0], dtype=np.float64))  # ONE fetch
+        for q in range(qarr.shape[0]):
+            print_result_line(n + q, float(dists[q]))
+        print("DONE")
+        return
     # the checkpoint's provenance wins over CLI defaults — querying a seed-7
     # tree with seed-42 queries would silently answer a problem that never
     # existed
@@ -448,10 +514,13 @@ def cmd_query(args) -> None:
     else:
         seed = args.seed if args.seed is not None else 42
     generator = str(meta.get("generator", args.generator))
+    if generator == "file":
+        print("checkpoint was built from --points data; protocol queries "
+              "need --queries FILE", file=sys.stderr)
+        sys.exit(1)
     if args.seed is not None and args.seed != seed:
         print(f"note: using checkpoint seed {seed} (ignoring --seed {args.seed})",
               file=sys.stderr)
-    n = tree.n if hasattr(tree, "n") else tree.n_real
     queries = _generate_queries(seed, tree.dim, n, generator)
     d2, _ = _tree_knn(tree, queries, k=args.k)
     for q in range(queries.shape[0]):
@@ -500,6 +569,9 @@ def main(argv=None) -> None:
     bu.add_argument("--seed", type=int, default=42)
     bu.add_argument("--dim", type=int, default=3)
     bu.add_argument("--n", type=int, default=1 << 20)
+    bu.add_argument("--points", default=None, metavar="FILE",
+                    help="build over user data ([N, D] .npy/.npz) instead of "
+                         "a seeded problem")
     bu.add_argument("--out", required=True)
     bu.set_defaults(fn=cmd_build)
 
@@ -508,6 +580,12 @@ def main(argv=None) -> None:
     q.add_argument("--seed", type=int, default=None,
                    help="override checkpoint seed (normally read from the npz)")
     q.add_argument("--k", type=int, default=1)
+    q.add_argument("--queries", default=None, metavar="FILE",
+                   help="user query set ([Q, D] .npy/.npz) instead of the 10 "
+                        "protocol queries")
+    q.add_argument("--out", default=None, metavar="FILE",
+                   help="with --queries: save (d2, ids) npz instead of "
+                        "printing protocol lines")
     q.set_defaults(fn=cmd_query)
 
     args = p.parse_args(argv)

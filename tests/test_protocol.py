@@ -203,3 +203,54 @@ def test_build_query_roundtrip_generative(tmp_path, engine):
     bf, _ = bruteforce.knn_exact_d2(pts, qs, k=1)
     got = [float(ln.split(" \t DISTANCE: ")[1]) for ln in lines[:-1]]
     np.testing.assert_allclose(got, np.sqrt(np.asarray(bf)[:, 0]), rtol=1e-4)
+
+
+def test_build_query_user_files(tmp_path):
+    """File-based I/O: build over user .npy points, query a user .npy set,
+    read (d2, ids) back from --out — oracle-checked end to end."""
+    rng = np.random.default_rng(3)
+    pts = rng.uniform(-50, 50, (700, 3)).astype(np.float32)
+    qs = rng.uniform(-50, 50, (37, 3)).astype(np.float32)
+    pts_f, qs_f = str(tmp_path / "p.npy"), str(tmp_path / "q.npy")
+    np.save(pts_f, pts)
+    np.save(qs_f, qs)
+    tree_f, out_f = str(tmp_path / "t.npz"), str(tmp_path / "r.npz")
+
+    res = _run_cli(["--engine", "morton", "build", "--points", pts_f,
+                    "--out", tree_f])
+    assert res.returncode == 0, res.stderr[-2000:]
+    res = _run_cli(["query", "--tree", tree_f, "--queries", qs_f,
+                    "--k", "4", "--out", out_f])
+    assert res.returncode == 0, res.stderr[-2000:]
+
+    from kdtree_tpu.ops import bruteforce
+
+    z = np.load(out_f)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=4)
+    np.testing.assert_allclose(z["d2"], np.asarray(bf), rtol=1e-5)
+    assert z["ids"].shape == (37, 4) and (z["ids"] >= 0).all()
+
+    # a file-built checkpoint has no seeded protocol queries to fall back to
+    res = _run_cli(["query", "--tree", tree_f])
+    assert res.returncode == 1 and "--queries" in res.stderr
+
+    # k=1 without --out prints protocol lines for the user queries
+    res = _run_cli(["query", "--tree", tree_f, "--queries", qs_f])
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = res.stdout.strip().splitlines()
+    assert lines[-1] == "DONE" and len(lines) == 38
+    got = [float(ln.split(" \t DISTANCE: ")[1]) for ln in lines[:-1]]
+    np.testing.assert_allclose(got, np.sqrt(bf[:, 0]), rtol=1e-4)
+
+    # k>1 without --out would silently drop neighbors — must refuse
+    res = _run_cli(["query", "--tree", tree_f, "--queries", qs_f, "--k", "4"])
+    assert res.returncode == 1 and "--out" in res.stderr
+
+    # NaN-poisoned input fails loudly (SURVEY §5 guard at the boundary)
+    bad_f = str(tmp_path / "bad.npy")
+    bad = pts.copy()
+    bad[5, 1] = np.nan
+    np.save(bad_f, bad)
+    res = _run_cli(["--engine", "morton", "build", "--points", bad_f,
+                    "--out", tree_f])
+    assert res.returncode == 1 and "non-finite" in res.stderr
