@@ -115,6 +115,14 @@ def _generate_queries(seed: int, dim: int, num_points: int, generator: str):
     return generate_queries(seed, dim, NUM_QUERIES)
 
 
+def _dense_lowd(q: int, n: int, dim: int) -> bool:
+    """The measured tiled-engine crossover (v5e, round 3): dense low-D
+    batches win 4x on the tiled Pallas engine; sparse batches invert
+    (each sparse tile's box covers most buckets). Shared by the auto
+    engine choice and checkpoint-query dispatch."""
+    return q >= 512 and q * 64 >= n and dim <= 6
+
+
 def _resolve_engine(engine: str, dim: int, q: int | None = None,
                     n: int | None = None) -> str:
     """Q-aware engine choice, grounded in v5e measurements (round 3,
@@ -135,7 +143,7 @@ def _resolve_engine(engine: str, dim: int, q: int | None = None,
     if dim > AUTO_TREE_DIM_MAX:
         return "bruteforce"
     if q is not None and n is not None:
-        if q >= 512 and q * 64 >= n and dim <= 6:
+        if _dense_lowd(q, n, dim):
             return "tiled"
         if q * n * dim <= 2e13:
             return "bruteforce"
@@ -381,7 +389,12 @@ def _build_tree_for_engine(points, engine: str, mesh_devices: int | None,
 
 
 def _tree_knn(tree, queries, k: int):
-    """Dispatch k-NN on whichever tree type a checkpoint contained."""
+    """Dispatch k-NN on whichever tree type a checkpoint contained.
+
+    Dense low-D query batches route to the tiled engines (same measured
+    crossover as ``_resolve_engine``: the per-query DFS is ~100x slower at
+    the north-star query shape) — this matters for ``query --queries`` with
+    a big user file."""
     from kdtree_tpu.models.tree import KDTree
     from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn
     from kdtree_tpu.ops.morton import MortonTree, morton_knn
@@ -389,11 +402,18 @@ def _tree_knn(tree, queries, k: int):
         GlobalExactTree, global_exact_query,
     )
     from kdtree_tpu.parallel.global_morton import (
-        GlobalMortonForest, global_morton_query,
+        GlobalMortonForest, global_morton_query, global_morton_query_tiled,
     )
     from kdtree_tpu.parallel.global_tree import GlobalKDTree, global_knn
 
+    q, dim = queries.shape
+
+    def dense(n):
+        return _dense_lowd(q, n, dim)
+
     if isinstance(tree, GlobalMortonForest):
+        if dense(tree.num_points):
+            return global_morton_query_tiled(tree, queries, k=k)
         # falls back to the mesh-free query when the local device count
         # doesn't match the forest's build mesh
         return global_morton_query(tree, queries, k=k)
@@ -401,6 +421,10 @@ def _tree_knn(tree, queries, k: int):
         # same mesh-free portability contract as the Morton forest
         return global_exact_query(tree, queries, k=k)
     if isinstance(tree, MortonTree):
+        if dense(tree.n_real):
+            from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+            return morton_knn_tiled(tree, queries, k=k)
         return morton_knn(tree, queries, k=k)
     if isinstance(tree, BucketKDTree):
         return bucket_knn(tree, queries, k=k)

@@ -93,3 +93,30 @@ def test_graft_entry_full_scale():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_tree_knn_dense_batch_routing():
+    """_tree_knn routes dense low-D batches to the tiled engines and stays
+    exact (CLI `query --queries` with a big user file hits this path)."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu import build_morton, generate_problem
+    from kdtree_tpu.ops import bruteforce
+    from kdtree_tpu.parallel.global_morton import build_global_morton
+    from kdtree_tpu.parallel.mesh import make_mesh
+    from kdtree_tpu.utils.cli import _tree_knn
+
+    rng = np.random.default_rng(2)
+    qs = jnp.asarray(rng.uniform(-100, 100, (600, 3)).astype(np.float32))
+
+    pts, _ = generate_problem(seed=6, dim=3, num_points=900, num_queries=1)
+    d2, _ = _tree_knn(build_morton(pts), qs, k=3)  # dense: 600*64 >= 900
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=3)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-5)
+
+    forest = build_global_morton(6, 3, 900, mesh=make_mesh(8))
+    fd2, _ = _tree_knn(forest, qs, k=3)
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    fbf, _ = bruteforce.knn_exact_d2(generate_points_rowwise(6, 3, 900), qs, k=3)
+    np.testing.assert_allclose(np.asarray(fd2), np.asarray(fbf), rtol=1e-5)
