@@ -25,11 +25,51 @@ speed).
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 import jax
 import numpy as np
+
+
+def _fail(msg: str, code: int = 1, hard: bool = False) -> None:
+    """Emit the driver-facing FAILED metric line and exit. ``hard`` uses
+    os._exit (needed when a wedged backend thread would block interpreter
+    shutdown)."""
+    print(json.dumps({"metric": f"FAILED {msg}", "value": 0, "unit": "",
+                      "vs_baseline": 0}))
+    sys.stdout.flush()
+    if hard:
+        os._exit(code)
+    sys.exit(code)
+
+
+def _device_probe(timeout_s: float = 600.0) -> None:
+    """Fail crisply if device init hangs (a crashed remote compile can wedge
+    the axon tunnel, leaving ``jax.devices()`` blocked indefinitely — seen
+    in round 3). The probe runs in a daemon thread; on timeout the driver
+    gets an honest FAILED metric line instead of a silent multi-hour hang.
+    Generous window: a healthy first init can legitimately take minutes."""
+    result = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except Exception as e:  # init error ≠ hang, but equally fatal here
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in result:
+        return
+    msg = result.get(
+        "error", f"device init did not complete in {timeout_s:.0f}s "
+        "(wedged tunnel?)"
+    )
+    _fail(f"device init: {msg}", code=2, hard=True)
 
 
 def _fetch(x):
@@ -132,6 +172,18 @@ def bench_clustered(kt, n: int, dim: int, nq: int):
 
 
 def main() -> None:
+    # restore env-var platform semantics: the axon sitecustomize overrides
+    # JAX_PLATFORMS with a config update, so a JAX_PLATFORMS=cpu bench run
+    # would still dial the tunnel first (and hang with it wedged)
+    env_plat = os.environ.get("JAX_PLATFORMS", "")
+    if env_plat and "axon" not in env_plat:
+        jax.config.update("jax_platforms", env_plat)
+    try:
+        probe_s = float(os.environ.get("BENCH_DEVICE_PROBE_S", "600"))
+    except ValueError:
+        probe_s = 600.0
+    _device_probe(probe_s)
+
     import kdtree_tpu as kt
 
     platform = jax.devices()[0].platform
@@ -153,9 +205,7 @@ def main() -> None:
     best, (pts, qs, d2, tree) = bench_build(kt, n, 3, nq)
     bf, _ = kt.bruteforce.knn(pts, qs, k=1)
     if not np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0], rtol=1e-4):
-        print(json.dumps({"metric": "FAILED oracle check (build)", "value": 0,
-                          "unit": "", "vs_baseline": 0}))
-        sys.exit(1)
+        _fail("oracle check (build)")
     pts_per_s = n / best
     base_pts_per_s = n / base_s
 
@@ -163,9 +213,7 @@ def main() -> None:
 
     qdt, qok = bench_queries(kt, pts, tree, Q, k)
     if not qok:
-        print(json.dumps({"metric": "FAILED oracle check (query)", "value": 0,
-                          "unit": "", "vs_baseline": 0}))
-        sys.exit(1)
+        _fail("oracle check (query)")
     extra.append({
         "metric": f"k-NN queries/sec (Q={Q}, k={k}, {cfg} tree, tiled"
                   f"{'+pallas' if on_accel else ''}, {platform})",
@@ -181,9 +229,7 @@ def main() -> None:
         # extra warmup mostly pays for the 10M-row sort/unsort compiles
         qbdt, qbok = bench_queries(kt, pts, tree, Qbig, k)
         if not qbok:
-            print(json.dumps({"metric": "FAILED oracle check (query-10M)",
-                              "value": 0, "unit": "", "vs_baseline": 0}))
-            sys.exit(1)
+            _fail("oracle check (query-10M)")
         extra.append({
             "metric": f"k-NN queries/sec (Q={Qbig}, k={k}, {cfg} tree, "
                       f"north-star shape, {platform})",
@@ -199,9 +245,7 @@ def main() -> None:
         del pts, qs, d2, tree
         bdt, bok = bench_build_big(kt, nbig, 3, nq)
         if not bok:
-            print(json.dumps({"metric": "FAILED oracle check (build-128M)",
-                              "value": 0, "unit": "", "vs_baseline": 0}))
-            sys.exit(1)
+            _fail("oracle check (build-128M)")
         extra.append({
             "metric": f"gen+build+10xNN points/sec (128M x 3D single chip, "
                       f"{platform})",
@@ -212,9 +256,7 @@ def main() -> None:
 
     cdt, cok = bench_clustered(kt, cn, cdim, nq)
     if not cok:
-        print(json.dumps({"metric": "FAILED oracle check (clustered)", "value": 0,
-                          "unit": "", "vs_baseline": 0}))
-        sys.exit(1)
+        _fail("oracle check (clustered)")
     extra.append({
         "metric": f"clustered Gaussian-mixture gen+solve pts/sec "
                   f"({cn}x{cdim}D, {platform})",

@@ -12,6 +12,7 @@ from kdtree_tpu.ops.build import build, build_jit, validate_invariants
 from kdtree_tpu.ops.bucket import BucketKDTree, bucket_knn, build_bucket
 from kdtree_tpu.ops.morton import MortonTree, build_morton, morton_knn
 from kdtree_tpu.ops.query import knn, nearest_neighbor
+from kdtree_tpu.ops.tile_query import morton_knn_tiled
 from kdtree_tpu.ops.generate import (
     generate_problem,
     generate_queries,
@@ -29,6 +30,7 @@ __all__ = [
     "MortonTree",
     "build_morton",
     "morton_knn",
+    "morton_knn_tiled",
     "generate_queries",
     "KDTree",
     "TreeSpec",
