@@ -156,6 +156,19 @@ def test_bench_reports_three_phases():
         assert phase in rep, rep
 
 
+def test_bench_trace_writes_profile(tmp_path):
+    """--trace wraps the timed run in jax.profiler.trace and leaves a
+    Perfetto-openable artifact behind (VERDICT r2 item 7's second half)."""
+    trace_dir = str(tmp_path / "trace")
+    res = _run_cli(["--generator", "threefry", "--engine", "morton",
+                    "bench", "--n", "400", "--dim", "3",
+                    "--trace", trace_dir])
+    assert res.returncode == 0, res.stderr[-2000:]
+    written = [p for p in Path(trace_dir).rglob("*") if p.is_file()]
+    assert written, f"no trace files under {trace_dir}"
+    assert any("trace" in p.name for p in written), written
+
+
 @pytest.mark.parametrize("engine", ["tree", "bucket", "morton", "global"])
 def test_build_query_roundtrip(tmp_path, engine):
     """build saves provenance; query replays it regardless of --seed —
