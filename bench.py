@@ -47,11 +47,17 @@ def _fail(msg: str, code: int = 1, hard: bool = False) -> None:
 
 
 def _device_probe(timeout_s: float = 600.0) -> None:
-    """Fail crisply if device init hangs (a crashed remote compile can wedge
-    the axon tunnel, leaving ``jax.devices()`` blocked indefinitely — seen
-    in round 3). The probe runs in a daemon thread; on timeout the driver
-    gets an honest FAILED metric line instead of a silent multi-hour hang.
-    Generous window: a healthy first init can legitimately take minutes."""
+    """Keep a wedged accelerator tunnel from hanging the bench forever (a
+    crashed remote compile can leave ``jax.devices()`` blocked indefinitely
+    — seen in round 3). The probe runs in a daemon thread; on timeout the
+    bench re-execs itself pinned to CPU (a fresh process is required — the
+    hung init thread holds the backend lock, so no other platform can
+    initialize in THIS process) and reports honest CPU-fallback numbers
+    instead of nothing. Fast init ERRORS (bad credentials, missing
+    runtime) and a second wedge in the fallback process fail crisply with
+    the standard metric line — CPU numbers must never mask a
+    misconfiguration. Generous window: a healthy first init can
+    legitimately take minutes."""
     result = {}
 
     def probe():
@@ -65,10 +71,23 @@ def _device_probe(timeout_s: float = 600.0) -> None:
     t.join(timeout_s)
     if "devices" in result:
         return
-    msg = result.get(
-        "error", f"device init did not complete in {timeout_s:.0f}s "
-        "(wedged tunnel?)"
-    )
+    if "error" in result:
+        # a fast init ERROR (bad credentials, missing runtime) is a real
+        # misconfiguration — surface it crisply; CPU numbers would mask it
+        _fail(f"device init: {result['error']}", code=2, hard=True)
+    msg = (f"device init did not complete in {timeout_s:.0f}s "
+           "(wedged tunnel?)")
+    if not os.environ.get("BENCH_TUNNEL_FALLBACK"):
+        print(f"bench: {msg}; falling back to the CPU platform",
+              file=sys.stderr)
+        sys.stderr.flush()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["BENCH_TUNNEL_FALLBACK"] = "1"
+        try:
+            os.execv(sys.executable,
+                     [sys.executable, os.path.abspath(__file__)])
+        except OSError as e:
+            msg = f"{msg}; CPU re-exec failed: {e!r}"
     _fail(f"device init: {msg}", code=2, hard=True)
 
 
