@@ -85,7 +85,8 @@ def _device_probe(timeout_s: float = 600.0) -> None:
         os.environ["BENCH_TUNNEL_FALLBACK"] = "1"
         try:
             os.execv(sys.executable,
-                     [sys.executable, os.path.abspath(__file__)])
+                     [sys.executable, os.path.abspath(__file__),
+                      *sys.argv[1:]])
         except OSError as e:
             msg = f"{msg}; CPU re-exec failed: {e!r}"
     _fail(f"device init: {msg}", code=2, hard=True)

@@ -1,0 +1,56 @@
+"""Device-probe branches of bench.py (the wedged-tunnel guard).
+
+Three-way contract: fast init error -> crisp FAILED line; first wedge ->
+CPU re-exec (exec hop validated manually against a real wedged tunnel —
+too slow for CI); second wedge -> crisp FAILED. These tests pin the two
+FAILED branches and the timeout detection in subprocesses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run(code, env_extra, timeout=120):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def _failed_line(stdout):
+    line = stdout.strip().splitlines()[-1]
+    rep = json.loads(line)
+    assert rep["value"] == 0 and rep["metric"].startswith("FAILED"), rep
+    return rep
+
+
+def test_fast_init_error_fails_crisply():
+    """A real init error (unknown platform) must NOT fall back to CPU —
+    honest CPU numbers would mask the misconfiguration."""
+    res = _run("import bench; bench.main()",
+               {"JAX_PLATFORMS": "bogus", "BENCH_DEVICE_PROBE_S": "30"})
+    assert res.returncode == 2, (res.stdout, res.stderr[-500:])
+    rep = _failed_line(res.stdout)
+    assert "bogus" in rep["metric"]
+    assert "falling back" not in res.stderr
+
+
+def test_second_wedge_fails_crisply():
+    """With the fallback guard already set (= we ARE the fallback process),
+    a hanging device init produces the FAILED line, not another exec."""
+    code = (
+        "import time, bench\n"
+        "bench.jax.devices = lambda *a: time.sleep(3600)\n"
+        "bench.main()\n"
+    )
+    res = _run(code, {"BENCH_TUNNEL_FALLBACK": "1",
+                      "BENCH_DEVICE_PROBE_S": "2",
+                      "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 2, (res.stdout, res.stderr[-500:])
+    rep = _failed_line(res.stdout)
+    assert "did not complete" in rep["metric"]
