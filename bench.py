@@ -169,6 +169,45 @@ def bench_queries(kt, pts, tree, Q: int, k: int):
     return dt, ok
 
 
+def bench_global_morton(kt, n: int, dim: int, nq: int):
+    """North-star per-device-scale capture (VERDICT r3 item 4): the scale
+    engine's exact per-device program (shard generate -> Morton code ->
+    dest sort -> exchange -> local bucket-tree build, parallel/
+    global_morton.py::_build_local) at 2^26 rows on a 1-device mesh of the
+    real chip — per-device scale >= the 1B/v5e-16 north star's ~62.5M
+    rows/device (docs/SCALING.md). slack=1.05: at P=1 every row routes to
+    the one destination, so overflow is impossible and the tight width
+    keeps the work buffer inside HBM."""
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton, global_morton_query,
+    )
+    from kdtree_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(1)
+    qs = generate_queries(77, dim, nq)
+
+    def run(seed: int):
+        forest = build_global_morton(seed, dim, n, mesh=mesh, slack=1.05)
+        d2, _ = global_morton_query(forest, qs, k=1, mesh=mesh)
+        return forest, d2
+
+    forest, d2 = run(999)
+    _fetch(d2)
+    pts = generate_points_rowwise(999, dim, n)
+    bf, _ = kt.bruteforce.knn(pts, qs, k=1)
+    ok = np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0], rtol=1e-4)
+    del pts, bf, forest, d2
+    times = []
+    for seed in (1, 2):
+        t0 = time.perf_counter()
+        out = run(seed)
+        _fetch(out[1])
+        times.append(time.perf_counter() - t0)
+        del out
+    return min(times), ok
+
+
 def bench_clustered(kt, n: int, dim: int, nq: int):
     """Gaussian-mixture high-D config on the brute-force path — the same
     path the CLI's auto engine dispatches to at 128-D (cli.py
@@ -270,6 +309,20 @@ def main() -> None:
             "metric": f"gen+build+10xNN points/sec (128M x 3D single chip, "
                       f"{platform})",
             "value": round(nbig / bdt),
+            "unit": "pts/s",
+            "vs_baseline": None,
+        })
+
+        # north-star per-device scale through the SCALE engine itself
+        # (driver-visible evidence for docs/SCALING.md item 1)
+        n26 = 1 << 26
+        gdt, gok = bench_global_morton(kt, n26, 3, nq)
+        if not gok:
+            _fail("oracle check (global-morton-2^26)")
+        extra.append({
+            "metric": f"global-morton build+10xNN points/sec (2^26 "
+                      f"rows/device, P=1 mesh, {platform})",
+            "value": round(n26 / gdt),
             "unit": "pts/s",
             "vs_baseline": None,
         })

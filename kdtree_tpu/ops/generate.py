@@ -124,3 +124,35 @@ def generate_points_rowwise(seed: int, dim: int, num_points: int, dtype=jnp.floa
     bit-identical to multi-device shard-local generation.
     """
     return generate_points_shard(seed, dim, 0, num_points, dtype=dtype)
+
+
+def generate_points_shard_clustered(
+    seed: int, dim: int, shard_start: int, shard_rows: int,
+    num_clusters: int = 8, stddev: float = 2.0, dtype=jnp.float32,
+) -> jax.Array:
+    """Shard-window clustered generation: the Gaussian-mixture stress
+    distribution (:func:`generate_clustered`'s shape) as a counter-based
+    row stream, so the scale engines can ingest SKEWED data without ever
+    materializing [N, D] (VERDICT r3 item 6 — the fit test needs clustered
+    data to actually flow through the sample-sort/mirror exchanges).
+
+    Every row's bits depend only on (seed, row): cluster centers come from
+    the seed key alone (identical on every device, no communication) and
+    each row folds its global index in for (assignment, noise) — shard
+    windows compose bit-identically to the rows 0..N stream, exactly like
+    :func:`generate_points_shard`.
+    """
+    kc, kr = jax.random.split(jax.random.key(seed), 2)
+    centers = jax.random.uniform(
+        kc, (num_clusters, dim), dtype=dtype, minval=COORD_MIN, maxval=COORD_MAX
+    )
+    row_keys = jax.vmap(lambda r: jax.random.fold_in(kr, r))(
+        shard_start + jnp.arange(shard_rows)
+    )
+
+    def one_row(k):
+        ka, kn = jax.random.split(k, 2)
+        c = jax.random.randint(ka, (), 0, num_clusters)
+        return centers[c] + stddev * jax.random.normal(kn, (dim,), dtype=dtype)
+
+    return jax.vmap(one_row)(row_keys)

@@ -215,12 +215,55 @@ def _build_morton_jit(points, bucket_cap, bits):
     return build_morton_impl(points, bucket_cap=bucket_cap, bits=bits)
 
 
+# Measured single-chip capacity cliff (v5e, 16 GiB HBM): the 2^27 x 3D build
+# works (114.6M pts/s); 2^28 crashes the XLA compile — and a crashed remote
+# compile can wedge the device tunnel for HOURS (round 3 lost its driver
+# bench window to exactly this). The build's peak working set is ~3 live
+# copies of the (d+2)-column sort operand (input columns + sort output +
+# the padded bucket/heap arrays), so the guard is bytes-based, not an n
+# constant: 3*(d+2)*4 bytes/point. At the measured cliff (2^27 x 3D ~ 8.1
+# GiB OK, 2^28 x 3D ~ 16.1 GiB crash) a 12 GiB budget separates the two
+# with margin. Override with KDTREE_TPU_MAX_BUILD_BYTES for chips with
+# more HBM.
+_MAX_BUILD_BYTES = 12 << 30
+
+
+def check_build_capacity(n: int, d: int, backend: str | None = None,
+                         budget: int | None = None) -> None:
+    """Raise ValueError (instead of letting XLA compile-crash) when a
+    single-chip Morton build would exceed the device memory budget."""
+    import os
+
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "tpu":
+        return  # CPU/GPU hosts page; only the TPU compile hard-crashes
+    if budget is None:
+        raw = os.environ.get("KDTREE_TPU_MAX_BUILD_BYTES")
+        try:
+            budget = int(raw) if raw is not None else _MAX_BUILD_BYTES
+        except ValueError:
+            raise ValueError(
+                f"KDTREE_TPU_MAX_BUILD_BYTES must be an integer byte count, "
+                f"got {raw!r} (e.g. 17179869184 for 16 GiB)"
+            ) from None
+    need = 3 * n * (d + 2) * 4
+    if need > budget:
+        raise ValueError(
+            f"single-chip Morton build of n={n}, d={d} needs ~{need >> 30} "
+            f"GiB working set (> {budget >> 30} GiB budget); shard it with "
+            "the global-morton engine (build_global_morton) instead, or "
+            "raise KDTREE_TPU_MAX_BUILD_BYTES if this chip has more HBM"
+        )
+
+
 def build_morton(
     points: jax.Array, bucket_cap: int = DEFAULT_BUCKET, bits: int | None = None
 ) -> MortonTree:
     """Build the Morton bucket tree (jitted). ``bits`` defaults to the most
     that fit a u32 code for this dimensionality (10 at D=3)."""
     n, d = points.shape
+    check_build_capacity(n, d)
     if bits is None:
         bits = 32 // max(d, 1)
     bits = max(1, min(bits, 32 // max(d, 1), 16))

@@ -38,7 +38,7 @@ exact (oracle-tested) — same contract as ``morton_knn``, with ids.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Callable, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -302,6 +302,106 @@ def _auto_tile(Q, n, k, D, nbp, B, cmax, use_pallas=False):
     return tq, min(cmax, nbp)
 
 
+def dense_lowd(q: int, n: int, dim: int) -> bool:
+    """The measured tiled-engine crossover (v5e, round 3): dense low-D
+    batches win 4x on the tiled Pallas engine; sparse batches invert
+    (each sparse tile's box covers most buckets). Shared by the CLI auto
+    engine choice, checkpoint-query dispatch, and the SPMD forest query
+    routing."""
+    return q >= 512 and q * 64 >= n and dim <= 6
+
+
+class TiledPlan(NamedTuple):
+    """Static launch configuration for a tiled-query run, shared by the
+    single-tree driver below and the SPMD forest driver
+    (:func:`kdtree_tpu.parallel.global_morton.global_morton_query_tiled`)."""
+
+    tile: int
+    cmax: int
+    seeds: int
+    v: int
+    bits: int
+    qbatch: int
+    use_pallas: bool
+
+
+def plan_tiled(
+    Q: int, D: int, n_real: int, nbp: int, B: int, k: int,
+    tile: int | None = None, cmax: int = DEFAULT_CMAX,
+    seeds: int = DEFAULT_SEEDS, use_pallas: bool | None = None,
+) -> TiledPlan:
+    """Resolve the static knobs of a tiled run from the problem shape.
+
+    ``tile=None`` picks the tile size from query/point density;
+    ``use_pallas=None`` enables the fused Mosaic kernel on TPU backends
+    and the XLA scan elsewhere (tests force use_pallas=True, which
+    interprets off-TPU).
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if tile is None:
+        tile, cmax = _auto_tile(Q, n_real, k, D, nbp, B, cmax, use_pallas)
+    tile = min(tile, max(Q, 1))
+    seeds = min(seeds, nbp)
+    if k > (seeds * B) // 2:
+        # seed buckets must be able to bound the k-th distance; fall back to
+        # collecting everything (exact, still dense) for oversized k
+        cmax = nbp
+    cmax = min(cmax, nbp)
+    bits = max(1, min(32 // max(D, 1), 16))
+    # each scan chunk must expose at least k candidate slots to lax.top_k
+    v = max(_SCAN_V, -(-k // B))
+    # batches bound each device program's runtime (watchdog) and memory;
+    # the global Hilbert sort happens ONCE, so batch slices stay coherent
+    qbatch = max(_BATCH_Q // tile, 1) * tile
+    return TiledPlan(tile, cmax, seeds, v, bits, qbatch, use_pallas)
+
+
+def drive_batches(
+    run_batch: Callable[[int, int], tuple],
+    offsets: Sequence[int],
+    cmax: int,
+    nbp: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Async batch dispatch with overflow-retry, shared by every tiled
+    driver. ``run_batch(offset, cap) -> (d2, gid, overflow)`` must be a
+    jitted program.
+
+    Settles the cap on the FIRST batch synchronously: a tile geometry that
+    overflows cap C in one batch tends to overflow it in similar batches
+    too, so systematic undersizing costs one doubling round here instead
+    of a re-run of every batch. Then every remaining batch is dispatched
+    before syncing anything: a per-batch ``bool(overflow)`` fetch would
+    block the host on each program in turn, inserting one tunnel round
+    trip between consecutive programs (measured at the 10M-query
+    north-star shape this serialization cost ~8x); async-dispatched, the
+    ~150 sub-batch programs run back-to-back on device and ONE stacked
+    fetch checks all overflow flags afterwards. Geometry-driven stragglers
+    retry in doubling rounds (rare once the cap is settled); a clean flag
+    at a smaller cap is still exact — overflow is the only incompleteness
+    signal.
+    """
+    bcmax = cmax
+    first = run_batch(offsets[0], bcmax)
+    while bool(first[2]) and bcmax < nbp:
+        bcmax = min(bcmax * 2, nbp)
+        first = run_batch(offsets[0], bcmax)
+    batches = [first] + [run_batch(b0, bcmax) for b0 in offsets[1:]]
+    while bcmax < nbp:
+        flags = np.asarray(jnp.stack([ov for (_, _, ov) in batches]))
+        bad = np.nonzero(flags)[0]
+        if bad.size == 0:
+            break
+        bcmax = min(bcmax * 2, nbp)
+        for i in bad:
+            batches[i] = run_batch(offsets[i], bcmax)
+    parts_d = [bd for (bd, _, _) in batches]
+    parts_i = [bi for (_, bi, _) in batches]
+    d2 = jnp.concatenate(parts_d, axis=0) if len(parts_d) > 1 else parts_d[0]
+    gi = jnp.concatenate(parts_i, axis=0) if len(parts_i) > 1 else parts_i[0]
+    return d2, gi
+
+
 def morton_knn_tiled(
     tree: MortonTree,
     queries: jax.Array,
@@ -328,69 +428,20 @@ def morton_knn_tiled(
             jnp.zeros((0, k), jnp.float32),
             jnp.zeros((0, k), jnp.int32),
         )
-    if use_pallas is None:
-        # the fused kernel is Mosaic-TPU only; GPU and CPU run the XLA scan
-        # (tests force use_pallas=True, which interprets off-TPU)
-        use_pallas = jax.default_backend() == "tpu"
-    if tile is None:
-        tile, cmax = _auto_tile(
-            Q, tree.n_real, k, D, tree.num_buckets, tree.bucket_size, cmax,
-            use_pallas,
-        )
-    tile = min(tile, max(Q, 1))
-    seeds = min(seeds, tree.num_buckets)
-    if k > (seeds * tree.bucket_size) // 2:
-        # seed buckets must be able to bound the k-th distance; fall back to
-        # collecting everything (exact, still dense) for oversized k
-        cmax = tree.num_buckets
-    cmax = min(cmax, tree.num_buckets)
-    bits = max(1, min(32 // max(D, 1), 16))
-    # each scan chunk must expose at least k candidate slots to lax.top_k
-    v = max(_SCAN_V, -(-k // tree.bucket_size))
-
-    # batches bound each device program's runtime (watchdog) and memory;
-    # the global Hilbert sort happens ONCE, so batch slices stay coherent
-    qbatch = max(_BATCH_Q // tile, 1) * tile
-    qpad = (-Q) % qbatch
-    sq, order = _sort_queries(queries, bits, qpad)
+    plan = plan_tiled(
+        Q, D, tree.n_real, tree.num_buckets, tree.bucket_size, k,
+        tile, cmax, seeds, use_pallas,
+    )
+    qpad = (-Q) % plan.qbatch
+    sq, order = _sort_queries(queries, plan.bits, qpad)
     Qp = sq.shape[0]
 
     def run_batch(b0: int, cap: int):
         return _tiled_batch(
-            tree, lax.slice_in_dim(sq, b0, b0 + qbatch, axis=0), k, tile,
-            cap, seeds, v, use_pallas,
+            tree, lax.slice_in_dim(sq, b0, b0 + plan.qbatch, axis=0), k,
+            plan.tile, cap, plan.seeds, plan.v, plan.use_pallas,
         )
 
-    offsets = list(range(0, Qp, qbatch))
-    # settle the cap on the FIRST batch synchronously: a tile geometry that
-    # overflows cap C in one batch tends to overflow it in similar batches
-    # too, so systematic undersizing costs one doubling round here instead
-    # of a re-run of every batch
-    bcmax = cmax
-    first = run_batch(offsets[0], bcmax)
-    while bool(first[2]) and bcmax < tree.num_buckets:
-        bcmax = min(bcmax * 2, tree.num_buckets)
-        first = run_batch(offsets[0], bcmax)
-    # then dispatch every remaining batch before syncing anything: a
-    # per-batch `bool(overflow)` fetch would block the host on each program
-    # in turn, inserting one tunnel round trip between consecutive programs
-    # (measured at the 10M-query north-star shape this serialization cost
-    # ~8x); async-dispatched, the ~150 sub-batch programs run back-to-back
-    # on device and ONE stacked fetch checks all overflow flags afterwards.
-    # Geometry-driven stragglers retry in doubling rounds (rare once the
-    # cap is settled); a clean flag at a smaller cap is still exact —
-    # overflow is the only incompleteness signal
-    batches = [first] + [run_batch(b0, bcmax) for b0 in offsets[1:]]
-    while bcmax < tree.num_buckets:
-        flags = np.asarray(jnp.stack([ov for (_, _, ov) in batches]))
-        bad = np.nonzero(flags)[0]
-        if bad.size == 0:
-            break
-        bcmax = min(bcmax * 2, tree.num_buckets)
-        for i in bad:
-            batches[i] = run_batch(offsets[i], bcmax)
-    parts_d = [bd for (bd, _, _) in batches]
-    parts_i = [bi for (_, bi, _) in batches]
-    d2 = jnp.concatenate(parts_d, axis=0) if len(parts_d) > 1 else parts_d[0]
-    gi = jnp.concatenate(parts_i, axis=0) if len(parts_i) > 1 else parts_i[0]
+    offsets = list(range(0, Qp, plan.qbatch))
+    d2, gi = drive_batches(run_batch, offsets, plan.cmax, tree.num_buckets)
     return _unsort(order, d2, gi, Q)

