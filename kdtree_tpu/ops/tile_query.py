@@ -352,8 +352,11 @@ def plan_tiled(
     # each scan chunk must expose at least k candidate slots to lax.top_k
     v = max(_SCAN_V, -(-k // B))
     # batches bound each device program's runtime (watchdog) and memory;
-    # the global Hilbert sort happens ONCE, so batch slices stay coherent
+    # the global Hilbert sort happens ONCE, so batch slices stay coherent.
+    # Small Q must not pad up to the full batch quantum (Q=1024 padded to
+    # 2^16 would scan 64x more rows than asked) — cap at Q tile-rounded
     qbatch = max(_BATCH_Q // tile, 1) * tile
+    qbatch = min(qbatch, -(-max(Q, 1) // tile) * tile)
     return TiledPlan(tile, cmax, seeds, v, bits, qbatch, use_pallas)
 
 

@@ -190,19 +190,38 @@ def _build_index(points, engine: str, mesh_devices: int | None = None,
         from kdtree_tpu.parallel import make_mesh
         from kdtree_tpu.parallel.global_morton import build_global_morton
 
-        seed, dim, num_points = problem
+        seed, dim, num_points = problem[:3]
         return build_global_morton(
-            seed, dim, num_points, mesh=make_mesh(mesh_devices)
+            seed, dim, num_points, mesh=make_mesh(mesh_devices),
+            distribution=_problem_distribution(problem),
         )
     if engine == "global-exact":
         from kdtree_tpu.parallel import make_mesh
         from kdtree_tpu.parallel.global_exact import build_global_exact
 
-        seed, dim, num_points = problem
+        seed, dim, num_points = problem[:3]
         return build_global_exact(
-            seed, dim, num_points, mesh=make_mesh(mesh_devices)
+            seed, dim, num_points, mesh=make_mesh(mesh_devices),
+            distribution=_problem_distribution(problem),
         )
     raise SystemExit(f"engine {engine!r} has no split build phase")
+
+
+def _problem_distribution(problem) -> str:
+    """problem is (seed, dim, n) or (seed, dim, n, distribution)."""
+    return problem[3] if len(problem) > 3 else "uniform"
+
+
+def _check_distribution(engine: str, dist: str) -> None:
+    """Non-uniform row streams exist only for the generative scale engines
+    (shard-local generation); one guard shared by bench and build so the
+    two subcommands can't drift."""
+    if dist != "uniform" and engine not in ("global-morton", "global-exact"):
+        print(f"--distribution {dist} needs a generative scale engine "
+              "(global-morton / global-exact); other engines define their "
+              "problems by the uniform stream or user --points data",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 def _query_index(index, queries, k: int, engine: str,
@@ -265,7 +284,7 @@ def _solve(points, queries, k: int, engine: str, mesh_devices: int | None = None
             # generative seeded problem: shard-local generation fused into
             # the SPMD program — no [N, D] array anywhere (the reference's
             # discard trick, kdtree_mpi.cpp:19-41)
-            seed, pdim, num_points = problem
+            seed, pdim, num_points = problem[:3]
             return ensemble_knn_gen(seed, pdim, num_points, queries, k=k,
                                     mesh=mesh)
         return ensemble_knn(points, queries, k=k, mesh=mesh)
@@ -345,9 +364,12 @@ def cmd_bench(args) -> None:
     fused_gen = _generative(engine, args.generator)  # gen is fused into the build
     fused_bq = engine == "ensemble"  # one SPMD program by design
 
+    dist = getattr(args, "distribution", "uniform")
+    _check_distribution(engine, dist)
+
     def run(seed: int, timer: PhaseTimer | None):
         t = timer or PhaseTimer()
-        problem = (seed, args.dim, args.n)
+        problem = (seed, args.dim, args.n, dist)
         if fused_gen:
             from kdtree_tpu.ops.generate import generate_queries
 
@@ -504,6 +526,8 @@ def _load_array(path: str, what: str) -> "np.ndarray":
 def cmd_build(args) -> None:
     from kdtree_tpu.utils.checkpoint import save_tree
 
+    dist = getattr(args, "distribution", "uniform")
+    _check_distribution(args.engine, dist)
     if getattr(args, "points", None):
         # user data, not a seeded problem: build over an arbitrary point set
         # (the reference can only generate; a framework must also ingest)
@@ -526,10 +550,11 @@ def cmd_build(args) -> None:
                   f"{args.generator} does not apply", file=sys.stderr)
         tree = _build_tree_for_engine(
             None, args.engine, args.devices,
-            problem=(args.seed, args.dim, args.n),
+            problem=(args.seed, args.dim, args.n, dist),
         )
         n, dim = args.n, args.dim
-        meta = {"seed": args.seed, "generator": "threefry"}
+        meta = {"seed": args.seed, "generator": "threefry",
+                "distribution": dist}
     else:
         points, _, gen_used = _generate(args.seed, args.dim, args.n,
                                         args.generator)
@@ -654,6 +679,10 @@ def main(argv=None) -> None:
     b.add_argument("--dim", type=int, default=3)
     b.add_argument("--n", type=int, default=1 << 20)
     b.add_argument("--k", type=int, default=1)
+    b.add_argument("--distribution", choices=["uniform", "clustered"],
+                   default="uniform",
+                   help="generative row stream for the scale engines "
+                        "(clustered = Gaussian-mixture load-imbalance stress)")
     b.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (Perfetto) of the timed "
                         "run; phases appear as named TraceAnnotations")
@@ -666,6 +695,9 @@ def main(argv=None) -> None:
     bu.add_argument("--points", default=None, metavar="FILE",
                     help="build over user data ([N, D] .npy/.npz) instead of "
                          "a seeded problem")
+    bu.add_argument("--distribution", choices=["uniform", "clustered"],
+                    default="uniform",
+                    help="generative row stream for the scale engines")
     bu.add_argument("--out", required=True)
     bu.add_argument("--sharded", action="store_true",
                     help="force the per-device shard checkpoint format "
