@@ -34,8 +34,16 @@ def fetch(x):
 
 
 def main():
-    n, dim, k, p = 1 << 20, 3, 16, 8
-    Q = 1 << 16
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    # defaults sized for the 1-core CI host (the 2^20/2^16 shape runs >50min
+    # there); pass --n 20 --q 16 on a real multi-core box
+    ap.add_argument("--n", type=int, default=19)
+    ap.add_argument("--q", type=int, default=14)
+    args = ap.parse_args()
+    n, dim, k, p = 1 << args.n, 3, 16, 8
+    Q = 1 << args.q
     mesh = make_mesh(p)
     forest = build_global_morton(3, dim, n, mesh=mesh)
     qs = generate_queries(11, dim, Q)
