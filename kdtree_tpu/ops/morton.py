@@ -270,6 +270,48 @@ def build_morton(
     return _build_morton_jit(points, bucket_cap, bits)
 
 
+def morton_view(
+    points: jax.Array,
+    gid: jax.Array | None = None,
+    n_real: int | None = None,
+    bucket_cap: int = DEFAULT_BUCKET,
+    bits: int | None = None,
+) -> MortonTree:
+    """A Morton bucket tree over another index's point storage — the
+    dense-serving view that lets ANY checkpointed tree type answer big
+    query batches with the tiled engine (the same per-device trick
+    ``parallel.global_exact._to_forest_jit`` uses for the exact-median
+    forest, single-tree form).
+
+    ``gid`` maps row positions to the source index's original point ids
+    (required when ``points`` is padded storage, e.g. a BucketKDTree's
+    flattened buckets: +inf rows build into inf-leaves the tiled scan
+    prunes, and their slots map to id -1). ``n_real`` overrides the real
+    point count for density planning when ``points`` includes padding;
+    with ``gid`` given and ``n_real`` omitted it is derived as the count
+    of real ids (one host sync) — defaulting to the padded row count
+    would silently break the downstream ``k = min(k, n_real)`` clamp.
+    """
+    if gid is not None and n_real is None:
+        n_real = int((gid >= 0).sum())
+    tree = build_morton(points, bucket_cap=bucket_cap, bits=bits)
+    if gid is not None:
+        bg = jnp.where(
+            tree.bucket_gid >= 0, gid[jnp.maximum(tree.bucket_gid, 0)], -1
+        )
+        tree = MortonTree(
+            tree.node_lo, tree.node_hi, tree.bucket_pts, bg,
+            n_real=n_real if n_real is not None else tree.n_real,
+            num_levels=tree.num_levels,
+        )
+    elif n_real is not None and n_real != tree.n_real:
+        tree = MortonTree(
+            tree.node_lo, tree.node_hi, tree.bucket_pts, tree.bucket_gid,
+            n_real=n_real, num_levels=tree.num_levels,
+        )
+    return tree
+
+
 # ---------------------------------------------------------------------------
 # query
 # ---------------------------------------------------------------------------

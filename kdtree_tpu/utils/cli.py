@@ -477,13 +477,62 @@ def _tree_knn(tree, queries, k: int):
             return morton_knn_tiled(tree, queries, k=k)
         return morton_knn(tree, queries, k=k)
     if isinstance(tree, BucketKDTree):
+        if dense(tree.n_real):
+            def bucket_flat():
+                import jax.numpy as jnp
+
+                # the bucket tree's SPLIT points live in the internal nodes,
+                # not in any bucket — the view must include both (absent
+                # node slots masked to the standard inf/-1 padding)
+                node_pts = jnp.where(
+                    (tree.node_gid >= 0)[:, None], tree.node_coords, jnp.inf
+                )
+                flat = jnp.concatenate(
+                    [tree.bucket_pts.reshape(-1, dim), node_pts], axis=0
+                )
+                gids = jnp.concatenate(
+                    [tree.bucket_gid.reshape(-1), tree.node_gid]
+                )
+                return dict(points=flat, gid=gids, n_real=tree.n_real)
+
+            out = _serve_dense_via_view(tree, queries, k, bucket_flat)
+            if out is not None:
+                return out
         return bucket_knn(tree, queries, k=k)
     if isinstance(tree, GlobalKDTree):
         return global_knn(tree, queries, k=k)
     assert isinstance(tree, KDTree)
+    if dense(tree.points.shape[0]):
+        # classic tree stores the original [N, D] array; its Morton view
+        # serves dense batches with ids that are already original rows
+        out = _serve_dense_via_view(
+            tree, queries, k, lambda: dict(points=tree.points)
+        )
+        if out is not None:
+            return out
     from kdtree_tpu import knn
 
     return knn(tree, queries, k=k)
+
+
+def _serve_dense_via_view(tree, queries, k: int, make_flat):
+    """Cache-or-build a Morton view on a checkpointed classic/bucket tree
+    and serve the dense batch with the tiled engine. Returns None when the
+    view would exceed the single-chip build capacity budget — the caller
+    falls back to its (slower but memory-lean) DFS engine instead of
+    surfacing a confusing rebuild error for a query that used to work."""
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    view = getattr(tree, "_morton_view", None)
+    if view is None:
+        from kdtree_tpu.ops.morton import morton_view
+
+        try:
+            view = morton_view(**make_flat())
+        except ValueError:
+            return None
+        tree._morton_view = view
+    return morton_knn_tiled(view, queries, k=k)
 
 
 def _load_array(path: str, what: str) -> "np.ndarray":

@@ -244,3 +244,24 @@ def test_tree_knn_dense_batch_routing():
 
     fbf, _ = bruteforce.knn_exact_d2(generate_points_rowwise(6, 3, 900), qs, k=3)
     np.testing.assert_allclose(np.asarray(fd2), np.asarray(fbf), rtol=1e-5)
+
+    # classic and bucket trees also serve dense batches (via a one-time
+    # cached Morton view over their stored points), ids included
+    from kdtree_tpu import build_jit
+    from kdtree_tpu.ops.bucket import build_bucket
+
+    ct = build_jit(pts)
+    cd2, ci = _tree_knn(ct, qs, k=3)
+    np.testing.assert_allclose(np.asarray(cd2), np.asarray(bf), rtol=1e-5)
+    assert hasattr(ct, "_morton_view")  # the dense path actually ran
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - np.asarray(pts)[np.asarray(ci)]) ** 2,
+        axis=-1,
+    )
+    np.testing.assert_allclose(gather, np.asarray(cd2), rtol=1e-5)
+
+    bt = build_bucket(pts, bucket_cap=32)
+    bd2, bi = _tree_knn(bt, qs, k=3)
+    np.testing.assert_allclose(np.asarray(bd2), np.asarray(bf), rtol=1e-5)
+    assert hasattr(bt, "_morton_view")
+    assert int(np.asarray(bi).min()) >= 0
