@@ -140,3 +140,57 @@ def test_matches_per_query_dfs():
     td, _ = morton_knn_tiled(tree, qs, k=6)
     dd, _ = morton_knn(tree, qs, k=6)
     np.testing.assert_allclose(np.asarray(td), np.asarray(dd), rtol=1e-6)
+
+
+def test_drive_batches_cap_settle_and_straggler_retry():
+    """The shared async batch driver: the FIRST batch settles the cap in
+    doubling rounds, remaining batches dispatch at the settled cap, and a
+    geometry-driven straggler retries alone without re-running the rest."""
+    from kdtree_tpu.ops.tile_query import drive_batches
+
+    calls = []
+
+    def run_batch(b0, cap):
+        calls.append((b0, cap))
+        # batch 0 needs cap >= 4; batch 4 is a straggler needing cap >= 8
+        need = 8 if b0 == 4 else 4
+        ov = cap < need
+        return (
+            jnp.full((2, 1), float(cap)),
+            jnp.full((2, 1), b0, jnp.int32),
+            jnp.asarray(ov),
+        )
+
+    d2, gi = drive_batches(run_batch, [0, 2, 4], cmax=1, nbp=16)
+    # settle: (0,1)->(0,2)->(0,4); dispatch (2,4),(4,4); retry only (4,8)
+    assert calls == [(0, 1), (0, 2), (0, 4), (2, 4), (4, 4), (4, 8)], calls
+    assert d2.shape == (6, 1) and gi.shape == (6, 1)
+    np.testing.assert_array_equal(
+        np.asarray(gi).ravel(), [0, 0, 2, 2, 4, 4]
+    )
+    # batches 0/2 answered at cap 4, straggler at cap 8
+    np.testing.assert_array_equal(
+        np.asarray(d2).ravel(), [4.0, 4.0, 4.0, 4.0, 8.0, 8.0]
+    )
+
+
+def test_drive_batches_cap_ceiling_stops_retries():
+    """At cap == nbp the driver must stop doubling even if a batch still
+    flags overflow (overflow is impossible at nbp by construction; a buggy
+    flag must not loop forever)."""
+    from kdtree_tpu.ops.tile_query import drive_batches
+
+    calls = []
+
+    def run_batch(b0, cap):
+        calls.append((b0, cap))
+        return (
+            jnp.zeros((1, 1)),
+            jnp.zeros((1, 1), jnp.int32),
+            jnp.asarray(True),  # always claims overflow
+        )
+
+    d2, _ = drive_batches(run_batch, [0], cmax=2, nbp=8)
+    # settle rounds: 2 -> 4 -> 8, then stop (cap == nbp)
+    assert calls == [(0, 2), (0, 4), (0, 8)], calls
+    assert d2.shape == (1, 1)
