@@ -228,9 +228,17 @@ def _build_morton_jit(points, bucket_cap, bits):
 _MAX_BUILD_BYTES = 12 << 30
 
 
+class BuildCapacityError(ValueError):
+    """A single-chip build would exceed the device HBM budget.
+
+    A distinct type so the CLI can turn exactly this condition into a crisp
+    stderr + exit-code failure (C10) without masking unrelated ValueErrors,
+    and so routing layers can fall back to a non-materializing path."""
+
+
 def check_build_capacity(n: int, d: int, backend: str | None = None,
                          budget: int | None = None) -> None:
-    """Raise ValueError (instead of letting XLA compile-crash) when a
+    """Raise BuildCapacityError (instead of letting XLA compile-crash) when a
     single-chip Morton build would exceed the device memory budget."""
     import os
 
@@ -249,7 +257,7 @@ def check_build_capacity(n: int, d: int, backend: str | None = None,
             ) from None
     need = 3 * n * (d + 2) * 4
     if need > budget:
-        raise ValueError(
+        raise BuildCapacityError(
             f"single-chip Morton build of n={n}, d={d} needs ~{need >> 30} "
             f"GiB working set (> {budget >> 30} GiB budget); shard it with "
             "the global-morton engine (build_global_morton) instead, or "

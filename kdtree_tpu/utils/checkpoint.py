@@ -42,6 +42,31 @@ def _registry():
 _SHARD_SAVE_BYTES = 1 << 30
 _SHARDED_KINDS = ("global-morton", "global-exact")
 
+# Mesh-free loads of a sharded checkpoint concatenate every shard into dense
+# host arrays — exactly the host-memory funnel the format exists to avoid.
+# Above this budget the load fails crisply instead of OOMing; callers that
+# really want the dense fallback pass allow_host_materialize=True (CLI:
+# `query --allow-host-materialize`). 4x headroom over the auto-shard
+# threshold: a checkpoint just past _SHARD_SAVE_BYTES still cross-loads on
+# an ordinary host; north-star-scale ones fail crisply. Override with
+# KDTREE_TPU_HOST_MATERIALIZE_BYTES for big-RAM hosts.
+_HOST_MATERIALIZE_BYTES = 4 << 30
+
+
+def _host_materialize_budget() -> int:
+    import os
+
+    raw = os.environ.get("KDTREE_TPU_HOST_MATERIALIZE_BYTES")
+    if raw is None:
+        return _HOST_MATERIALIZE_BYTES
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"KDTREE_TPU_HOST_MATERIALIZE_BYTES must be an integer byte "
+            f"count, got {raw!r}"
+        ) from None
+
 
 def _shard_path(path: str, i: int, tag: str) -> str:
     # the tag makes each save's shard set self-contained: a crashed re-save
@@ -127,9 +152,23 @@ def save_tree(path: str, tree, meta: dict | None = None,
     # write through an open file object: np.savez_compressed(str_path)
     # silently appends '.npz' to extension-less paths, while the sharded
     # manifest writes byte-exact — the on-disk name must not depend on
-    # which format the auto-threshold picked
-    with open(path, "wb") as f:
-        np.savez_compressed(f, **payload)
+    # which format the auto-threshold picked. Write to a tmp file and
+    # os.replace so a crash mid-write never truncates the previous
+    # checkpoint (the sharded manifest already does this).
+    import os
+    import uuid
+
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     _cleanup_stale_shards(path, keep_tag=None)
     return "single"
 
@@ -173,6 +212,13 @@ def _save_sharded(path, kind, tree, children, aux, meta) -> None:
         "num_shards": np.asarray(p, dtype=np.int64),
         "num_children": np.asarray(len(children), dtype=np.int64),
         "sharded_mask": np.asarray(is_dev, dtype=np.bool_),
+        # uncompressed bytes of ONE shard's arrays, so the mesh-free load
+        # can size its host-materialize check without decompressing a shard
+        "shard_bytes": np.asarray(
+            sum(int(np.prod(c.shape[1:])) * c.dtype.itemsize
+                for j, c in enumerate(children) if is_dev[j]),
+            dtype=np.int64,
+        ),
     }
     for j, c in enumerate(children):
         if not is_dev[j]:
@@ -187,14 +233,16 @@ def _save_sharded(path, kind, tree, children, aux, meta) -> None:
     _cleanup_stale_shards(path, keep_tag=tag)
 
 
-def _load_sharded(path: str, z, meta):
+def _load_sharded(path: str, z, meta, allow_host_materialize: bool = False):
     """Assemble a forest from per-device shard files.
 
     With a mesh of >= num_shards devices available, each sharded child is
     device_put straight onto its mesh position and the global arrays are
     assembled with ``jax.make_array_from_single_device_arrays`` — host RSS
     peaks at ~one shard. Without one (cross-hardware load), shards
-    concatenate into dense host arrays (the mesh-free query path's input).
+    concatenate into dense host arrays (the mesh-free query path's input) —
+    but only up to ``_HOST_MATERIALIZE_BYTES`` unless the caller opts in,
+    because at auto-shard scale that concatenation would OOM the host.
     Replicated children come straight out of the manifest.
     """
     import jax
@@ -245,6 +293,28 @@ def _load_sharded(path: str, z, meta):
                 shape, sharding, singles[j]
             )
     else:
+        # size the dense fallback WITHOUT touching shard data: the manifest
+        # records one shard's uncompressed bytes (pre-r5 manifests lack the
+        # key; fall back to decompressing shard 0's arrays for their shapes)
+        if "shard_bytes" in z.files:
+            shard_bytes = int(z["shard_bytes"])
+        else:
+            with _open_shard(0) as z0:
+                shard_bytes = 0
+                for j in dev_idx:
+                    c = z0[f"child_{j}"]  # one decompression per child
+                    shard_bytes += int(np.prod(c.shape)) * c.dtype.itemsize
+        total = shard_bytes * p
+        if total > _host_materialize_budget() and not allow_host_materialize:
+            raise ValueError(
+                f"sharded checkpoint {path} holds ~{total / 2**30:.1f} GiB "
+                f"across {p} shards but only {len(jax.devices())} device(s) "
+                f"are visible — the mesh-free fallback would materialize all "
+                f"of it in host memory. Load on a mesh of >= {p} devices, "
+                f"pass allow_host_materialize=True to load_tree (CLI: "
+                f"`query --allow-host-materialize`), or raise "
+                f"KDTREE_TPU_HOST_MATERIALIZE_BYTES."
+            )
         parts = {j: [] for j in dev_idx}
         for i in range(p):
             with _open_shard(i) as zs:
@@ -259,8 +329,13 @@ def _load_sharded(path: str, z, meta):
     return cls.tree_unflatten(aux, children), meta
 
 
-def load_tree(path: str):
-    """Returns (tree, meta); the tree type round-trips via the saved kind."""
+def load_tree(path: str, allow_host_materialize: bool = False):
+    """Returns (tree, meta); the tree type round-trips via the saved kind.
+
+    ``allow_host_materialize`` opts in to the dense host fallback when a
+    sharded checkpoint is loaded without a big-enough mesh (see
+    ``_load_sharded``).
+    """
     import jax.numpy as jnp
 
     with np.load(path) as z:
@@ -270,7 +345,7 @@ def load_tree(path: str):
             if k.startswith("meta_")
         }
         if "format" in z.files and str(z["format"]) == "sharded-v1":
-            tree, meta = _load_sharded(path, z, meta)
+            tree, meta = _load_sharded(path, z, meta, allow_host_materialize)
             from kdtree_tpu.utils.guards import validate_loaded_tree
 
             validate_loaded_tree(tree)

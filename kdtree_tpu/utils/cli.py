@@ -159,13 +159,14 @@ def _resolve_engine(engine: str, dim: int, q: int | None = None,
 
 
 def _build_index(points, engine: str, mesh_devices: int | None = None,
-                 problem=None):
+                 problem=None, slack: float | None = None):
     """Build phase: the index object for an engine.
 
     ``problem`` = (seed, dim, num_points) is required by the generative
     ``global-morton`` engine, whose build NEVER materializes the [N, D]
     array (shard-local generation is fused into the build; ``points`` is
-    ignored there and may be None).
+    ignored there and may be None). ``slack`` overrides the scale engines'
+    exchange-capacity factor (the overflow errors name it as the remedy).
     """
     if engine in ("morton", "tiled"):
         from kdtree_tpu.ops.morton import build_morton
@@ -191,18 +192,20 @@ def _build_index(points, engine: str, mesh_devices: int | None = None,
         from kdtree_tpu.parallel.global_morton import build_global_morton
 
         seed, dim, num_points = problem[:3]
+        kw = {} if slack is None else {"slack": slack}
         return build_global_morton(
             seed, dim, num_points, mesh=make_mesh(mesh_devices),
-            distribution=_problem_distribution(problem),
+            distribution=_problem_distribution(problem), **kw,
         )
     if engine == "global-exact":
         from kdtree_tpu.parallel import make_mesh
         from kdtree_tpu.parallel.global_exact import build_global_exact
 
         seed, dim, num_points = problem[:3]
+        kw = {} if slack is None else {"slack": slack}
         return build_global_exact(
             seed, dim, num_points, mesh=make_mesh(mesh_devices),
-            distribution=_problem_distribution(problem),
+            distribution=_problem_distribution(problem), **kw,
         )
     raise SystemExit(f"engine {engine!r} has no split build phase")
 
@@ -423,7 +426,7 @@ def cmd_bench(args) -> None:
 
 
 def _build_tree_for_engine(points, engine: str, mesh_devices: int | None,
-                           problem=None):
+                           problem=None, slack: float | None = None):
     """Build the tree object matching the engine choice (for checkpointing).
 
     "auto" resolves to the Morton tree — same as _solve's auto for low D, and
@@ -435,7 +438,8 @@ def _build_tree_for_engine(points, engine: str, mesh_devices: int | None,
 
         return build_morton(points)
     if engine in ("bucket", "tree", "global", "global-morton", "global-exact"):
-        return _build_index(points, engine, mesh_devices, problem=problem)
+        return _build_index(points, engine, mesh_devices, problem=problem,
+                            slack=slack)
     raise SystemExit(f"engine {engine!r} does not produce a checkpointable tree")
 
 
@@ -572,6 +576,32 @@ def _load_array(path: str, what: str) -> "np.ndarray":
     return arr
 
 
+def _open_points_streaming(path: str) -> "np.ndarray":
+    """Open a user point file for shard-block streaming ingest.
+
+    ``.npy`` opens as a memmap — the scale tier's whole reason to ingest is
+    files bigger than one host/device can hold, so the array must never
+    fully materialize here (per-block finiteness checks happen in
+    ``_stream_rows_to_mesh`` as each shard block is touched). Anything else
+    (npz, odd dtypes) falls back to the validating in-memory loader."""
+    if path.endswith(".npy"):
+        try:
+            arr = np.load(path, mmap_mode="r", allow_pickle=False)
+        except (OSError, ValueError) as e:
+            print(f"cannot load points file {path}: {e}", file=sys.stderr)
+            sys.exit(1)
+        if arr.ndim != 2 or arr.shape[0] < 1 or arr.shape[1] < 1:
+            print(f"points file {path} must be non-empty [N, D], got shape "
+                  f"{arr.shape}", file=sys.stderr)
+            sys.exit(1)
+        if not np.issubdtype(arr.dtype, np.number):
+            print(f"points file {path} must be numeric, got dtype "
+                  f"{arr.dtype}", file=sys.stderr)
+            sys.exit(1)
+        return arr
+    return _load_array(path, "points")
+
+
 def cmd_build(args) -> None:
     from kdtree_tpu.utils.checkpoint import save_tree
 
@@ -580,17 +610,40 @@ def cmd_build(args) -> None:
     if getattr(args, "points", None):
         # user data, not a seeded problem: build over an arbitrary point set
         # (the reference can only generate; a framework must also ingest)
-        if args.engine in ("global-morton", "global-exact"):
-            print(f"engine {args.engine} is generative (shard-local row "
-                  "streams); use a materialized engine for --points",
-                  file=sys.stderr)
+        if args.engine == "global-exact":
+            print("engine global-exact is generative (exact-median row "
+                  "streams); use global-morton for scale-tier --points "
+                  "ingest, or a materialized engine", file=sys.stderr)
             sys.exit(1)
-        import jax.numpy as jnp
+        if args.engine == "global-morton":
+            # scale-tier ingest (VERDICT r4 missing #3): rows stream host ->
+            # mesh one shard-block at a time (memmap for .npy — the file
+            # never fully materializes on the host), then the standard
+            # one-all_to_all sample-sort partition
+            from kdtree_tpu.parallel import make_mesh
+            from kdtree_tpu.parallel.global_morton import (
+                build_global_morton_from_points,
+            )
 
-        points = jnp.asarray(_load_array(args.points, "points"))
-        tree = _build_tree_for_engine(points, args.engine, args.devices)
-        n, dim = points.shape
-        meta = {"generator": "file"}
+            arr = _open_points_streaming(args.points)
+            skw = ({} if getattr(args, "slack", None) is None
+                   else {"slack": args.slack})
+            try:
+                tree = build_global_morton_from_points(
+                    arr, mesh=make_mesh(args.devices), **skw)
+            except (ValueError, RuntimeError) as e:
+                print(f"cannot build from {args.points}: {e}",
+                      file=sys.stderr)
+                sys.exit(1)
+            n, dim = arr.shape
+            meta = {"generator": "file"}
+        else:
+            import jax.numpy as jnp
+
+            points = jnp.asarray(_load_array(args.points, "points"))
+            tree = _build_tree_for_engine(points, args.engine, args.devices)
+            n, dim = points.shape
+            meta = {"generator": "file"}
     elif args.engine in ("global-morton", "global-exact"):
         # generative: never materialize [N, D]; provenance = threefry rows
         if args.generator != "threefry":
@@ -600,6 +653,7 @@ def cmd_build(args) -> None:
         tree = _build_tree_for_engine(
             None, args.engine, args.devices,
             problem=(args.seed, args.dim, args.n, dist),
+            slack=getattr(args, "slack", None),
         )
         n, dim = args.n, args.dim
         meta = {"seed": args.seed, "generator": "threefry",
@@ -633,7 +687,11 @@ def cmd_query(args) -> None:
     import zipfile
 
     try:
-        tree, meta = load_tree(args.tree)
+        tree, meta = load_tree(
+            args.tree,
+            allow_host_materialize=getattr(
+                args, "allow_host_materialize", False),
+        )
     except (OSError, ValueError, zipfile.BadZipFile) as e:
         # missing manifest, missing sharded sidecar files, corrupt or
         # truncated npz (BadZipFile is neither OSError nor ValueError) —
@@ -747,6 +805,10 @@ def main(argv=None) -> None:
     bu.add_argument("--distribution", choices=["uniform", "clustered"],
                     default="uniform",
                     help="generative row stream for the scale engines")
+    bu.add_argument("--slack", type=float, default=None,
+                    help="scale-engine exchange capacity factor (the "
+                         "'capacity overflow ... retry with slack > X' "
+                         "errors name this as the remedy)")
     bu.add_argument("--out", required=True)
     bu.add_argument("--sharded", action="store_true",
                     help="force the per-device shard checkpoint format "
@@ -764,6 +826,10 @@ def main(argv=None) -> None:
     q.add_argument("--out", default=None, metavar="FILE",
                    help="with --queries: save (d2, ids) npz instead of "
                         "printing protocol lines")
+    q.add_argument("--allow-host-materialize", action="store_true",
+                   help="permit a mesh-free load of a sharded checkpoint to "
+                        "assemble ALL shards in host memory (otherwise "
+                        "loads above the host budget fail crisply)")
     q.set_defaults(fn=cmd_query)
 
     args = p.parse_args(argv)
@@ -775,7 +841,15 @@ def main(argv=None) -> None:
         # Usage parity with Utility.cpp:109-112
         print(f"Usage: {p.prog} harness SEED DIM_POINTS  NUM_POINTS", file=sys.stderr)
         sys.exit(1)
-    args.fn(args)
+    from kdtree_tpu.ops.morton import BuildCapacityError
+
+    try:
+        args.fn(args)
+    except BuildCapacityError as e:
+        # the HBM guard (ops/morton.py) protects every subcommand; surface
+        # it with the crisp stderr + exit-code contract (C10), not a traceback
+        print(str(e), file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,62 @@
+"""Measure the DFS engine's chunk loop at the sparse 64k-query shape on
+the real chip (VERDICT r3 item 8 / r4 item 9).
+
+Code analysis says ``morton_knn``'s chunk loop is already async — each
+``_morton_knn_batch`` dispatch returns without a host fetch, so the ~16
+device programs queue back-to-back and the single sync happens at the
+final concatenate. The async leg reuses ``bench.bench_sparse_dfs`` (the
+same measurement the driver bench records); this script adds the
+per-chunk-SYNCED contrast run that quantifies what the async dispatch
+saves.
+
+Run on the real chip; one JSON line out.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def main():
+    n, dim, q, k, chunk = 1 << 24, 3, 1 << 16, 16, 4096
+    backend = jax.default_backend()
+
+    import bench
+    import kdtree_tpu as kt
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+    from kdtree_tpu.ops.morton import _morton_knn_batch, build_morton
+
+    pts = generate_points_rowwise(3, dim, n)
+    tree = build_morton(pts)
+    jax.block_until_ready(tree.bucket_pts)
+
+    t_async, ok = bench.bench_sparse_dfs(kt, tree, pts, q, k)
+
+    qs = generate_queries(55, dim, q)
+    np.asarray(_morton_knn_batch(tree, qs[:chunk], k, chunk)[0][:1])  # warmup
+    t0 = time.perf_counter()
+    for i in range(0, q, chunk):
+        d2c, _ = _morton_knn_batch(tree, qs[i : i + chunk], k, chunk)
+        np.asarray(d2c[:1])  # forced per-chunk host sync (the contrast)
+    t_sync = time.perf_counter() - t0
+
+    print(json.dumps({
+        "ok": bool(ok),
+        "backend": backend, "n": n, "q": q, "k": k, "chunk": chunk,
+        "async_s": round(t_async, 4),
+        "per_chunk_sync_s": round(t_sync, 4),
+        "async_q_per_s": round(q / t_async),
+        "sync_overhead_x": round(t_sync / t_async, 2),
+        "loop_is_async": bool(t_async <= t_sync * 1.02),
+    }))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

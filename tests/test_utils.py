@@ -128,6 +128,61 @@ def test_checkpoint_sharded_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(d14), np.asarray(ref14))
 
 
+def test_checkpoint_single_save_atomic(tmp_path, monkeypatch):
+    """ADVICE r4: a crashed single-npz re-save must not truncate the
+    previous checkpoint — the write goes to a tmp file and os.replace's
+    into place (same discipline as the sharded manifest)."""
+    pts, _ = generate_problem(seed=2, dim=3, num_points=300, num_queries=1)
+    tree = build_jit(pts)
+    path = str(tmp_path / "tree.npz")
+    save_tree(path, tree, meta={"seed": 2, "generator": "threefry"})
+
+    def boom(*a, **kw):
+        raise RuntimeError("disk died mid-write")
+
+    monkeypatch.setattr(np, "savez_compressed", boom)
+    with pytest.raises(RuntimeError, match="disk died"):
+        save_tree(path, tree, meta={"seed": 99})
+    monkeypatch.undo()
+    # the old checkpoint survives intact, and no tmp litter remains
+    tree2, meta = load_tree(path)
+    assert meta["seed"] == 2
+    np.testing.assert_array_equal(
+        np.asarray(tree.node_point), np.asarray(tree2.node_point))
+    assert list(tmp_path.glob("tree.npz.tmp-*")) == []
+
+
+def test_checkpoint_meshfree_load_budget_guard(tmp_path, monkeypatch):
+    """VERDICT r4 weak #5: loading a sharded checkpoint without a matching
+    mesh concatenates every shard on the host — above the budget that must
+    fail crisply (naming the opt-out) instead of OOMing."""
+    import unittest.mock as mock
+
+    import jax
+
+    from kdtree_tpu.parallel import make_mesh
+    from kdtree_tpu.parallel.global_morton import (
+        GlobalMortonForest, build_global_morton,
+    )
+    from kdtree_tpu.utils import checkpoint
+
+    forest = build_global_morton(13, 3, 1037, mesh=make_mesh(8))
+    path = str(tmp_path / "f.npz")
+    assert save_tree(path, forest, sharded=True) == "sharded"
+
+    real_devices = jax.devices()
+    monkeypatch.setattr(checkpoint, "_HOST_MATERIALIZE_BYTES", 1024)
+    with mock.patch.object(jax, "devices", return_value=real_devices[:1]):
+        with pytest.raises(ValueError, match="allow_host_materialize"):
+            load_tree(path)
+        # explicit opt-in takes the dense fallback and round-trips exactly
+        dense, _ = load_tree(path, allow_host_materialize=True)
+    children, _ = GlobalMortonForest.tree_flatten(dense)
+    ref_children, _ = GlobalMortonForest.tree_flatten(forest)
+    for c, rc in zip(children, ref_children):
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(rc))
+
+
 def test_checkpoint_sharded_sidecar_and_cleanup(tmp_path):
     """Code-review findings: a manifest copied without its sidecar shard
     files must fail with a message naming them (not a bare ENOENT), and a
