@@ -320,6 +320,27 @@ def morton_view(
     return tree
 
 
+def serving_view(owner, make_inputs, cache_attr: str = "_morton_view"):
+    """Cache-or-build a dense-serving :func:`morton_view` on ``owner``.
+
+    The shared shape of every "serve a checkpointed index with the tiled
+    engine" trick (classic/bucket trees in the CLI, the mesh-free forest
+    path): build the view once from ``make_inputs() ->`` ``morton_view``
+    kwargs, cache it on the object, and return ``None`` when the view
+    would exceed the single-chip HBM budget (``BuildCapacityError``) so
+    the caller falls back to its memory-lean engine instead of surfacing
+    a confusing rebuild error for a query that used to work."""
+    view = getattr(owner, cache_attr, None)
+    if view is not None:
+        return view
+    try:
+        view = morton_view(**make_inputs())
+    except BuildCapacityError:
+        return None
+    setattr(owner, cache_attr, view)
+    return view
+
+
 # ---------------------------------------------------------------------------
 # query
 # ---------------------------------------------------------------------------

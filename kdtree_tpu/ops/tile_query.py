@@ -112,8 +112,14 @@ def _frontier(tree: MortonTree, box_lo, box_hi, bound, cap: int):
             [ids, jnp.zeros((T, cap - m), jnp.int32)], axis=1
         )
         lb = jnp.concatenate([lb, jnp.full((T, cap - m), jnp.inf)], axis=1)
-    lb, ids = lax.sort((lb, ids), num_keys=1, is_stable=True)
-    ids, lb = ids[:, :cap], lb[:, :cap]
+    # top_k(-lb) keeps the cap-smallest lbs in ascending order — same kept
+    # set as a full sort-truncate at ~2.5x less stage time (measured, the
+    # r3 "top_k frontier" candidate; kept-set identity asserted in
+    # scripts/profile_stages.py's A/B). Tie choice at the cap edge cannot
+    # affect exactness: if more than cap nodes pass the bound, overflow is
+    # already True and the caller retries with a bigger cap.
+    neg, sel = lax.top_k(-lb, cap)
+    lb, ids = -neg, jnp.take_along_axis(ids, sel, axis=1)
 
     for _ in range(s, L):
         alive = jnp.isfinite(lb)
@@ -123,8 +129,8 @@ def _frontier(tree: MortonTree, box_lo, box_hi, bound, cap: int):
         clb = _gathered_box_lb(tree, box_lo, box_hi, safe)
         clb = jnp.where(calive & (clb <= bound[:, None]), clb, jnp.inf)
         overflow = overflow | (jnp.sum(jnp.isfinite(clb), axis=1) > cap)
-        clb, cids = lax.sort((clb, cids), num_keys=1, is_stable=True)
-        ids, lb = cids[:, :cap], clb[:, :cap]
+        neg, sel = lax.top_k(-clb, cap)
+        lb, ids = -neg, jnp.take_along_axis(cids, sel, axis=1)
 
     bucket = jnp.where(jnp.isfinite(lb), ids - first_leaf, -1)
     return bucket, lb, overflow

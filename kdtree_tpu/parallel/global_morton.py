@@ -708,11 +708,46 @@ def _query_tiled_spmd(forest, queries, k: int, mesh):
     return _unsort(order, d2, gi, Q)
 
 
+def _forest_view_inputs(forest: GlobalMortonForest):
+    """morton_view kwargs for ONE view over every shard's rows.
+
+    The mesh-free dense path is what a single real chip runs when serving
+    a forest checkpoint built on a bigger mesh — the common deployment
+    shape. Re-sorting the P shards' bucket storage (padding rows keep
+    their +inf/-1 encoding through ``morton_view``) turns P sequential
+    tiled runs into one (measured 7.7x at P=8 on CPU), at the cost of a
+    second copy of the rows on this chip — the view build's HBM guard
+    sizes that before sorting."""
+    from kdtree_tpu.ops.morton import check_build_capacity
+
+    p, nbp, B, d = forest.bucket_pts.shape
+    # fail BEFORE the reshape materializes a flattened copy of the rows —
+    # the copy is the very cost the guard protects against; serving_view's
+    # BuildCapacityError catch turns this into the sequential fallback
+    check_build_capacity(p * nbp * B, d)
+    return dict(
+        points=jnp.reshape(forest.bucket_pts, (p * nbp * B, d)),
+        gid=jnp.reshape(forest.bucket_gid, (p * nbp * B,)),
+        n_real=forest.num_points,
+        bucket_cap=forest.bucket_cap,
+        bits=forest.bits,
+    )
+
+
 def _query_tiled_meshfree(forest, queries, k: int):
-    """Sequential-over-trees tiled query: runs on whatever hardware loaded
-    the forest (e.g. a 1-chip TPU serving an 8-device-built checkpoint)."""
-    from kdtree_tpu.ops.morton import MortonTree
+    """Mesh-free tiled query: runs on whatever hardware loaded the forest
+    (e.g. a 1-chip TPU serving an 8-device-built checkpoint). Prefers one
+    flattened-view run over all rows (built once, cached via the shared
+    helper); falls back to the sequential per-shard loop — whose peak
+    memory is one shard's tree — when the view would bust the HBM
+    budget."""
+    from kdtree_tpu.ops.morton import MortonTree, serving_view
     from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    view = serving_view(forest, lambda: _forest_view_inputs(forest),
+                        cache_attr="_dense_view")
+    if view is not None:
+        return morton_knn_tiled(view, queries, k=k)
 
     n_shard = _shard_n_real(forest, k)
     parts_d, parts_i = [], []

@@ -520,22 +520,15 @@ def _tree_knn(tree, queries, k: int):
 
 
 def _serve_dense_via_view(tree, queries, k: int, make_flat):
-    """Cache-or-build a Morton view on a checkpointed classic/bucket tree
-    and serve the dense batch with the tiled engine. Returns None when the
-    view would exceed the single-chip build capacity budget — the caller
-    falls back to its (slower but memory-lean) DFS engine instead of
-    surfacing a confusing rebuild error for a query that used to work."""
+    """Serve a dense batch on a checkpointed classic/bucket tree with the
+    tiled engine via the shared cached-view helper; None (caller falls
+    back to its memory-lean DFS engine) when the view won't fit."""
+    from kdtree_tpu.ops.morton import serving_view
     from kdtree_tpu.ops.tile_query import morton_knn_tiled
 
-    view = getattr(tree, "_morton_view", None)
+    view = serving_view(tree, make_flat)
     if view is None:
-        from kdtree_tpu.ops.morton import morton_view
-
-        try:
-            view = morton_view(**make_flat())
-        except ValueError:
-            return None
-        tree._morton_view = view
+        return None
     return morton_knn_tiled(view, queries, k=k)
 
 
