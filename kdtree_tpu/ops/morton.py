@@ -288,8 +288,8 @@ def morton_view(
     """A Morton bucket tree over another index's point storage — the
     dense-serving view that lets ANY checkpointed tree type answer big
     query batches with the tiled engine (the same per-device trick
-    ``parallel.global_exact._to_forest_jit`` uses for the exact-median
-    forest, single-tree form).
+    ``parallel.global_morton._local_forest_jit`` applies across a device
+    axis, single-tree form).
 
     ``gid`` maps row positions to the source index's original point ids
     (required when ``points`` is padded storage, e.g. a BucketKDTree's
@@ -453,10 +453,15 @@ def morton_knn(
     Returns (dists_sq f32[Q, k], indices i32[Q, k]) ascending. Queries run
     in fixed-size chunks, one device program per chunk: bounded memory,
     local lockstep divergence, and no single program long enough to trip
-    an execution watchdog. For large Q prefer
-    :func:`kdtree_tpu.ops.tile_query.morton_knn_tiled` (dense, orders of
-    magnitude faster at scale); this DFS engine wins for small/sparse
-    batches.
+    an execution watchdog. The chunk loop is ASYNC by construction —
+    no host fetch between dispatches, so the per-chunk programs queue
+    back-to-back on device and the single sync happens at the caller's
+    first use of the concatenated result (the driver bench's
+    ``sparse DFS`` extra and ``scripts/measure_sparse_dfs.py`` record
+    the measured q/s and the per-chunk-synced contrast). For large Q
+    prefer :func:`kdtree_tpu.ops.tile_query.morton_knn_tiled` (dense,
+    orders of magnitude faster at scale); this DFS engine wins for
+    small/sparse batches.
     """
     k = min(k, tree.n_real)
     q = queries.shape[0]

@@ -435,26 +435,6 @@ def _query_meshfree_jit(top_pts, top_gid, lpts, lnode, lsplit, lgid, queries,
     return _fold_top(md, mi, top_pts, top_gid, queries, k)
 
 
-@functools.partial(jax.jit, static_argnames=("bucket_cap", "bits"))
-def _to_forest_jit(lpts, lgid, bucket_cap, bits):
-    """Per-device Morton bucket trees over the exact tree's local rows.
-
-    Pure per-device work (vmap over the leading axis, no collectives) —
-    with mesh-sharded inputs XLA keeps the map sharded, so the conversion
-    runs where the rows already live. Width-padding rows (inf coords,
-    lgid -1) build into inf-leaves the tiled scan prunes; their bucket
-    slots map to gid -1 like every other padding row."""
-    from kdtree_tpu.ops.morton import build_morton_impl
-
-    def one(pts_, gid_):
-        t = build_morton_impl(pts_, bucket_cap=bucket_cap, bits=bits)
-        bg = jnp.where(t.bucket_gid >= 0,
-                       gid_[jnp.maximum(t.bucket_gid, 0)], -1)
-        return t.node_lo, t.node_hi, t.bucket_pts, bg
-
-    return jax.vmap(one)(lpts, lgid)
-
-
 def _exact_to_forest(tree: GlobalExactTree, bucket_cap: int = 128):
     """One-time view of the exact-median tree as a GlobalMortonForest (the
     top-heap medians excepted — they live in no local tree and are folded
@@ -479,15 +459,16 @@ def _exact_to_forest(tree: GlobalExactTree, bucket_cap: int = 128):
         ndev = 1
     check_build_capacity(-((p * rows) // -ndev), tree.dim)
     bits = max(1, min(32 // max(tree.dim, 1), 16))
-    nl, nh, bp, bg = _to_forest_jit(tree.local_pts, tree.local_gid,
-                                    bucket_cap, bits)
+    # the shared no-exchange local-build map (vmap over the device axis —
+    # with mesh-sharded inputs XLA keeps the sorts where the rows live);
+    # occ rides along so tile planning sees the real density (r4 weak #6)
+    from .global_morton import _local_forest_jit
+
+    nl, nh, bp, bg, occ = _local_forest_jit(tree.local_pts, tree.local_gid,
+                                            bucket_cap, bits)
     forest = GlobalMortonForest(
         nl, nh, bp, bg, num_points=tree.num_points, seed=tree.seed,
-        bucket_cap=bucket_cap, bits=bits,
-        # exact-median partitions are near-balanced by construction, but the
-        # true per-device occupancy is one cheap reduction away — record it
-        # so tile planning sees the real density (VERDICT r4 weak #6)
-        occ_max=int(jnp.max(jnp.sum(tree.local_gid >= 0, axis=1))),
+        bucket_cap=bucket_cap, bits=bits, occ_max=int(jnp.max(occ)),
     )
     tree._forest_cache = forest
     return forest

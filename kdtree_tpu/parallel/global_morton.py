@@ -43,7 +43,7 @@ loses even the ids, ``kdtree_mpi.cpp:253``).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -612,6 +612,114 @@ def build_global_morton_from_points(
         node_lo, node_hi, bucket_pts, bucket_gid,
         num_points=n, seed=-1, bucket_cap=bucket_cap, bits=bits,
         occ_max=int(jnp.max(occ)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("bucket_cap", "bits"))
+def _local_forest_jit(lpts, lgid, bucket_cap, bits):
+    """Per-device Morton bucket trees over already-placed rows — no
+    exchange. Pure per-device work (vmap over the leading axis, no
+    collectives), so with mesh-sharded inputs XLA keeps the builds where
+    the rows live. Padding rows (inf coords, lgid -1) build into
+    inf-leaves the scans prune. Shared by the pre-sharded-file ingest
+    here and the exact tree's forest view
+    (:func:`kdtree_tpu.parallel.global_exact._exact_to_forest`)."""
+
+    def one(pts_, gid_):
+        t = build_morton_impl(pts_, bucket_cap=bucket_cap, bits=bits)
+        bg = jnp.where(t.bucket_gid >= 0,
+                       gid_[jnp.maximum(t.bucket_gid, 0)], -1)
+        occ = jnp.sum((gid_ >= 0).astype(jnp.int32))
+        return t.node_lo, t.node_hi, t.bucket_pts, bg, occ
+
+    return jax.vmap(one)(lpts, lgid)
+
+
+def build_global_morton_from_shard_files(
+    paths: Sequence[str],
+    mesh: Mesh | None = None,
+    bucket_cap: int = 128,
+) -> GlobalMortonForest:
+    """Build the scale-mode index over PRE-SHARDED per-device files:
+    file i becomes device i's shard as-is, with NO redistribution.
+
+    The alternative ingest route to :func:`build_global_morton_from_points`
+    for data a user has already partitioned (one .npy per device — e.g. a
+    prior export, or a spatial partitioner's output). Forest-query
+    exactness needs only that the shards partition the point set — the
+    merge scans every shard — so skipping the exchange is correct for ANY
+    file contents, including spatially-partitioned files that would
+    concentrate onto one destination if pushed through the sample-sort
+    exchange. Balance is the caller's choice of files; the worst shard's
+    occupancy is recorded for tile planning either way. Global ids are
+    row offsets into the files' concatenation, in argument order.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    if not paths:
+        raise ValueError("need at least one shard file")
+    arrs = []
+    dim = None
+    for path in paths:
+        a = np.load(path, mmap_mode="r", allow_pickle=False)
+        if a.ndim != 2 or a.shape[0] < 1 or a.shape[1] < 1:
+            raise ValueError(
+                f"shard file {path} must be non-empty [N, D], got shape "
+                f"{a.shape}"
+            )
+        if dim is None:
+            dim = int(a.shape[1])
+        elif int(a.shape[1]) != dim:
+            raise ValueError(
+                f"shard file {path} is {a.shape[1]}-D but earlier shards "
+                f"are {dim}-D"
+            )
+        arrs.append(a)
+    p = len(arrs)
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh(p)
+    if mesh.shape[SHARD_AXIS] != p:
+        raise ValueError(
+            f"{p} shard files need a {p}-device mesh, got "
+            f"{mesh.shape[SHARD_AXIS]}"
+        )
+    width = max(a.shape[0] for a in arrs)
+    # each device sorts `width` rows in its local build — same HBM shape
+    # as a single-chip Morton build, so the same crisp guard applies
+    # (BuildCapacityError instead of an XLA compile crash)
+    from kdtree_tpu.ops.morton import check_build_capacity
+
+    check_build_capacity(width, dim)
+    offsets = np.concatenate([[0], np.cumsum([a.shape[0] for a in arrs])])
+    n = int(offsets[-1])
+    devs = list(mesh.devices.flat)
+    pts_parts, gid_parts = [], []
+    for i, a in enumerate(arrs):
+        block = np.asarray(a, dtype=np.float32)
+        if not np.isfinite(block).all():
+            raise ValueError(f"shard file {paths[i]} contains non-finite "
+                             "values")
+        gblock = np.arange(offsets[i], offsets[i + 1], dtype=np.int32)
+        pad = width - block.shape[0]
+        if pad:
+            block = np.concatenate(
+                [block, np.full((pad, dim), np.inf, np.float32)])
+            gblock = np.concatenate([gblock, np.full(pad, -1, np.int32)])
+        pts_parts.append(jax.device_put(block[None], devs[i]))
+        gid_parts.append(jax.device_put(gblock[None], devs[i]))
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    lpts = jax.make_array_from_single_device_arrays(
+        (p, width, dim), sharding, pts_parts)
+    lgid = jax.make_array_from_single_device_arrays(
+        (p, width), sharding, gid_parts)
+    bits = max(1, min(32 // max(dim, 1), 16))
+    nl, nh, bp, bg, occ = _local_forest_jit(lpts, lgid, bucket_cap, bits)
+    return GlobalMortonForest(
+        nl, nh, bp, bg, num_points=n, seed=-1, bucket_cap=bucket_cap,
+        bits=bits, occ_max=int(jnp.max(occ)),
     )
 
 

@@ -609,27 +609,81 @@ def cmd_build(args) -> None:
                   "ingest, or a materialized engine", file=sys.stderr)
             sys.exit(1)
         if args.engine == "global-morton":
-            # scale-tier ingest (VERDICT r4 missing #3): rows stream host ->
-            # mesh one shard-block at a time (memmap for .npy — the file
-            # never fully materializes on the host), then the standard
-            # one-all_to_all sample-sort partition
             from kdtree_tpu.parallel import make_mesh
-            from kdtree_tpu.parallel.global_morton import (
-                build_global_morton_from_points,
-            )
 
-            arr = _open_points_streaming(args.points)
-            skw = ({} if getattr(args, "slack", None) is None
-                   else {"slack": args.slack})
-            try:
-                tree = build_global_morton_from_points(
-                    arr, mesh=make_mesh(args.devices), **skw)
-            except (ValueError, RuntimeError) as e:
-                print(f"cannot build from {args.points}: {e}",
-                      file=sys.stderr)
-                sys.exit(1)
-            n, dim = arr.shape
-            meta = {"generator": "file"}
+            if "{i}" in args.points:
+                # PRE-SHARDED ingest: --points "dir/part-{i}.npy" maps file
+                # i -> device i verbatim, no redistribution (exactness only
+                # needs the shards to partition the point set — right for
+                # spatially-partitioned exports the sample-sort exchange
+                # would concentrate onto one destination)
+                import glob as globmod
+                import os
+
+                from kdtree_tpu.parallel.global_morton import (
+                    build_global_morton_from_shard_files,
+                )
+
+                try:
+                    paths = []
+                    while os.path.exists(args.points.format(i=len(paths))):
+                        paths.append(args.points.format(i=len(paths)))
+                except (KeyError, IndexError, ValueError) as e:
+                    # braces other than {i} in the pattern — crisp, not a
+                    # format() traceback (C10)
+                    print(f"bad --points pattern {args.points}: {e} "
+                          "(only the {i} placeholder is substituted)",
+                          file=sys.stderr)
+                    sys.exit(1)
+                if not paths:
+                    print(f"no shard files match {args.points} (i=0...)",
+                          file=sys.stderr)
+                    sys.exit(1)
+                # a GAP in the sequence (part-3 deleted) would silently
+                # index a partial dataset: every file matching the pattern
+                # must be part of the contiguous 0..P-1 run
+                stray = (set(globmod.glob(args.points.replace("{i}", "*")))
+                         - set(paths))
+                if stray:
+                    print(f"shard sequence has a gap: {len(paths)} "
+                          f"contiguous file(s) from i=0, but also found "
+                          f"{sorted(stray)[:3]}... — refusing to build a "
+                          "partial index", file=sys.stderr)
+                    sys.exit(1)
+                if args.devices is not None and args.devices != len(paths):
+                    print(f"--devices {args.devices} conflicts with "
+                          f"{len(paths)} shard files (file i maps to "
+                          "device i verbatim)", file=sys.stderr)
+                    sys.exit(1)
+                try:
+                    tree = build_global_morton_from_shard_files(paths)
+                except (OSError, ValueError) as e:
+                    print(f"cannot build from {args.points}: {e}",
+                          file=sys.stderr)
+                    sys.exit(1)
+                n, dim = tree.num_points, tree.dim
+                meta = {"generator": "file"}
+            else:
+                # scale-tier ingest (VERDICT r4 missing #3): rows stream
+                # host -> mesh block-cyclically (memmap for .npy — the file
+                # never fully materializes on the host), then the standard
+                # one-all_to_all sample-sort partition
+                from kdtree_tpu.parallel.global_morton import (
+                    build_global_morton_from_points,
+                )
+
+                arr = _open_points_streaming(args.points)
+                skw = ({} if getattr(args, "slack", None) is None
+                       else {"slack": args.slack})
+                try:
+                    tree = build_global_morton_from_points(
+                        arr, mesh=make_mesh(args.devices), **skw)
+                except (ValueError, RuntimeError) as e:
+                    print(f"cannot build from {args.points}: {e}",
+                          file=sys.stderr)
+                    sys.exit(1)
+                n, dim = arr.shape
+                meta = {"generator": "file"}
         else:
             import jax.numpy as jnp
 
@@ -794,7 +848,9 @@ def main(argv=None) -> None:
     bu.add_argument("--n", type=int, default=1 << 20)
     bu.add_argument("--points", default=None, metavar="FILE",
                     help="build over user data ([N, D] .npy/.npz) instead of "
-                         "a seeded problem")
+                         "a seeded problem; with --engine global-morton a "
+                         "'{i}' placeholder (e.g. part-{i}.npy) maps "
+                         "pre-sharded files onto devices verbatim")
     bu.add_argument("--distribution", choices=["uniform", "clustered"],
                     default="uniform",
                     help="generative row stream for the scale engines")
