@@ -697,11 +697,17 @@ def cmd_build(args) -> None:
             print(f"note: {args.engine} defines its points by the threefry "
                   "row stream (shard-local generation); --generator "
                   f"{args.generator} does not apply", file=sys.stderr)
-        tree = _build_tree_for_engine(
-            None, args.engine, args.devices,
-            problem=(args.seed, args.dim, args.n, dist),
-            slack=getattr(args, "slack", None),
-        )
+        try:
+            tree = _build_tree_for_engine(
+                None, args.engine, args.devices,
+                problem=(args.seed, args.dim, args.n, dist),
+                slack=getattr(args, "slack", None),
+            )
+        except RuntimeError as e:
+            # sample-sort capacity overflow (now user-reachable via
+            # --slack) — crisp stderr + exit code, not a traceback (C10)
+            print(f"cannot build: {e}", file=sys.stderr)
+            sys.exit(1)
         n, dim = args.n, args.dim
         meta = {"seed": args.seed, "generator": "threefry",
                 "distribution": dist}

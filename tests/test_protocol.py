@@ -473,3 +473,20 @@ def test_cli_presharded_ingest(tmp_path):
                     "--out", tree_f])
     assert res.returncode == 1 and "pattern" in res.stderr
     assert "Traceback" not in res.stderr
+
+
+def test_cli_slack_flag(tmp_path):
+    """--slack is the overflow error's documented remedy: an absurdly
+    tight value must fail crisply (no traceback), and a generous one must
+    build; both through the generative scale engine."""
+    tree_f = str(tmp_path / "t.npz")
+    res = _run_cli(["--engine", "global-morton", "--devices", "8",
+                    "--generator", "threefry", "build", "--n", "4096",
+                    "--slack", "0.02", "--out", tree_f])
+    assert res.returncode == 1 and "overflow" in res.stderr
+    assert "Traceback" not in res.stderr
+    res = _run_cli(["--engine", "global-morton", "--devices", "8",
+                    "--generator", "threefry", "build", "--n", "4096",
+                    "--slack", "3.0", "--out", tree_f])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "saved GlobalMortonForest" in res.stdout
