@@ -9,8 +9,11 @@ from kdtree_tpu.parallel.global_exact import (
 from kdtree_tpu.parallel.global_morton import (
     GlobalMortonForest,
     build_global_morton,
+    build_global_morton_from_points,
+    build_global_morton_from_shard_files,
     global_morton_knn,
     global_morton_query,
+    global_morton_query_tiled,
 )
 from kdtree_tpu.parallel.global_tree import (
     GlobalKDTree,
@@ -34,8 +37,11 @@ __all__ = [
     "global_knn",
     "GlobalMortonForest",
     "build_global_morton",
+    "build_global_morton_from_points",
+    "build_global_morton_from_shard_files",
     "global_morton_knn",
     "global_morton_query",
+    "global_morton_query_tiled",
     "GlobalExactTree",
     "build_global_exact",
     "global_exact_knn",
