@@ -2,7 +2,17 @@
 """Headline benchmark: build throughput + north-star query throughput.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-"extra_metrics": [...]}.
+"platform": ..., "device_count": N, "device_init_seconds": N,
+"degraded": bool, "extra_metrics": [...]}. The platform/init/degraded keys
+are the bench-honesty contract (BENCH_r05 recorded a 600 s wedged init +
+silent CPU fallback that was indistinguishable from a healthy TPU run):
+"degraded": true marks a tunnel-wedge CPU fallback, so rounds can never
+compare a fallback run against TPU numbers unknowingly.
+
+A telemetry sidecar (full metrics/span report, docs/OBSERVABILITY.md) is
+written to $KDTREE_TPU_METRICS_OUT (default ./bench_telemetry.json;
+"none" disables telemetry entirely — the A/B partner for the <2%
+metrics-overhead acceptance check). Render it with `kdtree-tpu stats`.
 
 Headline (unchanged since r2, comparable across rounds): single-chip
 gen+build+10xNN points/sec over 16M x 3-D, vs the reference's 122.8 s on one
@@ -46,7 +56,7 @@ def _fail(msg: str, code: int = 1, hard: bool = False) -> None:
     sys.exit(code)
 
 
-def _device_probe(timeout_s: float = 600.0) -> None:
+def _device_probe(timeout_s: float = 600.0) -> float:
     """Keep a wedged accelerator tunnel from hanging the bench forever (a
     crashed remote compile can leave ``jax.devices()`` blocked indefinitely
     — seen in round 3). The probe runs in a daemon thread; on timeout the
@@ -57,12 +67,22 @@ def _device_probe(timeout_s: float = 600.0) -> None:
     runtime) and a second wedge in the fallback process fail crisply with
     the standard metric line — CPU numbers must never mask a
     misconfiguration. Generous window: a healthy first init can
-    legitimately take minutes."""
+    legitimately take minutes.
+
+    Returns the measured device-init duration in seconds — the number
+    whose absence made BENCH_r05's 600 s wedge + CPU fallback look like a
+    healthy TPU run."""
     result = {}
 
     def probe():
+        t0 = time.perf_counter()
         try:
-            result["devices"] = jax.devices()
+            devs = jax.devices()
+            # init_s FIRST: the main thread keys on "devices", so writing
+            # it last keeps a join() timeout landing between the two
+            # assignments from seeing devices without its duration
+            result["init_s"] = time.perf_counter() - t0
+            result["devices"] = devs
         except Exception as e:  # init error ≠ hang, but equally fatal here
             result["error"] = repr(e)
 
@@ -70,7 +90,7 @@ def _device_probe(timeout_s: float = 600.0) -> None:
     t.start()
     t.join(timeout_s)
     if "devices" in result:
-        return
+        return result["init_s"]
     if "error" in result:
         # a fast init ERROR (bad credentials, missing runtime) is a real
         # misconfiguration — surface it crisply; CPU numbers would mask it
@@ -93,9 +113,13 @@ def _device_probe(timeout_s: float = 600.0) -> None:
 
 
 def _fetch(x):
-    """True barrier: tiny host fetch (block_until_ready can return early
-    under a deep dispatch queue on axon)."""
-    return np.asarray(x.ravel()[:1])
+    """True barrier via the shared telemetry helper (block_until_ready can
+    return early under a deep dispatch queue on axon; the 1-element host
+    fetch is a real data-dependent barrier). Lazy import: kdtree_tpu must
+    not load before the device probe has settled the platform."""
+    from kdtree_tpu.obs import hard_sync
+
+    hard_sync(x)
 
 
 def bench_build(kt, n: int, dim: int, nq: int):
@@ -294,11 +318,26 @@ def main() -> None:
         probe_s = float(os.environ.get("BENCH_DEVICE_PROBE_S", "600"))
     except ValueError:
         probe_s = 600.0
-    _device_probe(probe_s)
+    init_s = _device_probe(probe_s)
 
     import kdtree_tpu as kt
+    from kdtree_tpu import obs
 
+    # telemetry sidecar: ON by default, written next to the headline JSON
+    # line; KDTREE_TPU_METRICS_OUT overrides the path, =none disables all
+    # telemetry (the A/B partner for the <2% metrics-overhead check)
+    metrics_out = obs.sidecar_path("bench_telemetry.json")
+    if metrics_out:
+        from kdtree_tpu.obs import jaxrt
+
+        obs.configure(metrics_out=metrics_out)
+        jaxrt.record_device_init(init_s)
+
+    # bench honesty (BENCH_r05 lesson): platform/device facts ride in the
+    # metric line itself so a CPU-fallback run can never pass as TPU
+    degraded = bool(os.environ.get("BENCH_TUNNEL_FALLBACK"))
     platform = jax.devices()[0].platform
+    device_count = len(jax.devices())
     on_accel = platform not in ("cpu",)
     if on_accel:
         n, base_s, cfg = 1 << 24, 122.8, "16M x 3D"
@@ -314,16 +353,19 @@ def main() -> None:
         cn, cdim, cbase_s = 50_000, 128, None
     nq = 10
 
-    best, (pts, qs, d2, tree) = bench_build(kt, n, 3, nq)
-    bf, _ = kt.bruteforce.knn(pts, qs, k=1)
-    if not np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0], rtol=1e-4):
-        _fail("oracle check (build)")
+    with obs.span("bench.build"):
+        best, (pts, qs, d2, tree) = bench_build(kt, n, 3, nq)
+        bf, _ = kt.bruteforce.knn(pts, qs, k=1)
+        if not np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0],
+                           rtol=1e-4):
+            _fail("oracle check (build)")
     pts_per_s = n / best
     base_pts_per_s = n / base_s
 
     extra = []
 
-    qdt, qok = bench_queries(kt, pts, tree, Q, k)
+    with obs.span("bench.queries"):
+        qdt, qok = bench_queries(kt, pts, tree, Q, k)
     if not qok:
         _fail("oracle check (query)")
     extra.append({
@@ -339,7 +381,8 @@ def main() -> None:
         # north-star query shape (BASELINE.json: 10M k-NN, k=16) — the
         # per-batch programs are those already compiled for Q above, so the
         # extra warmup mostly pays for the 10M-row sort/unsort compiles
-        qbdt, qbok = bench_queries(kt, pts, tree, Qbig, k)
+        with obs.span("bench.queries-10M"):
+            qbdt, qbok = bench_queries(kt, pts, tree, Qbig, k)
         if not qbok:
             _fail("oracle check (query-10M)")
         extra.append({
@@ -354,7 +397,8 @@ def main() -> None:
         # sparse 64k-query DFS measurement (r4 item 9): uses the 16M tree
         # built above, before the big-build section frees it
         Qs = 1 << 16
-        sdt, sok = bench_sparse_dfs(kt, tree, pts, Qs, k)
+        with obs.span("bench.sparse-dfs"):
+            sdt, sok = bench_sparse_dfs(kt, tree, pts, Qs, k)
         if not sok:
             _fail("oracle check (sparse-dfs-64k)")
         extra.append({
@@ -367,7 +411,8 @@ def main() -> None:
 
         # Pallas kernel under shard_map on the real chip (r4 item 3)
         np_, qp = 1 << 22, 1 << 16  # dense: Q*64 >= N -> SPMD tiled route
-        pdt, pused, pok = bench_spmd_pallas(kt, np_, 3, qp, k)
+        with obs.span("bench.spmd-pallas"):
+            pdt, pused, pok = bench_spmd_pallas(kt, np_, 3, qp, k)
         if not pok:
             _fail("oracle check (pallas-spmd)")
         extra.append({
@@ -384,7 +429,8 @@ def main() -> None:
         # north star (beyond this, the global-morton mesh path takes over).
         # Free the 16M bench context first — HBM headroom at 128M is thin.
         del pts, qs, d2, tree
-        bdt, bok = bench_build_big(kt, nbig, 3, nq)
+        with obs.span("bench.build-128M"):
+            bdt, bok = bench_build_big(kt, nbig, 3, nq)
         if not bok:
             _fail("oracle check (build-128M)")
         extra.append({
@@ -398,7 +444,8 @@ def main() -> None:
         # north-star per-device scale through the SCALE engine itself
         # (driver-visible evidence for docs/SCALING.md item 1)
         n26 = 1 << 26
-        gdt, gok = bench_global_morton(kt, n26, 3, nq)
+        with obs.span("bench.global-morton"):
+            gdt, gok = bench_global_morton(kt, n26, 3, nq)
         if not gok:
             _fail("oracle check (global-morton-2^26)")
         extra.append({
@@ -409,7 +456,8 @@ def main() -> None:
             "vs_baseline": None,
         })
 
-    cdt, cok = bench_clustered(kt, cn, cdim, nq)
+    with obs.span("bench.clustered"):
+        cdt, cok = bench_clustered(kt, cn, cdim, nq)
     if not cok:
         _fail("oracle check (clustered)")
     extra.append({
@@ -421,13 +469,32 @@ def main() -> None:
                         if cbase_s else None),
     })
 
-    print(json.dumps({
+    headline = {
         "metric": f"k-d tree gen+build+10xNN points/sec ({cfg}, {platform})",
         "value": round(pts_per_s),
         "unit": "pts/s",
         "vs_baseline": round(pts_per_s / base_pts_per_s, 2),
+        # honesty keys (BENCH_r05 lesson): a future round comparing
+        # BENCH_*.json files can now see at a glance WHAT ran and whether
+        # device init was healthy — a CPU fallback is flagged, not silent
+        "platform": platform,
+        "device_count": device_count,
+        "device_init_seconds": round(init_s, 3),
+        "degraded": degraded,
         "extra_metrics": extra,
-    }))
+    }
+    if metrics_out:
+        if obs.finalize_guarded(extra={
+            "platform": platform,
+            "device_count": device_count,
+            "device_init_seconds": init_s,
+            "degraded": degraded,
+            "headline": {k: headline[k] for k in
+                         ("metric", "value", "unit", "vs_baseline")},
+        }) is not None:
+            print(f"bench: telemetry sidecar written to {metrics_out}",
+                  file=sys.stderr)
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

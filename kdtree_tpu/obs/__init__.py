@@ -1,0 +1,235 @@
+"""kdtree_tpu.obs — the unified telemetry subsystem.
+
+One place for every "what did this run actually do" question:
+
+- :mod:`~kdtree_tpu.obs.registry` — process-wide counters / gauges /
+  fixed-bucket histograms, cheap enough for host-side hot paths;
+- :mod:`~kdtree_tpu.obs.spans` — nested, thread-safe span tracing with
+  ``jax.profiler.TraceAnnotation`` integration and the shared
+  :func:`hard_sync` host-fetch barrier (``PhaseTimer`` is now a thin
+  wrapper over this);
+- :mod:`~kdtree_tpu.obs.jaxrt` — JAX runtime telemetry: backend-compile
+  (recompile) counting via ``jax.monitoring``, device-init duration, the
+  platform that actually ran, live device-memory gauges;
+- :mod:`~kdtree_tpu.obs.export` — JSONL event log, one-shot JSON report
+  (``kdtree-tpu stats`` renders it), Prometheus text exposition.
+
+Cost model — two tiers, so production hot paths never pay for telemetry
+they didn't ask for:
+
+- **Always on (host-side, ~ns):** counters/gauges/spans incremented by
+  host driver code. No device work, no syncs.
+- **Gated on** :func:`enabled` **(device-side):** anything that adds a
+  device reduction or a host fetch (bucket-occupancy histograms, tile
+  candidate counts). Enable with ``KDTREE_TPU_METRICS=1``, the CLI's
+  ``--metrics-out``, or :func:`set_enabled`.
+
+See ``docs/OBSERVABILITY.md`` for the metric catalog and naming
+conventions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from kdtree_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+
+_enabled_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Whether device-side (fetch/reduction-costing) telemetry is on."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("KDTREE_TPU_METRICS", "").lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force device-side telemetry on/off; ``None`` restores the env
+    default (``KDTREE_TPU_METRICS``)."""
+    global _enabled_override
+    _enabled_override = value
+
+
+_deferred: list = []
+_deferred_lock = threading.Lock()
+_DEFER_CAP = 256
+
+
+def defer(fn) -> None:
+    """Queue a telemetry finalization callback — typically the host fetch
+    of a tiny device array an instrumented hot path just dispatched — to
+    run at :func:`flush` / report time. This keeps every device-side
+    metric SYNC out of the hot path itself: the instrumented code pays
+    only an async dispatch of a scalar-sized reduction (ns on the host),
+    and the fetch happens once, when someone actually asks for the
+    numbers. Bounded: past ``_DEFER_CAP`` pending callbacks the queue
+    drains inline so a long-running serving process can't grow it."""
+    with _deferred_lock:
+        _deferred.append(fn)
+        drain = _deferred[:] if len(_deferred) > _DEFER_CAP else None
+        if drain is not None:
+            _deferred.clear()
+    if drain is not None:
+        _run_deferred(drain)
+
+
+def _run_deferred(fns) -> None:
+    for fn in fns:
+        try:
+            fn()
+        except Exception:
+            # telemetry finalization must never fail the run it observed
+            pass
+
+
+def flush() -> None:
+    """Run every pending deferred telemetry callback (reports call this
+    automatically)."""
+    with _deferred_lock:
+        drain = _deferred[:]
+        _deferred.clear()
+    _run_deferred(drain)
+
+
+def is_tracer(x) -> bool:
+    """True when ``x`` is a jax tracer — instrumentation must not count
+    (or fetch!) trace-time abstract values as real work. Import-light so
+    the check itself stays free on paths that never imported jax."""
+    import sys
+
+    jax_core = sys.modules.get("jax.core") or sys.modules.get("jax._src.core")
+    if jax_core is None:
+        return False
+    return isinstance(x, jax_core.Tracer)
+
+
+def configure(
+    metrics_out: Optional[str] = None,
+    jsonl: Optional[str] = None,
+    install_jax_listeners: bool = True,
+    enable: bool = True,
+) -> MetricsRegistry:
+    """One-call setup for a telemetry-producing run: flips the
+    device-side gate, installs the jax.monitoring listeners, and points
+    the JSONL event log somewhere. ``metrics_out`` is recorded for
+    :func:`finalize` to write the report to."""
+    global _metrics_out_path
+    if enable:
+        set_enabled(True)
+    if install_jax_listeners:
+        from kdtree_tpu.obs import jaxrt
+
+        jaxrt.install()
+    if jsonl is not None:
+        from kdtree_tpu.obs import export
+
+        export.configure_jsonl(jsonl)
+    if metrics_out is not None:
+        _metrics_out_path = metrics_out
+    return get_registry()
+
+
+_metrics_out_path: Optional[str] = None
+
+
+def finalize(extra: Optional[dict] = None) -> Optional[dict]:
+    """Write the one-shot report to the path ``configure(metrics_out=...)``
+    recorded (no-op without one). Returns the report dict if written."""
+    if _metrics_out_path is None:
+        return None
+    from kdtree_tpu.obs import export
+
+    return export.write_report(_metrics_out_path, extra=extra)
+
+
+# Re-exports: the whole public surface importable from kdtree_tpu.obs.
+# Lazy (function-level) imports keep `import kdtree_tpu.obs` free of jax.
+def hard_sync(outputs) -> None:
+    from kdtree_tpu.obs.spans import hard_sync as _hs
+
+    _hs(outputs)
+
+
+def span(name: str, **kw):
+    from kdtree_tpu.obs.spans import span as _span
+
+    return _span(name, **kw)
+
+
+def sidecar_path(default_path: str) -> Optional[str]:
+    """Resolve a script's telemetry-sidecar destination from the shared
+    ``KDTREE_TPU_METRICS_OUT`` contract: the env var overrides
+    ``default_path``, and ``""``/``0``/``none``/``off`` disables telemetry
+    entirely (returns None). One definition so bench.py and
+    scripts/profile_stages.py cannot drift."""
+    path = os.environ.get("KDTREE_TPU_METRICS_OUT", default_path)
+    return None if path.lower() in ("", "0", "none", "off") else path
+
+
+def finalize_guarded(extra: Optional[dict] = None) -> Optional[dict]:
+    """Device-memory snapshot + :func:`finalize`, never raising — failed
+    telemetry must not turn a successful run into a crash. Returns the
+    report dict, or None if disabled or the write/snapshot failed (the
+    failure is reported on stderr)."""
+    import sys
+
+    try:
+        from kdtree_tpu.obs import jaxrt
+
+        jaxrt.snapshot_device_memory()
+        return finalize(extra=extra)
+    except Exception as e:
+        print(f"telemetry sidecar write failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def count_build(engine: str, points: int) -> None:
+    """Record one index build of ``points`` rows by ``engine`` — the shared
+    domain-counter shape every build entry point uses."""
+    reg = get_registry()
+    reg.counter("kdtree_builds_total", labels={"engine": engine}).inc()
+    reg.counter(
+        "kdtree_build_points_total", labels={"engine": engine}
+    ).inc(points)
+
+
+def count_query(engine: str, rows: int) -> None:
+    """Record one query call of ``rows`` query rows by ``engine``."""
+    reg = get_registry()
+    reg.counter("kdtree_queries_total", labels={"engine": engine}).inc()
+    reg.counter(
+        "kdtree_query_rows_total", labels={"engine": engine}
+    ).inc(rows)
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "enabled",
+    "set_enabled",
+    "is_tracer",
+    "configure",
+    "finalize",
+    "hard_sync",
+    "span",
+    "count_build",
+    "count_query",
+    "defer",
+    "flush",
+    "sidecar_path",
+    "finalize_guarded",
+]

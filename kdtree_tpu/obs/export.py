@@ -1,0 +1,229 @@
+"""Exporters: JSONL event log, one-shot JSON report, Prometheus text.
+
+Three consumers, three formats:
+
+- **JSONL event log** (``configure_jsonl(path)`` + ``emit_event``): an
+  append-only stream of timestamped events (span completions, run
+  markers). The debugging format — replayable, greppable, and safe to
+  tail while a run is live. Disabled (a no-op) until configured.
+- **JSON report** (``report()`` / ``write_report``): the one-shot summary
+  a bench or CLI run leaves behind — the full registry snapshot plus a
+  convenience ``spans`` rollup and any caller-supplied top-level facts
+  (platform, device_init_seconds, ...). ``kdtree-tpu stats`` renders it.
+- **Prometheus text exposition** (``prometheus_text``): the pull-scrape
+  format, so a future serving process can expose ``/metrics`` without a
+  new serialization (ROADMAP open item: the scrape endpoint itself).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from kdtree_tpu.obs.registry import MetricsRegistry, format_key, get_registry
+
+REPORT_VERSION = 1
+
+_jsonl_lock = threading.Lock()
+_jsonl_path: Optional[str] = None
+
+
+def configure_jsonl(path: Optional[str]) -> None:
+    """Set (or clear, with None) the JSONL event-log destination."""
+    global _jsonl_path
+    with _jsonl_lock:
+        _jsonl_path = path
+
+
+def jsonl_path() -> Optional[str]:
+    return _jsonl_path
+
+
+def emit_event(event: Dict) -> None:
+    """Append one event line to the configured JSONL log; no-op when no
+    log is configured, and never raises into the instrumented caller —
+    telemetry failures must not fail the run they observe."""
+    with _jsonl_lock:
+        path = _jsonl_path
+        if path is None:
+            return
+        try:
+            line = json.dumps({"ts": time.time(), **event})
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except (OSError, TypeError, ValueError):
+            pass
+
+
+def _span_rollup(hists: Dict[str, Dict]) -> Dict[str, Dict[str, float]]:
+    """Convenience view of the kdtree_span_seconds histogram family:
+    {span_path: {count, total_seconds, mean_seconds}}."""
+    out: Dict[str, Dict[str, float]] = {}
+    prefix = 'kdtree_span_seconds{span="'
+    for key, snap in hists.items():
+        if not key.startswith(prefix):
+            continue
+        path = key[len(prefix):-2]  # strip the '"}' tail
+        count = int(snap["count"])
+        total = float(snap["sum"])
+        out[path] = {
+            "count": count,
+            "total_seconds": total,
+            "mean_seconds": (total / count) if count else 0.0,
+        }
+    return out
+
+
+def report(
+    registry: Optional[MetricsRegistry] = None,
+    extra: Optional[Dict] = None,
+) -> Dict:
+    """One-shot JSON-ready report: registry snapshot + span rollup +
+    caller facts. ``extra`` keys land at the top level (platform,
+    device_init_seconds, degraded, ...)."""
+    from kdtree_tpu import obs
+
+    obs.flush()  # run pending deferred fetches before snapshotting
+    reg = registry or get_registry()
+    snap = reg.snapshot()
+    rep = {
+        "report_version": REPORT_VERSION,
+        "generated_unix": time.time(),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+        "spans": _span_rollup(snap["histograms"]),
+    }
+    if extra:
+        rep.update(extra)
+    return rep
+
+
+def write_report(
+    path: str,
+    registry: Optional[MetricsRegistry] = None,
+    extra: Optional[Dict] = None,
+) -> Dict:
+    """Write the report atomically (tmp + os.replace — a crashed writer
+    must not leave a truncated half-report where a good one stood).
+    Returns the report dict."""
+    rep = report(registry, extra)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rep, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return rep
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus text exposition format (version 0.0.4) of the whole
+    registry. Histograms emit cumulative ``_bucket{le=...}`` series plus
+    ``_sum`` / ``_count``, counters emit ``_total``-as-named values."""
+    reg = registry or get_registry()
+    lines = []
+    seen_type = set()
+    for name, kind, items, inst in reg.collect():
+        if name not in seen_type:
+            lines.append(f"# TYPE {name} {kind}")
+            seen_type.add(name)
+        if kind in ("counter", "gauge"):
+            lines.append(f"{format_key(name, items)} {inst.value:g}")
+            continue
+        snap = inst.snapshot()
+        base = dict(items)
+        for upper, cum in snap["buckets"].items():
+            le_items = tuple(sorted({**base, "le": upper}.items()))
+            lines.append(f"{format_key(name + '_bucket', le_items)} {cum}")
+        lines.append(f"{format_key(name + '_sum', items)} {snap['sum']:g}")
+        lines.append(f"{format_key(name + '_count', items)} {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def render_report(rep: Dict) -> str:
+    """Human-readable rendering of a report dict (the ``stats``
+    subcommand). Leads with the run facts that decide whether the numbers
+    are even comparable (platform, degraded, init time), then spans by
+    total time, then counters/gauges/histograms."""
+    out = []
+    plat = rep.get("platform")
+    if plat is None:
+        for key in rep.get("gauges", {}):
+            if key.startswith('jax_platform_info{platform="'):
+                plat = key.split('"')[1]
+                break
+    degraded = rep.get("degraded", False)
+    out.append("== run ==")
+    out.append(f"platform:            {plat or 'unknown'}"
+               + ("   [DEGRADED: fell back from an accelerator]"
+                  if degraded else ""))
+    g = rep.get("gauges", {})
+    if "device_init_seconds" in rep or "jax_device_init_seconds" in g:
+        init_s = rep.get("device_init_seconds",
+                         g.get("jax_device_init_seconds"))
+        out.append(f"device init:         {float(init_s):.3f} s")
+    if "jax_device_count" in g:
+        out.append(f"devices:             {int(g['jax_device_count'])}")
+    c = rep.get("counters", {})
+    if "jax_backend_compiles_total" in c:
+        secs = c.get("jax_backend_compile_seconds_total", 0.0)
+        out.append(
+            f"backend compiles:    {int(c['jax_backend_compiles_total'])}"
+            f" ({secs:.2f} s total) — growth after warmup = recompiles"
+        )
+
+    spans = rep.get("spans", {})
+    if spans:
+        out.append("")
+        out.append("== spans (by total time) ==")
+        width = max(len(p) for p in spans)
+        for path, s in sorted(
+            spans.items(), key=lambda kv: -kv[1]["total_seconds"]
+        ):
+            out.append(
+                f"{path:<{width}}  n={s['count']:<5d} "
+                f"total={s['total_seconds']:9.3f}s "
+                f"mean={s['mean_seconds']*1e3:9.2f}ms"
+            )
+
+    plain_counters = {
+        k: v for k, v in c.items()
+        if not k.startswith(("jax_events_total", "jax_event_seconds_total"))
+    }
+    if plain_counters:
+        out.append("")
+        out.append("== counters ==")
+        width = max(len(k) for k in plain_counters)
+        for key in sorted(plain_counters):
+            out.append(f"{key:<{width}}  {plain_counters[key]:g}")
+
+    if g:
+        out.append("")
+        out.append("== gauges ==")
+        width = max(len(k) for k in g)
+        for key in sorted(g):
+            out.append(f"{key:<{width}}  {g[key]:g}")
+
+    hists = {
+        k: v for k, v in rep.get("histograms", {}).items()
+        if not k.startswith("kdtree_span_seconds")
+    }
+    if hists:
+        out.append("")
+        out.append("== histograms ==")
+        for key in sorted(hists):
+            snap = hists[key]
+            count = int(snap["count"])
+            mean = (float(snap["sum"]) / count) if count else 0.0
+            out.append(f"{key}: n={count} mean={mean:g}")
+            buckets = snap["buckets"]
+            prev = 0
+            for upper, cum in buckets.items():
+                in_bucket = int(cum) - prev
+                prev = int(cum)
+                if in_bucket:
+                    out.append(f"    <= {upper:>8}: {in_bucket}")
+    return "\n".join(out) + "\n"

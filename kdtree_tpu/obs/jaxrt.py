@@ -1,0 +1,169 @@
+"""JAX runtime telemetry: recompiles, device init, platform, memory.
+
+The round-5 bench wedge (BENCH_r05.json: a 600 s device init and a silent
+CPU fallback publishing a healthy-looking metric line) is exactly the
+failure mode this module makes visible — every run records which platform
+actually executed and how long backend init took, and every XLA backend
+compile is counted so a retrace storm shows up as a number instead of a
+mystery slowdown.
+
+``install()`` hooks :mod:`jax.monitoring` listeners into the registry:
+
+- ``jax_backend_compiles_total`` / ``jax_backend_compile_seconds_total``
+  count every XLA backend compile (the ``backend_compile_duration``
+  event). The FIRST compile of each program counts too, so the recompile
+  signal is the count *growing after warmup* — a steady-state serving
+  loop should hold this flat; growth means a shape/dtype/static-arg churn
+  is busting the jit cache.
+- ``jax_events_total{event=...}`` counts discrete events (compilation-
+  cache hits/misses when the persistent cache is enabled, etc.).
+- ``jax_event_seconds_total{event=...}`` accumulates the other duration
+  events (jaxpr trace time, MLIR lowering time).
+
+Listeners are process-global and idempotent to install; jax offers no
+unregister, so ``install`` is one-way (the registry they write to is
+resolved at call time, per event, so a test-fresh registry still sees
+events from an earlier install).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from kdtree_tpu.obs.registry import MetricsRegistry, get_registry
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_install_lock = threading.Lock()
+_installed = False
+_registry_override: Optional[MetricsRegistry] = None
+
+
+def _reg() -> MetricsRegistry:
+    return _registry_override or get_registry()
+
+
+def _on_event(event: str, **kwargs) -> None:
+    try:
+        _reg().counter("jax_events_total", labels={"event": event}).inc()
+    except Exception:
+        # a listener exception would propagate INTO the jax caller that
+        # emitted the event — telemetry must never fail the run it observes
+        pass
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    try:
+        reg = _reg()
+        if event == BACKEND_COMPILE_EVENT:
+            reg.counter("jax_backend_compiles_total").inc()
+            reg.counter("jax_backend_compile_seconds_total").inc(duration)
+        elif duration >= 0:
+            # some events are signed deltas, not durations — e.g. the
+            # persistent compilation cache's compile_time_saved_sec goes
+            # NEGATIVE when retrieval costs more than a tiny compile did;
+            # a monotone counter can only accept the non-negative ones
+            reg.counter(
+                "jax_event_seconds_total", labels={"event": event}
+            ).inc(duration)
+        else:
+            reg.gauge(
+                "jax_event_seconds_last", labels={"event": event}
+            ).set(duration)
+    except Exception:
+        pass
+
+
+def install(registry: Optional[MetricsRegistry] = None) -> None:
+    """Idempotently register the jax.monitoring listeners."""
+    global _installed, _registry_override
+    if registry is not None:
+        _registry_override = registry
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring as monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _installed = True
+
+
+def recompile_count(registry: Optional[MetricsRegistry] = None) -> float:
+    """Current backend-compile count (0.0 before install/first compile)."""
+    reg = registry or _reg()
+    return reg.counter("jax_backend_compiles_total").value
+
+
+def record_device_init(
+    seconds: float, registry: Optional[MetricsRegistry] = None
+) -> None:
+    """Record backend-init duration plus the platform/device-count facts
+    every honest report must carry (a CPU-fallback run must be
+    distinguishable from a TPU run by its telemetry alone)."""
+    import jax
+
+    reg = registry or _reg()
+    devs = jax.devices()
+    reg.gauge("jax_device_init_seconds").set(seconds)
+    reg.gauge("jax_device_count").set(len(devs))
+    reg.gauge(
+        "jax_platform_info", labels={"platform": devs[0].platform}
+    ).set(1.0)
+
+
+def probe_devices(registry: Optional[MetricsRegistry] = None):
+    """Time ``jax.devices()`` (first call = full backend init) and record
+    it. Returns the device list. Callers that already timed their own
+    probe (the bench's watchdog thread) use :func:`record_device_init`
+    directly instead."""
+    import jax
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    record_device_init(time.perf_counter() - t0, registry)
+    return devs
+
+
+_MEM_STATS_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "largest_alloc_size",
+    "bytes_reserved",
+    "num_allocs",
+)
+
+
+def snapshot_device_memory(
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, Dict[str, int]]:
+    """Live device-memory gauges, one per (device, stat).
+
+    ``memory_stats()`` is populated on TPU/GPU and ``None`` on CPU — a
+    CPU run simply records no memory gauges (absence is itself a platform
+    signal, and fabricating host-RSS numbers into a device metric would
+    mislead). Returns the raw per-device stats for report embedding.
+    """
+    import jax
+
+    reg = registry or _reg()
+    out: Dict[str, Dict[str, int]] = {}
+    for i, dev in enumerate(jax.local_devices()):
+        stats_fn = getattr(dev, "memory_stats", None)
+        stats = stats_fn() if stats_fn is not None else None
+        if not stats:
+            continue
+        clean = {
+            k: int(v) for k, v in stats.items() if isinstance(v, (int, float))
+        }
+        out[str(i)] = clean
+        for key in _MEM_STATS_KEYS:
+            if key in clean:
+                reg.gauge(
+                    "jax_device_memory_bytes",
+                    labels={"device": str(i), "stat": key},
+                ).set(clean[key])
+    return out

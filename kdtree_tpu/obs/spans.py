@@ -1,0 +1,135 @@
+"""Nested span tracing + the one true host-fetch barrier.
+
+``span(name)`` times a region of host code, names it in any active
+``jax.profiler`` trace (``TraceAnnotation``), and — like the PhaseTimer it
+subsumes — hard-syncs whatever device outputs the caller appends to the
+yielded handle before the clock stops, so async dispatch can't lie about
+where time went.
+
+``hard_sync`` is the shared belt-and-braces barrier formerly duplicated
+between ``utils/timing.py`` and ``bench.py``: ``jax.block_until_ready``
+can return early under a deep dispatch queue on the axon tunnel, so after
+blocking we do a 1-element host fetch of every leaf — a true
+data-dependent barrier that costs only the tunnel RTT.
+
+Spans nest per-thread (a thread-local stack); a span's recorded path is
+``parent/child``, so concurrent driver threads can't interleave each
+other's hierarchies. Every completed span lands in the registry histogram
+``kdtree_span_seconds{span=...}`` and, when a JSONL event log is
+configured, as one ``{"type": "span", ...}`` event line.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+import jax
+import numpy as _np
+
+from kdtree_tpu.obs.registry import MetricsRegistry, get_registry
+
+_tls = threading.local()
+
+# span durations range from sub-ms counter flushes to multi-minute bench
+# sections; one shared log-spaced bucket set keeps every span family
+# comparable in the exposition output
+SPAN_TIME_BUCKETS = (
+    0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 300.0,
+)
+
+
+def hard_sync(outputs) -> None:
+    """True completion barrier for any pytree of jax arrays.
+
+    ``block_until_ready`` + a 1-element host fetch per leaf (the fetch is
+    data-dependent, so the runtime cannot reorder around it). No-op for
+    empty pytrees and non-array leaves.
+    """
+    leaves = jax.tree_util.tree_leaves(outputs)
+    if not leaves:
+        return
+    jax.block_until_ready(leaves)
+    for leaf in leaves:
+        if hasattr(leaf, "ravel"):
+            _np.asarray(leaf.ravel()[:1])
+
+
+class Span(list):
+    """The handle a ``span(...)`` block yields.
+
+    It IS a list: append (or ``+=``) device outputs to have them
+    hard-synced before the span's clock stops. ``duration`` is set on
+    exit; ``path`` is the slash-joined nesting path.
+    """
+
+    def __init__(self, name: str, path: str) -> None:
+        super().__init__()
+        self.name = name
+        self.path = path
+        self.duration: Optional[float] = None
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    registry: Optional[MetricsRegistry] = None,
+    sync: bool = True,
+    **attrs,
+) -> Iterator[Span]:
+    """Time a named region; nested calls record ``parent/child`` paths.
+
+    ``sync=False`` skips the exit barrier for regions that intentionally
+    end with work still in flight (e.g. an async dispatch loop whose
+    caller syncs later) — the duration then covers dispatch, not
+    execution, and the span records ``synced: false`` in the event log.
+    """
+    reg = registry or get_registry()
+    stack = _stack()
+    path = "/".join([s.name for s in stack] + [name])
+    sp = Span(name, path)
+    stack.append(sp)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                yield sp
+            finally:
+                # the barrier lives INSIDE the TraceAnnotation scope so a
+                # profiler trace attributes the blocking wait to this span,
+                # matching the duration the registry records. It is also
+                # WHERE deferred device errors surface — it may raise, so
+                # the pop/record below lives in an outer finally: a failed
+                # span must still pop itself, or every later span on this
+                # thread gets a corrupted path.
+                if sync and len(sp):
+                    hard_sync(list(sp))
+    finally:
+        sp.duration = time.perf_counter() - t0
+        if stack and stack[-1] is sp:
+            stack.pop()
+        reg.histogram(
+            "kdtree_span_seconds", buckets=SPAN_TIME_BUCKETS,
+            labels={"span": path},
+        ).observe(sp.duration)
+        from kdtree_tpu.obs import export
+
+        export.emit_event({
+            "type": "span", "span": path, "seconds": sp.duration,
+            "synced": bool(sync), **attrs,
+        })
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span on this thread, if any."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None

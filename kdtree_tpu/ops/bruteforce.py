@@ -139,6 +139,10 @@ def knn(
     if method == "auto":
         method = "exact" if d <= EXACT_DIM_MAX else "matmul"
     tile = min(tile, max(k, ((n + 127) // 128) * 128))
+    from kdtree_tpu import obs
+
+    if not obs.is_tracer(queries):
+        obs.count_query("bruteforce", queries.shape[0])
     return _knn_scan(points, queries, k, tile, method)
 
 

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from kdtree_tpu import obs
 from kdtree_tpu.models.tree import node_levels
 
 DEFAULT_BUCKET = 128
@@ -280,6 +281,8 @@ def build_bucket(
     n, d = points.shape
     if strategy == "auto":
         strategy = "sort"
+    if not obs.is_tracer(points):
+        obs.count_build("bucket", n)
     spec = bucket_spec(n, bucket_cap)
     arrs = _bucket_arrays(n, d, bucket_cap)
     return _build_bucket_jit(
@@ -473,4 +476,6 @@ def bucket_knn(
     crashed the TPU worker; chunking also keeps lockstep divergence local).
     """
     k = min(k, tree.n_real)
+    if not obs.is_tracer(queries):
+        obs.count_query("bucket", queries.shape[0])
     return _bucket_knn_batch(tree, queries, k, min(chunk, max(queries.shape[0], 1)))

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from kdtree_tpu import obs
 from kdtree_tpu.models.tree import KDTree, TreeSpec, node_levels, tree_spec
 
 # The static structure arrays are O(N); embedding them as HLO constants bloats
@@ -142,6 +143,8 @@ def build_jit(points: jax.Array) -> KDTree:
     n, d = points.shape
     spec = tree_spec(n)
     consume, all_nodes, all_medpos, node_axes = spec_arrays(n, d)
+    if not obs.is_tracer(points):
+        obs.count_build("tree", n)
     return _build_jit_impl(
         points, consume, all_nodes, all_medpos, node_axes, spec.num_levels
     )

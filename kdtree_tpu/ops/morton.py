@@ -49,7 +49,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from kdtree_tpu import obs
 from kdtree_tpu.ops.topk import scan_bucket_block
+
+# bucket-occupancy histogram bounds (points per bucket) — spans both the
+# single-chip default cap (256) and the forest cap (128); the +Inf bucket
+# catches anything a future cap raises
+_OCC_BUCKETS = (0, 8, 16, 32, 64, 96, 128, 192, 256, 512)
 
 DEFAULT_BUCKET = 256  # two 128-lane vregs per bucket row. Measured at the
 # north-star query shape (16M pts, 1M k=16 queries, fused Pallas scan):
@@ -275,7 +281,21 @@ def build_morton(
     if bits is None:
         bits = 32 // max(d, 1)
     bits = max(1, min(bits, 32 // max(d, 1), 16))
-    return _build_morton_jit(points, bucket_cap, bits)
+    tree = _build_morton_jit(points, bucket_cap, bits)
+    if not obs.is_tracer(points):
+        obs.count_build("morton", n)
+        if obs.enabled() and not obs.is_tracer(tree.bucket_gid):
+            # enabled-gated occupancy: dispatch a tiny [NBP] reduction now
+            # (async, ~free) and DEFER the host fetch to report time so no
+            # sync lands inside the build hot path
+            import numpy as _np
+
+            occ_dev = jnp.sum((tree.bucket_gid >= 0).astype(jnp.int32), axis=1)
+            hist = obs.get_registry().histogram(
+                "kdtree_bucket_occupancy", buckets=_OCC_BUCKETS
+            )
+            obs.defer(lambda: hist.observe_array(_np.asarray(occ_dev)))
+    return tree
 
 
 def morton_view(
@@ -465,6 +485,8 @@ def morton_knn(
     """
     k = min(k, tree.n_real)
     q = queries.shape[0]
+    if not obs.is_tracer(queries):
+        obs.count_query("morton", q)
     chunk = min(chunk, max(q, 1))
     if q <= chunk:
         return _morton_knn_batch(tree, queries, k, chunk)

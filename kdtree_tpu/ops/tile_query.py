@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from kdtree_tpu import obs
 from kdtree_tpu.ops.hilbert import hilbert_codes
 from kdtree_tpu.ops.morton import MortonTree
 
@@ -253,7 +254,11 @@ def _tiled_batch(
     else:
         fd, fi = _scan_tiles(tree, tq, cand, k, v, tb)
     q = tq.shape[0] * tile
-    return fd.reshape(q, k), fi.reshape(q, k), jnp.any(overflow)
+    # collect-pass candidate-bucket count: a trivial [T, C] reduction the
+    # compiler fuses; the driver fetches it (telemetry-gated) alongside the
+    # overflow flags to report tile-query prune rate
+    ncand = jnp.sum((cand >= 0).astype(jnp.int32))
+    return fd.reshape(q, k), fi.reshape(q, k), jnp.any(overflow), ncand
 
 
 @functools.partial(jax.jit, static_argnames=("qreal",))
@@ -371,10 +376,18 @@ def drive_batches(
     offsets: Sequence[int],
     cmax: int,
     nbp: int,
+    scan_units_per_batch: int | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Async batch dispatch with overflow-retry, shared by every tiled
-    driver. ``run_batch(offset, cap) -> (d2, gid, overflow)`` must be a
-    jitted program.
+    driver. ``run_batch(offset, cap) -> (d2, gid, overflow[, ncand])``
+    must be a jitted program; the optional 4th output is the batch's
+    candidate-bucket count (an i32 scalar), which — together with
+    ``scan_units_per_batch`` = tiles-per-batch x shards, the number of
+    (tile, local-tree) pairs whose frontier could have kept up to ``nbp``
+    buckets each — lets the driver report the tile-query prune rate
+    (``1 - candidates / (scan_units * nbp)``). The candidate fetch is one
+    extra stacked host read gated on ``obs.enabled()``, so
+    metrics-disabled runs pay nothing.
 
     Settles the cap on the FIRST batch synchronously: a tile geometry that
     overflows cap C in one batch tends to overflow it in similar batches
@@ -390,22 +403,46 @@ def drive_batches(
     at a smaller cap is still exact — overflow is the only incompleteness
     signal.
     """
+    reg = obs.get_registry()
+    retries = reg.counter("kdtree_tile_overflow_retries_total")
     bcmax = cmax
     first = run_batch(offsets[0], bcmax)
     while bool(first[2]) and bcmax < nbp:
         bcmax = min(bcmax * 2, nbp)
+        retries.inc()
         first = run_batch(offsets[0], bcmax)
     batches = [first] + [run_batch(b0, bcmax) for b0 in offsets[1:]]
     while bcmax < nbp:
-        flags = np.asarray(jnp.stack([ov for (_, _, ov) in batches]))
+        flags = np.asarray(jnp.stack([b[2] for b in batches]))
         bad = np.nonzero(flags)[0]
         if bad.size == 0:
             break
         bcmax = min(bcmax * 2, nbp)
         for i in bad:
+            retries.inc()
             batches[i] = run_batch(offsets[i], bcmax)
-    parts_d = [bd for (bd, _, _) in batches]
-    parts_i = [bi for (_, bi, _) in batches]
+    reg.counter("kdtree_tile_batches_total").inc(len(offsets))
+    if obs.enabled() and len(batches[0]) > 3:
+        # stack the per-batch candidate counts on device (async) and DEFER
+        # the fetch to report time — no sync added to the dispatch loop
+        ncand_dev = jnp.stack([b[3] for b in batches])
+        units = (scan_units_per_batch or 0) * len(offsets)
+
+        def _flush_candidates(reg=reg, ncand_dev=ncand_dev, units=units,
+                              nbp=nbp):
+            ncand = int(np.asarray(ncand_dev).sum())
+            reg.counter("kdtree_tile_candidates_total").inc(ncand)
+            if units:
+                reg.counter("kdtree_tile_scan_units_total").inc(units)
+                denom = units * nbp
+                if denom > 0:
+                    reg.gauge("kdtree_tile_prune_rate").set(
+                        1.0 - ncand / denom
+                    )
+
+        obs.defer(_flush_candidates)
+    parts_d = [b[0] for b in batches]
+    parts_i = [b[1] for b in batches]
     d2 = jnp.concatenate(parts_d, axis=0) if len(parts_d) > 1 else parts_d[0]
     gi = jnp.concatenate(parts_i, axis=0) if len(parts_i) > 1 else parts_i[0]
     return d2, gi
@@ -437,20 +474,25 @@ def morton_knn_tiled(
             jnp.zeros((0, k), jnp.float32),
             jnp.zeros((0, k), jnp.int32),
         )
+    obs.count_query("tiled", Q)
     plan = plan_tiled(
         Q, D, tree.n_real, tree.num_buckets, tree.bucket_size, k,
         tile, cmax, seeds, use_pallas,
     )
     qpad = (-Q) % plan.qbatch
-    sq, order = _sort_queries(queries, plan.bits, qpad)
-    Qp = sq.shape[0]
+    with obs.span("query.tiled", sync=False, q=Q, k=k):
+        sq, order = _sort_queries(queries, plan.bits, qpad)
+        Qp = sq.shape[0]
 
-    def run_batch(b0: int, cap: int):
-        return _tiled_batch(
-            tree, lax.slice_in_dim(sq, b0, b0 + plan.qbatch, axis=0), k,
-            plan.tile, cap, plan.seeds, plan.v, plan.use_pallas,
+        def run_batch(b0: int, cap: int):
+            return _tiled_batch(
+                tree, lax.slice_in_dim(sq, b0, b0 + plan.qbatch, axis=0), k,
+                plan.tile, cap, plan.seeds, plan.v, plan.use_pallas,
+            )
+
+        offsets = list(range(0, Qp, plan.qbatch))
+        d2, gi = drive_batches(
+            run_batch, offsets, plan.cmax, tree.num_buckets,
+            scan_units_per_batch=plan.qbatch // plan.tile,
         )
-
-    offsets = list(range(0, Qp, plan.qbatch))
-    d2, gi = drive_batches(run_batch, offsets, plan.cmax, tree.num_buckets)
-    return _unsort(order, d2, gi, Q)
+        return _unsort(order, d2, gi, Q)

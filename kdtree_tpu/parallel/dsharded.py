@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from kdtree_tpu.ops.bruteforce import _knn_scan
 
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 
 def _local_body(points_cols, queries_cols, *, n: int, k: int, tile: int,
@@ -63,7 +63,7 @@ def _dsharded_jit(points, queries, mesh, k, tile):
             [queries, jnp.zeros((queries.shape[0], dpad), queries.dtype)],
             axis=1,
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _local_body, n=n, k=k, tile=tile, axis_name=SHARD_AXIS
         ),

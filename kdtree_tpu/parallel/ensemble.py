@@ -31,7 +31,7 @@ from kdtree_tpu.models.tree import tree_spec
 from kdtree_tpu.ops.build import build_impl, spec_arrays
 from kdtree_tpu.ops.query import _knn_batch
 
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 
 def _local_build_query(points_local, queries, structure, k: int, num_levels: int,
@@ -56,9 +56,18 @@ def _local_build_query(points_local, queries, structure, k: int, num_levels: int
     return -neg, jnp.take_along_axis(cat_i, sel, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "mesh", "pad_value", "num_levels"))
-def _ensemble_jit(points, queries, structure, k: int, mesh: Mesh, pad_value: float,
-                  num_levels: int):
+# Legacy-jax caveat (no `jax.shard_map`, i.e. the experimental-module era):
+# wrapping THIS fused build+query shard_map in an outer jax.jit miscompiles
+# the query while_loop on the 0.4.x SPMD partitioner — per-shard answers
+# come out wrong while the eager shard_map call is correct (verified
+# against the brute-force oracle both ways). On legacy jax the ensemble
+# entry points therefore call the impl EAGERLY: the shard_map body still
+# compiles as one SPMD program, only the pad/slice prelude runs op-by-op.
+_FUSED_JIT_SAFE = hasattr(jax, "shard_map")
+
+
+def _ensemble_impl(points, queries, structure, k: int, mesh: Mesh, pad_value: float,
+                   num_levels: int):
     n, d = points.shape
     p = mesh.shape[SHARD_AXIS]
     pad = (-n) % p
@@ -66,7 +75,7 @@ def _ensemble_jit(points, queries, structure, k: int, mesh: Mesh, pad_value: flo
         points = jnp.concatenate(
             [points, jnp.full((pad, d), pad_value, points.dtype)], axis=0
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _local_build_query, k=k, num_levels=num_levels, axis_name=SHARD_AXIS
         ),
@@ -78,6 +87,10 @@ def _ensemble_jit(points, queries, structure, k: int, mesh: Mesh, pad_value: flo
     d2, gidx = fn(points, queries, structure)
     # padding rows (if any) can never win: +inf coords give +inf distances
     return d2, jnp.where(gidx < n, gidx, -1).astype(jnp.int32)
+
+
+_ensemble_jit = functools.partial(jax.jit, static_argnames=(
+    "k", "mesh", "pad_value", "num_levels"))(_ensemble_impl)
 
 
 def _local_gen_build_query(start, seed, queries, structure, *, dim: int,
@@ -105,13 +118,9 @@ def _local_gen_build_query(start, seed, queries, structure, *, dim: int,
     return _merge_partials(all_d, all_i, k)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "mesh", "dim", "rows", "num_points", "num_levels"),
-)
-def _ensemble_gen_jit(starts, seed, queries, structure, k, mesh, dim, rows,
-                      num_points, num_levels):
-    fn = jax.shard_map(
+def _ensemble_gen_impl(starts, seed, queries, structure, k, mesh, dim, rows,
+                       num_points, num_levels):
+    fn = shard_map(
         functools.partial(
             _local_gen_build_query, dim=dim, rows=rows,
             num_points=num_points, k=k, num_levels=num_levels,
@@ -123,6 +132,10 @@ def _ensemble_gen_jit(starts, seed, queries, structure, k, mesh, dim, rows,
         check_vma=False,
     )
     return fn(starts, seed, queries, structure)
+
+
+_ensemble_gen_jit = functools.partial(jax.jit, static_argnames=(
+    "k", "mesh", "dim", "rows", "num_points", "num_levels"))(_ensemble_gen_impl)
 
 
 def ensemble_knn_gen(
@@ -145,7 +158,8 @@ def ensemble_knn_gen(
     num_levels = tree_spec(rows).num_levels
     k = min(k, num_points)
     starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
-    return _ensemble_gen_jit(
+    run = _ensemble_gen_jit if _FUSED_JIT_SAFE else _ensemble_gen_impl
+    return run(
         starts, jnp.asarray([seed], jnp.int32), queries, structure, k, mesh,
         dim, rows, num_points, num_levels,
     )
@@ -175,4 +189,5 @@ def ensemble_knn(
     n_local = (n + p - 1) // p  # ceil-div: padded rows / shard count
     structure = spec_arrays(n_local, d)
     num_levels = tree_spec(n_local).num_levels
-    return _ensemble_jit(points, queries, structure, k, mesh, float("inf"), num_levels)
+    run = _ensemble_jit if _FUSED_JIT_SAFE else _ensemble_impl
+    return run(points, queries, structure, k, mesh, float("inf"), num_levels)

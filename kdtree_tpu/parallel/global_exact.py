@@ -55,13 +55,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kdtree_tpu import obs
 from kdtree_tpu.models.tree import tree_spec
 from kdtree_tpu.ops.build import build_impl, spec_arrays
 from kdtree_tpu.ops.generate import generate_points_shard
 from kdtree_tpu.ops.query import _knn_batch
 
 from .global_morton import _merge_partials
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 DEFAULT_SLACK = 1.6
 
@@ -307,7 +308,7 @@ def _build_jit(starts, seed, structure, mesh, dim, rows, width, num_points,
     med_ks = tuple(
         tuple(c // 2 for c in sizes) for sizes in _top_layout(num_points, p)
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _build_local_body,
             dim=dim, rows=rows, width=width, num_points=num_points, p=p,
@@ -368,6 +369,7 @@ def build_global_exact(
             f"mirror-exchange capacity overflow ({int(overflow[0])} rows); "
             f"retry with slack > {slack}"
         )
+    obs.count_build("global-exact", num_points)
     return GlobalExactTree(
         top_pts, top_gid, lpts, lnode, lsplit, lgid,
         num_points=num_points, seed=seed,
@@ -404,7 +406,7 @@ def _query_local_body(top_pts, top_gid, lpts, lnode, lsplit, lgid, queries,
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "num_levels"))
 def _query_jit(tree_arrays, queries, mesh, k, num_levels):
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _query_local_body, k=k, num_levels=num_levels,
             axis_name=SHARD_AXIS,
@@ -515,6 +517,10 @@ def global_exact_query(
     rows = tree.local_pts.shape[1]
     num_levels = tree_spec(rows).num_levels
     k = min(k, tree.num_points)
+    if not obs.is_tracer(queries):
+        from .global_morton import _count_sharded_query
+
+        _count_sharded_query("global-exact", queries.shape[0], tree.devices)
     if mesh is None and len(jax.devices()) >= tree.devices:
         from .mesh import make_mesh
 

@@ -50,10 +50,35 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kdtree_tpu import obs
 from kdtree_tpu.ops.morton import build_morton_impl, morton_codes, _morton_knn_one
 from kdtree_tpu.ops.generate import COORD_MAX, COORD_MIN, generate_points_shard
 
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
+
+
+def _count_build(num_points: int, devices: int) -> None:
+    obs.count_build("global-morton", num_points)
+    obs.get_registry().gauge("kdtree_forest_devices").set(devices)
+
+
+def _count_sharded_query(engine: str, q: int, devices: int) -> None:
+    """Per-shard query load for a forest of ``devices`` local trees.
+
+    Queries are replicated and the merge scans EVERY shard's tree (SPMD or
+    the sequential mesh-free fallback alike), so each shard's counter
+    advances by q — the family reports how much query work each shard's
+    tree absorbed, sized by the BUILD-time shard count. It is uniform by
+    construction; a future selective router (query only the shards whose
+    code range can matter) is what would make it skew. Shared by the
+    forest engines — global-morton here and global-exact (which imports
+    this); the single-heap ``global`` engine has no shards to count."""
+    obs.count_query(engine, q)
+    reg = obs.get_registry()
+    for shard in range(devices):
+        reg.counter(
+            "kdtree_shard_queries_total", labels={"shard": str(shard)}
+        ).inc(q)
 
 DEFAULT_SAMPLES = 256
 DEFAULT_SLACK = 2.0
@@ -293,7 +318,7 @@ def _build_jit(starts, seed, mesh, dim, rows, num_points, cap, bucket_cap,
     # seed is a TRACED scalar (not static): a warmup run on one seed compiles
     # the build for every seed
     p = mesh.shape[SHARD_AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _build_local,
             dim=dim, rows=rows, num_points=num_points, p=p,
@@ -356,11 +381,13 @@ def _tiled_query_local(node_lo, node_hi, bucket_pts, bucket_gid, sq, *,
         node_lo[0], node_hi[0], bucket_pts[0], bucket_gid[0],
         n_real=n_shard, num_levels=num_levels,
     )
-    fd, fi, ov = _tiled_batch(tree, sq, k, tile, cmax, seeds, v, use_pallas)
+    fd, fi, ov, nc = _tiled_batch(tree, sq, k, tile, cmax, seeds, v,
+                                  use_pallas)
     all_d = lax.all_gather(fd, axis_name)  # [P, QB, k]
     all_i = lax.all_gather(fi, axis_name)
     md, mi = _merge_partials(all_d, all_i, k)
-    return md, mi, lax.psum(ov.astype(jnp.int32), axis_name)
+    return (md, mi, lax.psum(ov.astype(jnp.int32), axis_name),
+            lax.psum(nc, axis_name))
 
 
 @functools.partial(
@@ -373,7 +400,7 @@ def _tiled_query_local(node_lo, node_hi, bucket_pts, bucket_gid, sq, *,
 def _tiled_query_batch_jit(node_lo, node_hi, bucket_pts, bucket_gid, sq,
                            mesh, k, num_levels, n_shard, tile, cmax, seeds,
                            v, use_pallas):
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _tiled_query_local,
             k=k, num_levels=num_levels, n_shard=n_shard, tile=tile,
@@ -385,7 +412,7 @@ def _tiled_query_batch_jit(node_lo, node_hi, bucket_pts, bucket_gid, sq,
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
             P(None, None),
         ),
-        out_specs=(P(None, None), P(None, None), P()),
+        out_specs=(P(None, None), P(None, None), P(), P()),
         check_vma=False,
     )
     return fn(node_lo, node_hi, bucket_pts, bucket_gid, sq)
@@ -396,7 +423,7 @@ def _tiled_query_batch_jit(node_lo, node_hi, bucket_pts, bucket_gid, sq,
 )
 def _query_jit(node_lo, node_hi, bucket_pts, bucket_gid, queries, mesh, k,
                num_levels, num_points):
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _query_local,
             k=k, num_levels=num_levels, num_points=num_points,
@@ -440,10 +467,13 @@ def build_global_morton(
     bits = max(1, min(32 // max(dim, 1), 16))
     cap = max(1, int(rows / p * slack))
     starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
-    node_lo, node_hi, bucket_pts, bucket_gid, overflow, occ = _build_jit(
-        starts, jnp.asarray([seed], jnp.int32), mesh, dim, rows, num_points,
-        cap, bucket_cap, bits, distribution
-    )
+    with obs.span("build.global-morton", n=num_points, devices=p) as sp:
+        node_lo, node_hi, bucket_pts, bucket_gid, overflow, occ = _build_jit(
+            starts, jnp.asarray([seed], jnp.int32), mesh, dim, rows,
+            num_points, cap, bucket_cap, bits, distribution
+        )
+        sp.append(overflow)  # span exit barriers on the build's tail output
+        _count_build(num_points, p)
     if int(overflow[0]) > 0:
         raise RuntimeError(
             f"sample-sort capacity overflow ({int(overflow[0])} rows); "
@@ -475,7 +505,7 @@ def _ingest_local(pts, gid, grid_lo, grid_hi, *, p, cap, bucket_cap, bits,
 )
 def _ingest_jit(pts, gid, grid_lo, grid_hi, mesh, cap, bucket_cap, bits):
     p = mesh.shape[SHARD_AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ingest_local,
             p=p, cap=cap, bucket_cap=bucket_cap, bits=bits,
@@ -608,6 +638,7 @@ def build_global_morton_from_points(
             f"sample-sort capacity overflow ({int(overflow[0])} rows); "
             f"retry with slack > {slack}"
         )
+    _count_build(n, p)
     return GlobalMortonForest(
         node_lo, node_hi, bucket_pts, bucket_gid,
         num_points=n, seed=-1, bucket_cap=bucket_cap, bits=bits,
@@ -717,6 +748,7 @@ def build_global_morton_from_shard_files(
         (p, width), sharding, gid_parts)
     bits = max(1, min(32 // max(dim, 1), 16))
     nl, nh, bp, bg, occ = _local_forest_jit(lpts, lgid, bucket_cap, bits)
+    _count_build(n, p)
     return GlobalMortonForest(
         nl, nh, bp, bg, num_points=n, seed=-1, bucket_cap=bucket_cap,
         bits=bits, occ_max=int(jnp.max(occ)),
@@ -742,6 +774,9 @@ def global_morton_query(
 
         mesh = make_mesh(forest.devices)
     k = min(k, forest.num_points)
+    if not obs.is_tracer(queries):
+        _count_sharded_query("global-morton", queries.shape[0],
+                             forest.devices)
     from kdtree_tpu.ops.tile_query import dense_lowd
 
     if dense_lowd(queries.shape[0], forest.num_points, forest.dim):
@@ -812,7 +847,10 @@ def _query_tiled_spmd(forest, queries, k: int, mesh):
         )
 
     offsets = list(range(0, sq.shape[0], plan.qbatch))
-    d2, gi = drive_batches(run_batch, offsets, plan.cmax, nbp)
+    d2, gi = drive_batches(
+        run_batch, offsets, plan.cmax, nbp,
+        scan_units_per_batch=(plan.qbatch // plan.tile) * forest.devices,
+    )
     return _unsort(order, d2, gi, Q)
 
 

@@ -50,10 +50,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kdtree_tpu import obs
 from kdtree_tpu.models.tree import tree_spec
 from kdtree_tpu.ops.query import _knn_batch_nodes
 
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 
 @jax.tree_util.register_pytree_node_class
@@ -239,7 +240,7 @@ def _global_build_local(
 )
 def _build_global_jit(points, gid, consume, posnode, mesh, num_levels, heap_size):
     p = mesh.shape[SHARD_AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _global_build_local,
             num_levels=num_levels,
@@ -286,6 +287,7 @@ def build_global(points: jax.Array, mesh: Mesh | None = None) -> GlobalKDTree:
         points, gid, consume, posnode, mesh, spec.num_levels, spec.heap_size
     )
     trav = jnp.asarray(_traversable_mask(n_pad, n))
+    obs.count_build("global", n)
     return GlobalKDTree(
         node_coords=node_coords,
         node_gid=node_gid,
@@ -318,7 +320,7 @@ def _global_gen_local(start, seed, consume_local, posnode_local, *, dim: int,
 def _build_global_gen_jit(starts, seed, consume, posnode, mesh, dim, rows,
                           num_points, num_levels, heap_size):
     p = mesh.shape[SHARD_AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _global_gen_local,
             dim=dim, rows=rows, num_points=num_points,
@@ -377,6 +379,8 @@ def global_knn(
     Returns (dists_sq f32[Q, k], global indices i32[Q, k]) ascending.
     """
     k = min(k, gtree.n_real)
+    if not obs.is_tracer(queries):
+        obs.count_query("global", queries.shape[0])
     return _knn_batch_nodes(
         gtree.node_coords, gtree.node_gid, gtree.node_traversable, queries, k,
         gtree.num_levels,

@@ -25,3 +25,23 @@ def make_mesh(num_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
             raise ValueError(f"requested {num_devices} devices, have {len(devs)}")
         devs = devs[:num_devices]
     return Mesh(np.array(devs), (axis,))
+
+
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: newer jax exposes it as
+    ``jax.shard_map`` with a ``check_vma`` kwarg; older releases (e.g. the
+    0.4.x line) only have ``jax.experimental.shard_map.shard_map`` with
+    the same check under its previous name ``check_rep``. Every SPMD
+    entry point in :mod:`kdtree_tpu.parallel` routes through here so the
+    framework runs on both without 11 scattered version checks."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

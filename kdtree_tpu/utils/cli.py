@@ -9,6 +9,12 @@ Covers both reference entry modes (SURVEY.md C10) plus framework subcommands:
   Unlike the reference, no compile-time DEBUG gate — both modes always exist.
 - ``bench``: per-phase timing (gen/build/query) with compile separated.
 - ``build`` / ``query``: build-and-save / load-and-query (npz checkpoint).
+- ``stats``: render a ``--metrics-out`` telemetry report human-readably.
+
+Any subcommand run with the top-level ``--metrics-out PATH`` flag writes a
+one-shot JSON telemetry report (metrics registry + spans + JAX runtime
+facts — see docs/OBSERVABILITY.md) on exit, including failed exits: a
+degraded run's report is exactly the one worth reading.
 
 Engine selection is honest about hardware: ``auto`` picks by measured
 crossovers (see ``_resolve_engine``) — MXU brute force in high D (the
@@ -401,12 +407,22 @@ def cmd_bench(args) -> None:
                 h += [d2, idx]
         return d2
 
+    import time as _time
+
+    import jax
+
+    from kdtree_tpu.obs import jaxrt
+
+    # device-init duration + platform/device-count facts land in the
+    # registry (and thus any --metrics-out report) before any compile
+    t0 = _time.perf_counter()
+    devices = jax.devices()
+    jaxrt.record_device_init(_time.perf_counter() - t0)
+
     # warmup on a distinct seed: compiles everything, excluded from timing.
     # Timed run uses a fresh seed — re-running a jitted fn on the very same
     # arrays can report ~0s (see .claude/skills/verify/SKILL.md).
     np.asarray(run(args.seed + 1000, None))
-
-    import jax
 
     timer = PhaseTimer()
     trace = (jax.profiler.trace(args.trace) if getattr(args, "trace", None)
@@ -421,6 +437,7 @@ def cmd_bench(args) -> None:
     rep.update(
         n=args.n, dim=args.dim, k=args.k, engine=engine,
         pts_per_sec=(args.n / solve_s) if solve_s > 0 else None,
+        platform=devices[0].platform, device_count=len(devices),
     )
     print(json.dumps(rep))
 
@@ -807,8 +824,34 @@ def cmd_query(args) -> None:
     print("DONE")
 
 
+def cmd_stats(args) -> None:
+    """Render a --metrics-out JSON telemetry report human-readably (the
+    registry snapshot is machine-first; this is the operator view)."""
+    from kdtree_tpu.obs import export
+
+    try:
+        with open(args.report) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read telemetry report {args.report}: {e}",
+              file=sys.stderr)
+        sys.exit(1)
+    if not isinstance(rep, dict) or "counters" not in rep:
+        print(f"{args.report} is not a kdtree-tpu telemetry report "
+              "(missing 'counters'); was it written by --metrics-out?",
+              file=sys.stderr)
+        sys.exit(1)
+    sys.stdout.write(export.render_report(rep))
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="kdtree-tpu", description=__doc__)
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write a one-shot JSON telemetry report (metrics "
+                        "registry + spans + JAX runtime facts) on exit; "
+                        "also enables the device-side metrics that cost a "
+                        "fetch (bucket occupancy, tile candidate counts). "
+                        "Render it with the 'stats' subcommand")
     p.add_argument("--platform", default=None,
                    help="pin jax_platforms (e.g. 'cpu') — needed because the "
                         "axon sitecustomize overrides the JAX_PLATFORMS env var")
@@ -887,6 +930,13 @@ def main(argv=None) -> None:
                         "loads above the host budget fail crisply)")
     q.set_defaults(fn=cmd_query)
 
+    st = sub.add_parser(
+        "stats", help="render a --metrics-out telemetry report"
+    )
+    st.add_argument("report", metavar="REPORT.json",
+                    help="path a previous run's --metrics-out wrote")
+    st.set_defaults(fn=cmd_stats)
+
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -896,6 +946,11 @@ def main(argv=None) -> None:
         # Usage parity with Utility.cpp:109-112
         print(f"Usage: {p.prog} harness SEED DIM_POINTS  NUM_POINTS", file=sys.stderr)
         sys.exit(1)
+    metrics_out = getattr(args, "metrics_out", None)
+    if metrics_out and args.cmd != "stats":
+        from kdtree_tpu import obs
+
+        obs.configure(metrics_out=metrics_out)
     from kdtree_tpu.ops.morton import BuildCapacityError
 
     try:
@@ -905,6 +960,19 @@ def main(argv=None) -> None:
         # it with the crisp stderr + exit-code contract (C10), not a traceback
         print(str(e), file=sys.stderr)
         sys.exit(1)
+    finally:
+        # write the report even on failed exits — a degraded run's
+        # telemetry is exactly the part worth keeping; and a failed WRITE
+        # must never replace the run's own exit (telemetry never fails
+        # the run it observes)
+        if metrics_out and args.cmd != "stats":
+            from kdtree_tpu import obs
+
+            try:
+                obs.finalize()
+            except OSError as e:
+                print(f"cannot write telemetry report {metrics_out}: {e}",
+                      file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -13,9 +13,13 @@ mid-pipeline rather than at the edges.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from kdtree_tpu.obs import get_registry
 
 
 def assert_no_nan(arr: jax.Array, name: str = "points") -> jax.Array:
@@ -23,8 +27,20 @@ def assert_no_nan(arr: jax.Array, name: str = "points") -> jax.Array:
 
     +inf is allowed — it is the framework-wide padding sentinel; NaN never
     is. Returns the array so call sites can stay expression-shaped.
+
+    Each invocation and its wall-clock cost (the reduction IS a host sync)
+    land in the registry (``kdtree_guard_nan_checks_total`` /
+    ``kdtree_guard_nan_check_seconds_total``), so the guard's hot-path
+    overhead is a measurement, not an assumption.
     """
-    if bool(jnp.any(jnp.isnan(arr))):
+    t0 = time.perf_counter()
+    bad = bool(jnp.any(jnp.isnan(arr)))
+    reg = get_registry()
+    reg.counter("kdtree_guard_nan_checks_total").inc()
+    reg.counter("kdtree_guard_nan_check_seconds_total").inc(
+        time.perf_counter() - t0
+    )
+    if bad:
         raise ValueError(
             f"{name} contains NaN coordinates; refusing to build/query — "
             "NaN breaks Morton quantization silently (every comparison is "

@@ -3,8 +3,17 @@
 The reference wraps all of main in one chrono timer behind a compile-time
 macro (``kdtree_sequential.cpp:146-154,186-191``), conflating generation,
 build, and query, and conflating compile with run. Here: named phases, each
-fenced with ``jax.block_until_ready`` so async dispatch can't lie, and
-explicit warmup so compile time is reported separately.
+fenced so async dispatch can't lie, and explicit warmup so compile time is
+reported separately.
+
+``PhaseTimer`` is now a thin compatibility wrapper over the telemetry
+subsystem's span tracer (:mod:`kdtree_tpu.obs.spans`): each phase is a
+span, so phases land in the metrics registry, nest under any enclosing
+span, name themselves in ``jax.profiler`` traces, and share the single
+:func:`kdtree_tpu.obs.spans.hard_sync` host-fetch barrier (formerly
+duplicated here and in ``bench.py`` — on axon, ``block_until_ready`` can
+return early under a deep dispatch queue; the 1-element host fetch is a
+true data-dependent barrier and costs only the tunnel RTT).
 
 Measured pitfall on the axon TPU platform (see .claude/skills/verify/SKILL.md):
 re-running a jitted function on the *same* input array can report ~0s; always
@@ -14,39 +23,29 @@ time with fresh inputs.
 from __future__ import annotations
 
 import contextlib
-import time
-from typing import Any, Dict
+from typing import Dict
 
-import jax
+from kdtree_tpu.obs.spans import span
 
 
 class PhaseTimer:
-    """Collects named phase durations; each phase blocks on its outputs."""
+    """Collects named phase durations; each phase hard-syncs the outputs
+    appended to the yielded handle before its clock stops."""
 
     def __init__(self) -> None:
         self.phases: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        holder: list[Any] = []
-        t0 = time.perf_counter()
+        sp = None
         try:
-            # names the phase in a jax.profiler trace (no-op when not tracing)
-            with jax.profiler.TraceAnnotation(name):
-                yield holder
+            with span(name) as sp:
+                yield sp
         finally:
-            if holder:
-                jax.block_until_ready(holder)
-                # belt-and-braces sync: on axon, block_until_ready can return
-                # early under a deep dispatch queue; a 1-element host fetch of
-                # each output is a true data-dependent barrier and costs only
-                # the tunnel RTT.
-                import numpy as _np
-
-                for leaf in jax.tree_util.tree_leaves(holder):
-                    if hasattr(leaf, "ravel"):
-                        _np.asarray(leaf.ravel()[:1])
-            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0
+            if sp is not None and sp.duration is not None:
+                self.phases[name] = (
+                    self.phases.get(name, 0.0) + sp.duration
+                )
 
     def total(self) -> float:
         return sum(self.phases.values())

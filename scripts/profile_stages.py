@@ -47,11 +47,21 @@ def main():
     from jax import lax
 
     import kdtree_tpu as kt
+    from kdtree_tpu import obs
     from kdtree_tpu.ops.morton import morton_codes
     from kdtree_tpu.ops import tile_query as tq
     from kdtree_tpu.ops.tile_query import (
         _frontier, _scan_tiles, _sort_queries, plan_tiled,
     )
+
+    # telemetry sidecar alongside the stage table (shared contract with
+    # bench.py via obs.sidecar_path: env override, =none disables)
+    metrics_out = obs.sidecar_path("profile_telemetry.json")
+    if metrics_out:
+        from kdtree_tpu.obs import jaxrt
+
+        obs.configure(metrics_out=metrics_out)
+        jaxrt.probe_devices()
 
     platform = jax.devices()[0].platform
     peak = HBM_PEAK_GBS.get(platform, 100.0)
@@ -214,6 +224,15 @@ def main():
     dt = time.perf_counter() - t0
     print(f"{'query: full tiled pipeline':34s} {dt*1e3:9.2f} ms "
           f"({Q/dt:,.0f} q/s)")
+
+    if metrics_out:
+        # guarded: the stage table above already printed — failed telemetry
+        # must not turn a successful profile into a crash
+        if obs.finalize_guarded(
+            extra={"platform": platform, "n": n, "q": Q, "k": k}
+        ) is not None:
+            print(f"telemetry sidecar written to {metrics_out}",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -111,7 +111,9 @@ def test_radix_select_duplicate_keys():
         )
         return mk[None], mg[None]
 
-    fn = jax.jit(jax.shard_map(  # k is traced: ONE compile for all ranks
+    from kdtree_tpu.parallel.mesh import shard_map
+
+    fn = jax.jit(shard_map(  # k is traced: ONE compile for all ranks
         body, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(None)),
         out_specs=(P(None), P(None)), check_vma=False,

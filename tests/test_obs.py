@@ -1,0 +1,448 @@
+"""Telemetry subsystem tests: registry semantics, span nesting, JAX
+runtime listeners (recompile detection), exporters, domain-counter wiring
+through the engines, and the CLI --metrics-out / stats round trip."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import obs
+from kdtree_tpu.obs import export, jaxrt
+from kdtree_tpu.obs.registry import MetricsRegistry, format_key
+
+
+@pytest.fixture(autouse=True)
+def _reset_enabled():
+    yield
+    obs.set_enabled(None)
+    obs.flush()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", labels={"engine": "morton"})
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # same (name, labels) -> same instrument; different labels -> distinct
+    assert reg.counter("c_total", labels={"engine": "morton"}) is c
+    assert reg.counter("c_total", labels={"engine": "tiled"}) is not c
+
+    g = reg.gauge("g")
+    g.set(7)
+    g.add(-2)
+    assert g.value == 5.0
+
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(56.05)
+    # cumulative: <=0.1 -> 1, <=1.0 -> 3, <=10.0 -> 4, +Inf -> 5
+    assert list(snap["buckets"].values()) == [1, 3, 4, 5]
+
+    # a name cannot change kind
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("c_total")
+
+
+def test_histogram_observe_array_matches_scalar_path():
+    reg = MetricsRegistry()
+    h1 = reg.histogram("a", buckets=(1, 2, 4))
+    h2 = reg.histogram("b", buckets=(1, 2, 4))
+    vals = np.asarray([0.0, 1.0, 1.5, 2.0, 3.0, 100.0])
+    for v in vals:
+        h1.observe(float(v))
+    h2.observe_array(vals)
+    assert h1.snapshot() == h2.snapshot()
+
+
+def test_counter_concurrent_increments_from_threads():
+    reg = MetricsRegistry()
+    c = reg.counter("threads_total")
+    n_threads, per_thread = 8, 5000
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == n_threads * per_thread
+
+
+def test_format_key():
+    assert format_key("m", ()) == "m"
+    assert format_key("m", (("a", "1"), ("b", "x"))) == 'm{a="1",b="x"}'
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_monotonicity():
+    from kdtree_tpu.obs.spans import span
+
+    reg = MetricsRegistry()
+    with span("outer", registry=reg) as outer:
+        with span("inner", registry=reg) as inner:
+            pass
+        assert inner.path == "outer/inner"
+        assert inner.duration is not None and inner.duration >= 0.0
+    assert outer.duration is not None
+    # a parent's clock covers its children
+    assert outer.duration >= inner.duration
+    snap = reg.snapshot()
+    keys = set(snap["histograms"])
+    assert 'kdtree_span_seconds{span="outer"}' in keys
+    assert 'kdtree_span_seconds{span="outer/inner"}' in keys
+
+
+def test_span_hard_syncs_appended_outputs():
+    import jax.numpy as jnp
+
+    from kdtree_tpu.obs.spans import span
+
+    reg = MetricsRegistry()
+    with span("synced", registry=reg) as sp:
+        sp.append(jnp.arange(1024) * 2)  # device output; exit must barrier
+    assert sp.duration is not None and sp.duration > 0.0
+
+
+def test_span_stack_survives_sync_failure():
+    """A hard_sync failure at span exit (deferred device errors surface at
+    the barrier) must still pop the span and record it — a leaked stack
+    entry would mislabel every later span path on the thread."""
+    from unittest import mock
+
+    from kdtree_tpu.obs import spans as spans_mod
+    from kdtree_tpu.obs.spans import span
+
+    reg = MetricsRegistry()
+    with mock.patch.object(spans_mod, "hard_sync",
+                           side_effect=RuntimeError("device died")):
+        with pytest.raises(RuntimeError, match="device died"):
+            with span("doomed", registry=reg) as sp:
+                sp.append(object())  # non-empty -> exit barrier runs
+    # stack clean: a fresh span records a TOP-LEVEL path
+    with span("after", registry=reg) as sp2:
+        pass
+    assert sp2.path == "after"
+    keys = set(reg.snapshot()["histograms"])
+    assert 'kdtree_span_seconds{span="doomed"}' in keys
+    assert 'kdtree_span_seconds{span="after"}' in keys
+
+
+def test_hard_sync_handles_pytrees_and_empties():
+    import jax.numpy as jnp
+
+    obs.hard_sync(None)
+    obs.hard_sync([])
+    obs.hard_sync({"a": jnp.zeros(4), "b": (jnp.ones(2), 3.0)})
+
+
+def test_phase_timer_is_span_backed():
+    from kdtree_tpu.utils.timing import PhaseTimer
+
+    reg_before = obs.get_registry().snapshot()["histograms"]
+    t = PhaseTimer()
+    with t.phase("obs_phase_x"):
+        pass
+    assert "obs_phase_x" in t.phases
+    hists = obs.get_registry().snapshot()["histograms"]
+    key = 'kdtree_span_seconds{span="obs_phase_x"}'
+    prev = reg_before.get(key, {"count": 0})["count"]
+    assert hists[key]["count"] == prev + 1
+
+
+# ---------------------------------------------------------------------------
+# JAX runtime telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_counter_detects_retrace():
+    import jax
+    import jax.numpy as jnp
+
+    jaxrt.install()
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    before = jaxrt.recompile_count()
+    f(jnp.zeros(8)).block_until_ready()
+    f(jnp.zeros(8)).block_until_ready()  # cache hit: no new compile
+    after_first = jaxrt.recompile_count()
+    assert after_first >= before + 1
+    # intentional retrace: a new shape busts the jit cache
+    f(jnp.zeros(9)).block_until_ready()
+    assert jaxrt.recompile_count() >= after_first + 1
+
+
+def test_negative_duration_event_never_raises():
+    """The persistent compilation cache emits compile_time_saved_sec as a
+    SIGNED delta (negative when retrieval costs more than a tiny compile).
+    The listener must absorb it — a raise here propagates into whatever
+    jax call emitted the event (the original bug broke knn() mid-suite)."""
+    from kdtree_tpu.obs.jaxrt import _on_event_duration
+
+    _on_event_duration("/jax/compilation_cache/compile_time_saved_sec", -0.05)
+    g = obs.get_registry().snapshot()["gauges"]
+    key = ('jax_event_seconds_last'
+           '{event="/jax/compilation_cache/compile_time_saved_sec"}')
+    assert g[key] == -0.05
+
+
+def test_device_init_and_platform_facts():
+    jaxrt.record_device_init(1.25)
+    g = obs.get_registry().snapshot()["gauges"]
+    assert g["jax_device_init_seconds"] == 1.25
+    assert g["jax_device_count"] >= 1
+    assert g['jax_platform_info{platform="cpu"}'] == 1.0
+
+
+def test_memory_snapshot_is_graceful_on_cpu():
+    # CPU devices expose no memory_stats; must no-op, not fabricate
+    out = jaxrt.snapshot_device_memory()
+    assert isinstance(out, dict)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("x_total", labels={"e": "m"}).inc(3)
+    reg.gauge("y").set(2.5)
+    reg.histogram("z_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    text = export.prometheus_text(reg)
+    assert "# TYPE x_total counter" in text
+    assert 'x_total{e="m"} 3' in text
+    assert "# TYPE y gauge" in text
+    assert "y 2.5" in text
+    assert 'z_seconds_bucket{le="0.1"} 0' in text
+    assert 'z_seconds_bucket{le="+Inf"} 1' in text
+    assert "z_seconds_count 1" in text
+
+
+def test_report_and_render(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("kdtree_builds_total", labels={"engine": "morton"}).inc()
+    from kdtree_tpu.obs.spans import span
+
+    with span("phase_a", registry=reg):
+        pass
+    path = str(tmp_path / "rep.json")
+    rep = export.write_report(path, registry=reg,
+                              extra={"platform": "cpu", "degraded": True})
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["platform"] == "cpu"
+    assert loaded["spans"]["phase_a"]["count"] == 1
+    assert loaded["counters"]['kdtree_builds_total{engine="morton"}'] == 1.0
+    text = export.render_report(rep)
+    assert "platform:" in text and "DEGRADED" in text and "phase_a" in text
+
+
+def test_jsonl_event_log(tmp_path):
+    from kdtree_tpu.obs.spans import span
+
+    path = str(tmp_path / "events.jsonl")
+    export.configure_jsonl(path)
+    try:
+        with span("logged_span"):
+            pass
+        export.emit_event({"type": "marker", "note": "hi"})
+    finally:
+        export.configure_jsonl(None)
+    lines = [json.loads(l) for l in open(path)]
+    kinds = [l["type"] for l in lines]
+    assert "span" in kinds and "marker" in kinds
+    sp = next(l for l in lines if l["type"] == "span")
+    assert sp["span"] == "logged_span" and sp["seconds"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: domain counters, prune rate, occupancy, guards
+# ---------------------------------------------------------------------------
+
+
+def test_build_and_query_counters_advance():
+    from kdtree_tpu import build_morton, generate_problem, morton_knn
+
+    reg = obs.get_registry()
+    b = reg.counter("kdtree_builds_total", labels={"engine": "morton"})
+    q = reg.counter("kdtree_queries_total", labels={"engine": "morton"})
+    qr = reg.counter("kdtree_query_rows_total", labels={"engine": "morton"})
+    b0, q0, qr0 = b.value, q.value, qr.value
+    pts, qs = generate_problem(seed=3, dim=3, num_points=2000, num_queries=7)
+    tree = build_morton(pts)
+    morton_knn(tree, qs, k=2)
+    assert b.value == b0 + 1
+    assert q.value == q0 + 1
+    assert qr.value == qr0 + 7
+
+
+def test_tile_query_prune_rate_and_occupancy():
+    import jax.numpy as jnp
+
+    from kdtree_tpu import build_morton, generate_problem
+    from kdtree_tpu.ops import bruteforce
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    obs.set_enabled(True)
+    reg = obs.get_registry()
+    cand = reg.counter("kdtree_tile_candidates_total")
+    units = reg.counter("kdtree_tile_scan_units_total")
+    occ_before = reg.histogram(
+        "kdtree_bucket_occupancy", buckets=(0, 8, 16, 32, 64, 96, 128, 192,
+                                            256, 512)
+    ).count
+    c0, u0 = cand.value, units.value
+
+    pts, _ = generate_problem(seed=5, dim=3, num_points=20000, num_queries=1)
+    tree = build_morton(pts)
+    rng = np.random.default_rng(0)
+    qs = jnp.asarray(rng.uniform(-100, 100, (2048, 3)).astype(np.float32))
+    d2, _ = morton_knn_tiled(tree, qs, k=4)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=4)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-5)
+
+    obs.flush()  # deferred device fetches run at report/flush time
+    assert cand.value > c0, "candidate counter never advanced"
+    assert units.value > u0
+    prune = reg.gauge("kdtree_tile_prune_rate").value
+    assert 0.0 <= prune <= 1.0
+    # the whole point of the tree: most buckets pruned even at small scale
+    assert prune > 0.3
+    occ_after = reg.histogram(
+        "kdtree_bucket_occupancy", buckets=(0, 8, 16, 32, 64, 96, 128, 192,
+                                            256, 512)
+    ).count
+    assert occ_after - occ_before == tree.num_buckets
+
+
+def test_metrics_disabled_skips_device_side_work():
+    from kdtree_tpu import build_morton, generate_problem
+
+    obs.set_enabled(False)
+    reg = obs.get_registry()
+    h = reg.histogram(
+        "kdtree_bucket_occupancy", buckets=(0, 8, 16, 32, 64, 96, 128, 192,
+                                            256, 512)
+    )
+    before = h.count
+    pts, _ = generate_problem(seed=6, dim=3, num_points=3000, num_queries=1)
+    build_morton(pts)
+    obs.flush()
+    assert h.count == before
+
+
+def test_guard_instrumentation():
+    import jax.numpy as jnp
+
+    from kdtree_tpu.utils.guards import assert_no_nan
+
+    reg = obs.get_registry()
+    n = reg.counter("kdtree_guard_nan_checks_total")
+    s = reg.counter("kdtree_guard_nan_check_seconds_total")
+    n0, s0 = n.value, s.value
+    assert_no_nan(jnp.ones((64, 3)))
+    assert n.value == n0 + 1
+    assert s.value > s0
+
+
+def test_drive_batches_counts_batches_and_retries():
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.tile_query import drive_batches
+
+    reg = obs.get_registry()
+    batches = reg.counter("kdtree_tile_batches_total")
+    retries = reg.counter("kdtree_tile_overflow_retries_total")
+    b0, r0 = batches.value, retries.value
+
+    def run_batch(off, cap):
+        return (
+            jnp.zeros((2, 1)),
+            jnp.zeros((2, 1), jnp.int32),
+            jnp.asarray(cap < 4),  # overflow until the cap doubles to 4
+        )
+
+    drive_batches(run_batch, [0, 2], cmax=1, nbp=16)
+    assert batches.value == b0 + 2
+    assert retries.value == r0 + 2  # settle rounds 1->2->4
+
+
+# ---------------------------------------------------------------------------
+# CLI round trip
+# ---------------------------------------------------------------------------
+
+
+def test_cli_metrics_out_roundtrip_and_stats(tmp_path, capsys):
+    from kdtree_tpu.utils.cli import main as cli_main
+
+    path = str(tmp_path / "telemetry.json")
+    cli_main([
+        "--metrics-out", path, "--engine", "morton",
+        "--generator", "threefry",
+        "bench", "--n", "20000", "--dim", "3", "--seed", "7",
+    ])
+    bench_line = capsys.readouterr().out.strip().splitlines()[-1]
+    bench_rep = json.loads(bench_line)
+    assert bench_rep["engine"] == "morton"
+    assert bench_rep["platform"] == "cpu"
+    assert bench_rep["device_count"] >= 1
+
+    with open(path) as f:
+        rep = json.load(f)
+    # the acceptance keys: platform, device init, recompile count, spans,
+    # domain counters — all present in one report
+    assert rep["gauges"]['jax_platform_info{platform="cpu"}'] == 1.0
+    assert rep["gauges"]["jax_device_init_seconds"] >= 0.0
+    assert rep["counters"]["jax_backend_compiles_total"] > 0
+    assert rep["counters"]['kdtree_builds_total{engine="morton"}'] >= 1
+    for phase in ("generate", "build", "query"):
+        assert phase in rep["spans"], f"missing phase span {phase}"
+    # enabled-gated device-side metrics rode along (--metrics-out enables)
+    assert rep["histograms"]["kdtree_bucket_occupancy"]["count"] > 0
+    # at least 10 distinct instrumented metrics overall
+    distinct = (
+        len(rep["counters"]) + len(rep["gauges"]) + len(rep["histograms"])
+    )
+    assert distinct >= 10, f"only {distinct} metrics in the report"
+
+    cli_main(["stats", path])
+    rendered = capsys.readouterr().out
+    assert "platform:" in rendered
+    assert "backend compiles:" in rendered
+    assert "== spans" in rendered
+
+
+def test_cli_stats_rejects_non_report(tmp_path, capsys):
+    from kdtree_tpu.utils.cli import main as cli_main
+
+    bad = tmp_path / "x.json"
+    bad.write_text("[1, 2, 3]")
+    with pytest.raises(SystemExit):
+        cli_main(["stats", str(bad)])
+    missing = tmp_path / "nope.json"
+    with pytest.raises(SystemExit):
+        cli_main(["stats", str(missing)])
