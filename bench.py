@@ -175,22 +175,38 @@ def bench_build_big(kt, n: int, dim: int, nq: int):
 def bench_queries(kt, pts, tree, Q: int, k: int):
     """Tiled k-NN throughput against an existing tree (fresh query sets;
     warmup at full Q compiles the whole tiled pipeline including the
-    Q-sized global sort/unsort programs)."""
+    Q-sized global sort/unsort programs).
+
+    Returns (elapsed_s, oracle_ok, plan_cache, recompiles): ``plan_cache``
+    is "warm" when this process's FIRST plan for the shape came from the
+    persistent store (docs/TUNING.md) — i.e. a previous run or a tune
+    sweep already settled it — and "cold" when the heuristic had to guess;
+    ``recompiles`` counts backend compiles during the TIMED run (a warm
+    steady state must hold this at 0 — cap-doubling retries show up here
+    as fresh static shapes)."""
+    from kdtree_tpu import obs
+    from kdtree_tpu.obs import jaxrt
     from kdtree_tpu.ops.generate import generate_queries
     from kdtree_tpu.ops.tile_query import morton_knn_tiled
 
+    reg = obs.get_registry()
+    hits = reg.counter("kdtree_plan_cache_hits_total")
+    h0 = hits.value
     dim = pts.shape[1]
     d2, _ = morton_knn_tiled(tree, generate_queries(100, dim, Q), k=k)
     _fetch(d2)
+    plan_cache = "warm" if hits.value > h0 else "cold"
     qs = generate_queries(7, dim, Q)
+    c0 = jaxrt.recompile_count()
     t0 = time.perf_counter()
     d2, _ = morton_knn_tiled(tree, qs, k=k)
     _fetch(d2)
     dt = time.perf_counter() - t0
+    recompiles = int(jaxrt.recompile_count() - c0)
     # oracle spot-check on 512 queries (tiled brute force: bounded memory)
     bf, _ = kt.bruteforce.knn(pts, qs[:512], k=k)
     ok = np.allclose(np.asarray(d2[:512]), np.asarray(bf), rtol=1e-4)
-    return dt, ok
+    return dt, ok, plan_cache, recompiles
 
 
 def bench_global_morton(kt, n: int, dim: int, nq: int):
@@ -327,9 +343,13 @@ def main() -> None:
     # line; KDTREE_TPU_METRICS_OUT overrides the path, =none disables all
     # telemetry (the A/B partner for the <2% metrics-overhead check)
     metrics_out = obs.sidecar_path("bench_telemetry.json")
-    if metrics_out:
-        from kdtree_tpu.obs import jaxrt
+    from kdtree_tpu.obs import jaxrt
 
+    # compile counting stays on even with the sidecar disabled — the
+    # headline line's "recompiles" key must never silently read 0 because
+    # telemetry was off
+    jaxrt.install()
+    if metrics_out:
         obs.configure(metrics_out=metrics_out)
         jaxrt.record_device_init(init_s)
 
@@ -365,7 +385,7 @@ def main() -> None:
     extra = []
 
     with obs.span("bench.queries"):
-        qdt, qok = bench_queries(kt, pts, tree, Q, k)
+        qdt, qok, plan_cache, recompiles = bench_queries(kt, pts, tree, Q, k)
     if not qok:
         _fail("oracle check (query)")
     extra.append({
@@ -375,6 +395,8 @@ def main() -> None:
         "unit": "q/s",
         "vs_baseline": None,  # reference: 10 hardcoded 1-NN queries, no
                               # separable timer -> no honest baseline
+        "plan_cache": plan_cache,
+        "recompiles": recompiles,
     })
 
     if Qbig:
@@ -382,7 +404,8 @@ def main() -> None:
         # per-batch programs are those already compiled for Q above, so the
         # extra warmup mostly pays for the 10M-row sort/unsort compiles
         with obs.span("bench.queries-10M"):
-            qbdt, qbok = bench_queries(kt, pts, tree, Qbig, k)
+            qbdt, qbok, qbplan, qbrecomp = bench_queries(kt, pts, tree,
+                                                         Qbig, k)
         if not qbok:
             _fail("oracle check (query-10M)")
         extra.append({
@@ -391,6 +414,8 @@ def main() -> None:
             "value": round(Qbig / qbdt),
             "unit": "q/s",
             "vs_baseline": None,
+            "plan_cache": qbplan,
+            "recompiles": qbrecomp,
         })
 
     if on_accel:

@@ -118,6 +118,14 @@ class MortonTree:
         )
 
 
+def default_bits(dim: int) -> int:
+    """The shared quantization-bit rule: the most bits per axis that still
+    fit a u32 interleaved code for this dimensionality, capped at 16. One
+    definition — a tree built with one rule and queried through a planner
+    using another would silently mismatch Hilbert sort vs tree geometry."""
+    return max(1, min(32 // max(dim, 1), 16))
+
+
 def morton_codes(
     points: jax.Array, bits: int, lo: jax.Array | None = None,
     hi: jax.Array | None = None,
@@ -340,6 +348,15 @@ def morton_view(
     return tree
 
 
+# cached on the owner after the first BuildCapacityError: an over-budget
+# checkpoint's failure is a property of its shape, so retrying it on every
+# dense batch would re-materialize make_inputs()' flattened bucket-points
+# copy (the very allocation the budget guard exists to prevent) just to
+# raise again. A distinct sentinel (not None) so "never tried" and
+# "tried and over budget" stay distinguishable.
+_BUDGET_EXCEEDED = object()
+
+
 def serving_view(owner, make_inputs, cache_attr: str = "_morton_view"):
     """Cache-or-build a dense-serving :func:`morton_view` on ``owner``.
 
@@ -349,13 +366,19 @@ def serving_view(owner, make_inputs, cache_attr: str = "_morton_view"):
     kwargs, cache it on the object, and return ``None`` when the view
     would exceed the single-chip HBM budget (``BuildCapacityError``) so
     the caller falls back to its memory-lean engine instead of surfacing
-    a confusing rebuild error for a query that used to work."""
+    a confusing rebuild error for a query that used to work. The
+    over-budget outcome is cached too: later batches return None without
+    re-running ``make_inputs`` (whose flattened copy is the expensive
+    part)."""
     view = getattr(owner, cache_attr, None)
+    if view is _BUDGET_EXCEEDED:
+        return None
     if view is not None:
         return view
     try:
         view = morton_view(**make_inputs())
     except BuildCapacityError:
+        setattr(owner, cache_attr, _BUDGET_EXCEEDED)
         return None
     setattr(owner, cache_attr, view)
     return view

@@ -47,7 +47,7 @@ from jax import lax
 
 from kdtree_tpu import obs
 from kdtree_tpu.ops.hilbert import hilbert_codes
-from kdtree_tpu.ops.morton import MortonTree
+from kdtree_tpu.ops.morton import MortonTree, default_bits
 
 DEFAULT_TILE = 256
 DEFAULT_CMAX = 128
@@ -325,7 +325,12 @@ def dense_lowd(q: int, n: int, dim: int) -> bool:
 class TiledPlan(NamedTuple):
     """Static launch configuration for a tiled-query run, shared by the
     single-tree driver below and the SPMD forest driver
-    (:func:`kdtree_tpu.parallel.global_morton.global_morton_query_tiled`)."""
+    (:func:`kdtree_tpu.parallel.global_morton.global_morton_query_tiled`).
+
+    ``source`` records where the knobs came from: ``"warm"`` (plan-store
+    hit — the batch driver skips the synchronous first-batch cap-settling
+    probe), ``"heuristic"`` (the static density model), or ``"explicit"``
+    (caller-forced; never recorded back to the store)."""
 
     tile: int
     cmax: int
@@ -334,23 +339,61 @@ class TiledPlan(NamedTuple):
     bits: int
     qbatch: int
     use_pallas: bool
+    source: str = "heuristic"
+    # the plan-store signature this plan was looked up under (None for
+    # explicit plans) — carried here so feedback_for records under EXACTLY
+    # the key lookup consulted; re-deriving it at each call site invited
+    # silent argument-order drift that would de-sync lookup from recording
+    sig: object = None
 
 
 def plan_tiled(
     Q: int, D: int, n_real: int, nbp: int, B: int, k: int,
     tile: int | None = None, cmax: int = DEFAULT_CMAX,
     seeds: int = DEFAULT_SEEDS, use_pallas: bool | None = None,
+    devices: int = 1,
 ) -> TiledPlan:
     """Resolve the static knobs of a tiled run from the problem shape.
 
-    ``tile=None`` picks the tile size from query/point density;
-    ``use_pallas=None`` enables the fused Mosaic kernel on TPU backends
-    and the XLA scan elsewhere (tests force use_pallas=True, which
-    interprets off-TPU).
+    ``tile=None`` picks the launch configuration automatically: first from
+    the persistent plan store (:mod:`kdtree_tpu.tuning` — a previous run's
+    settled tile/cmax/seeds for this quantized problem signature, in which
+    case the caller-supplied ``cmax``/``seeds`` starting hints are
+    superseded), then from the static density heuristic on a miss.
+    ``devices`` is the per-shard plan context (forest drivers pass their
+    shard count so a P=8 shard plan never collides with a single-chip
+    one). ``use_pallas=None`` enables the fused Mosaic kernel on TPU
+    backends and the XLA scan elsewhere (tests force use_pallas=True,
+    which interprets off-TPU).
     """
+    forced_engine = use_pallas is not None
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    if tile is None:
+    source = "explicit"
+    sig = None
+    # the store is consulted/recorded only for FULLY auto plans: a caller
+    # hinting cmax or seeds or forcing the scan engine (even with tile
+    # unset) is a one-off override, and recording its settled knobs would
+    # lock the override into every future auto run of the shape (feedback
+    # never shrinks a cap, and a forced-engine profile would evict the
+    # default engine's warm plan under the shared signature key)
+    auto = (tile is None and cmax == DEFAULT_CMAX
+            and seeds == DEFAULT_SEEDS and not forced_engine)
+    if auto:
+        from kdtree_tpu import tuning
+
+        sig = tuning.make_signature(Q, D, n_real, k, B, nbp,
+                                    devices=devices)
+        prof = tuning.lookup(sig, use_pallas=use_pallas)
+        if prof is not None:
+            tile, cmax = int(prof["tile"]), int(prof["cmax"])
+            seeds = int(prof.get("seeds", seeds))
+            source = "warm"
+        else:
+            tile, cmax = _auto_tile(Q, n_real, k, D, nbp, B, cmax,
+                                    use_pallas)
+            source = "heuristic"
+    elif tile is None:
         tile, cmax = _auto_tile(Q, n_real, k, D, nbp, B, cmax, use_pallas)
     tile = min(tile, max(Q, 1))
     seeds = min(seeds, nbp)
@@ -359,7 +402,7 @@ def plan_tiled(
         # collecting everything (exact, still dense) for oversized k
         cmax = nbp
     cmax = min(cmax, nbp)
-    bits = max(1, min(32 // max(D, 1), 16))
+    bits = default_bits(D)
     # each scan chunk must expose at least k candidate slots to lax.top_k
     v = max(_SCAN_V, -(-k // B))
     # batches bound each device program's runtime (watchdog) and memory;
@@ -368,7 +411,8 @@ def plan_tiled(
     # 2^16 would scan 64x more rows than asked) — cap at Q tile-rounded
     qbatch = max(_BATCH_Q // tile, 1) * tile
     qbatch = min(qbatch, -(-max(Q, 1) // tile) * tile)
-    return TiledPlan(tile, cmax, seeds, v, bits, qbatch, use_pallas)
+    return TiledPlan(tile, cmax, seeds, v, bits, qbatch, use_pallas, source,
+                     sig)
 
 
 def drive_batches(
@@ -377,6 +421,8 @@ def drive_batches(
     cmax: int,
     nbp: int,
     scan_units_per_batch: int | None = None,
+    settle_first: bool = True,
+    feedback=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Async batch dispatch with overflow-retry, shared by every tiled
     driver. ``run_batch(offset, cap) -> (d2, gid, overflow[, ncand])``
@@ -389,10 +435,16 @@ def drive_batches(
     extra stacked host read gated on ``obs.enabled()``, so
     metrics-disabled runs pay nothing.
 
-    Settles the cap on the FIRST batch synchronously: a tile geometry that
-    overflows cap C in one batch tends to overflow it in similar batches
-    too, so systematic undersizing costs one doubling round here instead
-    of a re-run of every batch. Then every remaining batch is dispatched
+    Settles the cap on the FIRST batch synchronously (``settle_first``): a
+    tile geometry that overflows cap C in one batch tends to overflow it
+    in similar batches too, so systematic undersizing costs one doubling
+    round here instead of a re-run of every batch. A WARM plan
+    (``plan.source == "warm"`` — the cap already settled in a previous
+    run and came back from the plan store) passes ``settle_first=False``
+    and skips the probe entirely: every batch dispatches async
+    immediately, and the stacked-flags retry rounds below still guard
+    exactness if the stored cap has gone stale. Then every remaining
+    batch is dispatched
     before syncing anything: a per-batch ``bool(overflow)`` fetch would
     block the host on each program in turn, inserting one tunnel round
     trip between consecutive programs (measured at the 10M-query
@@ -405,13 +457,18 @@ def drive_batches(
     """
     reg = obs.get_registry()
     retries = reg.counter("kdtree_tile_overflow_retries_total")
+    nretries = 0
     bcmax = cmax
-    first = run_batch(offsets[0], bcmax)
-    while bool(first[2]) and bcmax < nbp:
-        bcmax = min(bcmax * 2, nbp)
-        retries.inc()
+    if settle_first:
         first = run_batch(offsets[0], bcmax)
-    batches = [first] + [run_batch(b0, bcmax) for b0 in offsets[1:]]
+        while bool(first[2]) and bcmax < nbp:
+            bcmax = min(bcmax * 2, nbp)
+            retries.inc()
+            nretries += 1
+            first = run_batch(offsets[0], bcmax)
+        batches = [first] + [run_batch(b0, bcmax) for b0 in offsets[1:]]
+    else:
+        batches = [run_batch(b0, bcmax) for b0 in offsets]
     while bcmax < nbp:
         flags = np.asarray(jnp.stack([b[2] for b in batches]))
         bad = np.nonzero(flags)[0]
@@ -420,6 +477,7 @@ def drive_batches(
         bcmax = min(bcmax * 2, nbp)
         for i in bad:
             retries.inc()
+            nretries += 1
             batches[i] = run_batch(offsets[i], bcmax)
     reg.counter("kdtree_tile_batches_total").inc(len(offsets))
     if obs.enabled() and len(batches[0]) > 3:
@@ -429,18 +487,29 @@ def drive_batches(
         units = (scan_units_per_batch or 0) * len(offsets)
 
         def _flush_candidates(reg=reg, ncand_dev=ncand_dev, units=units,
-                              nbp=nbp):
+                              nbp=nbp, feedback=feedback):
             ncand = int(np.asarray(ncand_dev).sum())
             reg.counter("kdtree_tile_candidates_total").inc(ncand)
+            rate = None
             if units:
                 reg.counter("kdtree_tile_scan_units_total").inc(units)
                 denom = units * nbp
                 if denom > 0:
-                    reg.gauge("kdtree_tile_prune_rate").set(
-                        1.0 - ncand / denom
-                    )
+                    rate = 1.0 - ncand / denom
+                    reg.gauge("kdtree_tile_prune_rate").set(rate)
+            if feedback is not None:
+                # hand THIS run's rate to the plan-store enrichment
+                # directly — reading the process-global gauge back would
+                # cross-contaminate signatures when several differently
+                # shaped runs flush together
+                feedback.record_stats(prune_rate=rate)
 
         obs.defer(_flush_candidates)
+    if feedback is not None:
+        # the settled cap and this run's retry count are host-side facts by
+        # now (the retry loop fetched the flags); recording them closes the
+        # auto-tune loop — the next same-shaped run starts here
+        feedback.settled(cmax=bcmax, retries=nretries)
     parts_d = [b[0] for b in batches]
     parts_i = [b[1] for b in batches]
     d2 = jnp.concatenate(parts_d, axis=0) if len(parts_d) > 1 else parts_d[0]
@@ -460,12 +529,16 @@ def morton_knn_tiled(
     """Exact batched k-NN via Hilbert-sorted query tiles and dense scans.
 
     Same contract as :func:`kdtree_tpu.ops.morton.morton_knn` (d2 f32[Q, k],
-    ids i32[Q, k], ascending), built for large Q. ``tile=None`` picks the
-    tile size from query/point density; ``cmax`` doubles automatically (up
-    to the bucket count) when a tile's candidate set overflows — geometry-
-    driven, rare for sane tiles. ``use_pallas=None`` enables the fused
-    scan kernel (:mod:`kdtree_tpu.pallas.scan_knn`) on TPU backends and
-    uses the XLA scan elsewhere.
+    ids i32[Q, k], ascending), built for large Q. ``tile=None`` plans
+    automatically — from the persistent plan store when a previous run
+    settled this problem shape (:mod:`kdtree_tpu.tuning`; a warm plan
+    skips the first-batch cap probe entirely), from query/point density
+    otherwise — and records the settled configuration back. ``cmax``
+    doubles automatically (up to the bucket count) when a tile's
+    candidate set overflows — geometry-driven, rare for sane tiles.
+    ``use_pallas=None`` enables the fused scan kernel
+    (:mod:`kdtree_tpu.pallas.scan_knn`) on TPU backends and uses the XLA
+    scan elsewhere.
     """
     Q, D = queries.shape
     k = min(k, tree.n_real)
@@ -479,6 +552,9 @@ def morton_knn_tiled(
         Q, D, tree.n_real, tree.num_buckets, tree.bucket_size, k,
         tile, cmax, seeds, use_pallas,
     )
+    from kdtree_tpu import tuning
+
+    feedback = tuning.feedback_for(plan)
     qpad = (-Q) % plan.qbatch
     with obs.span("query.tiled", sync=False, q=Q, k=k):
         sq, order = _sort_queries(queries, plan.bits, qpad)
@@ -494,5 +570,7 @@ def morton_knn_tiled(
         d2, gi = drive_batches(
             run_batch, offsets, plan.cmax, tree.num_buckets,
             scan_units_per_batch=plan.qbatch // plan.tile,
+            settle_first=plan.source != "warm",
+            feedback=feedback,
         )
         return _unsort(order, d2, gi, Q)

@@ -447,7 +447,7 @@ def _exact_to_forest(tree: GlobalExactTree, bucket_cap: int = 128):
     forest = getattr(tree, "_forest_cache", None)
     if forest is not None:
         return forest
-    from kdtree_tpu.ops.morton import check_build_capacity
+    from kdtree_tpu.ops.morton import check_build_capacity, default_bits
 
     # The conversion materializes a second copy of every local row set
     # (bucket_pts + gids + AABB heaps). On a matching mesh each device only
@@ -460,7 +460,7 @@ def _exact_to_forest(tree: GlobalExactTree, bucket_cap: int = 128):
     except Exception:
         ndev = 1
     check_build_capacity(-((p * rows) // -ndev), tree.dim)
-    bits = max(1, min(32 // max(tree.dim, 1), 16))
+    bits = default_bits(tree.dim)
     # the shared no-exchange local-build map (vmap over the device axis —
     # with mesh-sharded inputs XLA keeps the sorts where the rows live);
     # occ rides along so tile planning sees the real density (r4 weak #6)

@@ -51,7 +51,9 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kdtree_tpu import obs
-from kdtree_tpu.ops.morton import build_morton_impl, morton_codes, _morton_knn_one
+from kdtree_tpu.ops.morton import (
+    build_morton_impl, default_bits, morton_codes, _morton_knn_one,
+)
 from kdtree_tpu.ops.generate import COORD_MAX, COORD_MIN, generate_points_shard
 
 from .mesh import SHARD_AXIS, shard_map
@@ -82,6 +84,21 @@ def _count_sharded_query(engine: str, q: int, devices: int) -> None:
 
 DEFAULT_SAMPLES = 256
 DEFAULT_SLACK = 2.0
+
+_MAX_ROWS_I32 = 1 << 31  # global point ids are int32 everywhere
+
+
+def _check_rows_fit_i32(n: int, what: str) -> None:
+    """Global point ids (``bucket_gid``, result ids) are int32 throughout
+    the forest; rows past 2**31-1 would wrap their gids negative and be
+    silently treated as padding by every downstream mask — data loss, not
+    an error. Refuse crisply at the door instead."""
+    if n >= _MAX_ROWS_I32:
+        raise ValueError(
+            f"{what} has {n} rows, but global point ids are int32 "
+            f"(max {_MAX_ROWS_I32 - 1} rows per index); split the data "
+            "across multiple forests"
+        )
 
 
 def _partition_exchange(pts, gid, code, p: int, cap: int, axis_name: str):
@@ -458,13 +475,14 @@ def build_global_morton(
     Raises RuntimeError on sample-sort capacity overflow (retry with higher
     ``slack``).
     """
+    _check_rows_fit_i32(num_points, "generative problem")
     if mesh is None:
         from .mesh import make_mesh
 
         mesh = make_mesh()
     p = mesh.shape[SHARD_AXIS]
     rows = -(-num_points // p)  # ceil; past-N rows masked in _build_local
-    bits = max(1, min(32 // max(dim, 1), 16))
+    bits = default_bits(dim)
     cap = max(1, int(rows / p * slack))
     starts = jnp.asarray([i * rows for i in range(p)], jnp.int32)
     with obs.span("build.global-morton", n=num_points, devices=p) as sp:
@@ -618,16 +636,17 @@ def build_global_morton_from_points(
     Raises RuntimeError on sample-sort capacity overflow (retry with higher
     ``slack``) and ValueError on non-finite input rows.
     """
+    n, dim = points.shape
+    if n < 1:
+        raise ValueError("points must be a non-empty [N, D] array")
+    _check_rows_fit_i32(n, "points array")
     if mesh is None:
         from .mesh import make_mesh
 
         mesh = make_mesh()
-    n, dim = points.shape
-    if n < 1:
-        raise ValueError("points must be a non-empty [N, D] array")
     p = mesh.shape[SHARD_AXIS]
     rows = -(-n // p)
-    bits = max(1, min(32 // max(dim, 1), 16))
+    bits = default_bits(dim)
     pts_sh, gid_sh, lo, hi = _stream_rows_to_mesh(points, mesh, rows)
     cap = max(1, int(pts_sh.shape[1] / p * slack))
     node_lo, node_hi, bucket_pts, bucket_gid, overflow, occ = _ingest_jit(
@@ -726,6 +745,7 @@ def build_global_morton_from_shard_files(
     check_build_capacity(width, dim)
     offsets = np.concatenate([[0], np.cumsum([a.shape[0] for a in arrs])])
     n = int(offsets[-1])
+    _check_rows_fit_i32(n, "shard-file set")
     devs = list(mesh.devices.flat)
     pts_parts, gid_parts = [], []
     for i, a in enumerate(arrs):
@@ -746,7 +766,7 @@ def build_global_morton_from_shard_files(
         (p, width, dim), sharding, pts_parts)
     lgid = jax.make_array_from_single_device_arrays(
         (p, width), sharding, gid_parts)
-    bits = max(1, min(32 // max(dim, 1), 16))
+    bits = default_bits(dim)
     nl, nh, bp, bg, occ = _local_forest_jit(lpts, lgid, bucket_cap, bits)
     _count_build(n, p)
     return GlobalMortonForest(
@@ -825,15 +845,24 @@ def _shard_n_real(forest: GlobalMortonForest, k: int) -> int:
 
 def _query_tiled_spmd(forest, queries, k: int, mesh):
     """SPMD tiled forest query: sort+slice on the host, one shard_map
-    program per batch (async-dispatched), shared overflow-retry driver."""
+    program per batch (async-dispatched), shared overflow-retry driver.
+
+    The per-SHARD plan (signature includes ``devices=P`` and the shard's
+    real-row count, so it never collides with a single-chip plan over the
+    same data) consults the persistent store first: a warm hit dispatches
+    every batch at the previously settled cap with no first-batch probe,
+    and the run's settled reality is recorded back either way."""
+    from kdtree_tpu import tuning
     from kdtree_tpu.ops.tile_query import (
         _sort_queries, _unsort, drive_batches, plan_tiled,
     )
 
     Q, D = queries.shape
     nbp = forest.bucket_pts.shape[1]
+    B = forest.bucket_pts.shape[2]
     n_shard = _shard_n_real(forest, k)
-    plan = plan_tiled(Q, D, n_shard, nbp, forest.bucket_pts.shape[2], k)
+    plan = plan_tiled(Q, D, n_shard, nbp, B, k, devices=forest.devices)
+    feedback = tuning.feedback_for(plan)
     qpad = (-Q) % plan.qbatch
     sq, order = _sort_queries(queries, plan.bits, qpad)
 
@@ -850,6 +879,8 @@ def _query_tiled_spmd(forest, queries, k: int, mesh):
     d2, gi = drive_batches(
         run_batch, offsets, plan.cmax, nbp,
         scan_units_per_batch=(plan.qbatch // plan.tile) * forest.devices,
+        settle_first=plan.source != "warm",
+        feedback=feedback,
     )
     return _unsort(order, d2, gi, Q)
 

@@ -1,0 +1,76 @@
+"""kdtree_tpu.tuning — the closed auto-tune loop for the tiled query path.
+
+Three pieces (see ``docs/TUNING.md``):
+
+- :mod:`~kdtree_tpu.tuning.store` — persistent plan profiles (JSON under a
+  cache dir) keyed by a quantized problem signature; survives process
+  restarts, which is the whole point — a settled plan is knowledge about
+  the *data*, not about one process;
+- :mod:`~kdtree_tpu.tuning.feedback` — per-run report-back of the settled
+  cmax / retry count (host-cheap, immediate) and prune-rate / occupancy
+  stats (telemetry-priced, deferred to the obs flush);
+- :mod:`~kdtree_tpu.tuning.tuner` — the explicit ``kdtree-tpu tune``
+  sweep that measures (tile, cmax) candidates and persists the winner.
+
+``plan_tiled`` (:mod:`kdtree_tpu.ops.tile_query`) consults the store via
+:func:`lookup` on every auto-planned run: a warm hit skips the
+synchronous first-batch cap-settling probe, the doubling-retry rounds,
+and their per-shape XLA recompiles. Profiles are advisory only — the
+overflow-retry contract still guards exactness, so the worst a bad
+profile can do is run at yesterday's speed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kdtree_tpu import obs
+from kdtree_tpu.tuning.feedback import PlanFeedback, feedback_for
+from kdtree_tpu.tuning.store import (
+    ENV_CACHE_DIR,
+    PlanSignature,
+    PlanStore,
+    default_cache_dir,
+    default_store,
+    make_signature,
+)
+
+
+def lookup(
+    sig: PlanSignature, use_pallas: Optional[bool] = None,
+    store: Optional[PlanStore] = None,
+) -> Optional[dict]:
+    """Warm-plan lookup for one problem signature (build it with
+    :func:`make_signature`); returns the stored profile dict or None
+    (store disabled / miss / corrupt). A profile recorded for the other
+    scan engine (``use_pallas`` disagrees with this run's) reads as a
+    miss — Pallas-tuned tiles are wrong for the XLA scan and vice versa,
+    and the two paths share a signature key. Hit-or-miss lands in the
+    ``kdtree_plan_cache_{hits,misses}_total`` counters so a serving
+    process's warm ratio is visible in every telemetry report."""
+    store = store if store is not None else default_store()
+    if not store.enabled:
+        return None
+    prof = store.get(sig)
+    if prof is not None and use_pallas is not None and \
+            "use_pallas" in prof and bool(prof["use_pallas"]) != use_pallas:
+        prof = None
+    reg = obs.get_registry()
+    if prof is None:
+        reg.counter("kdtree_plan_cache_misses_total").inc()
+    else:
+        reg.counter("kdtree_plan_cache_hits_total").inc()
+    return prof
+
+
+__all__ = [
+    "ENV_CACHE_DIR",
+    "PlanFeedback",
+    "PlanSignature",
+    "PlanStore",
+    "default_cache_dir",
+    "default_store",
+    "feedback_for",
+    "lookup",
+    "make_signature",
+]

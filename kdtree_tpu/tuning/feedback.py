@@ -1,0 +1,108 @@
+"""Feedback recorder: write each tiled run's settled reality back into the
+plan store — the half of the auto-tune loop that replaces guessing.
+
+Two tiers, matching the obs cost model:
+
+- **Loop-closing facts (always, host-cheap):** the settled ``cmax`` and
+  this run's overflow-retry count are already host-side when the batch
+  driver finishes (the retry loop fetched the flags), so
+  :meth:`PlanFeedback.settled` records them immediately — one small JSON
+  write per query *call*, and only when the profile actually changed
+  (``PlanStore.record`` suppresses no-op rewrites, so a steady-state
+  serving loop settles to zero writes).
+- **Telemetry-priced stats (gated on ``obs.enabled()``):** the observed
+  prune rate and bucket-occupancy quantile come from device fetches the
+  instrumentation defers to report time; the enrichment rides the same
+  ``obs.defer`` queue, AFTER the metric flush callbacks that produce
+  those numbers, so it reads settled gauges instead of adding a sync.
+
+The recorded profile is exactly what ``plan_tiled`` consults on the next
+run with the same signature — see :mod:`kdtree_tpu.tuning.store` for the
+trust model (profiles are advisory; overflow-retry still guards
+exactness).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kdtree_tpu import obs
+from kdtree_tpu.tuning.store import PlanSignature, PlanStore, default_store
+
+
+def occupancy_quantile(q: float, registry=None) -> Optional[float]:
+    """Approximate q-quantile of the ``kdtree_bucket_occupancy`` histogram
+    (upper bound of the first bucket whose cumulative count reaches the
+    quantile) — the load-skew signal slack selection wants. None when the
+    histogram has no observations (occupancy is device-fetch-priced and
+    only recorded under ``obs.enabled()``)."""
+    reg = registry or obs.get_registry()
+    snap = reg.snapshot()["histograms"].get("kdtree_bucket_occupancy")
+    if not snap or not snap["count"]:
+        return None
+    target = q * snap["count"]
+    for upper, cum in snap["buckets"].items():
+        if cum >= target:
+            return None if upper == "+Inf" else float(upper)
+    return None
+
+
+class PlanFeedback:
+    """One tiled run's report-back handle; created by :func:`feedback_for`
+    and driven by ``drive_batches`` once the cap has settled."""
+
+    def __init__(self, sig: PlanSignature, plan, store: PlanStore) -> None:
+        self.sig = sig
+        self.plan = plan
+        self.store = store
+
+    def settled(self, cmax: int, retries: int) -> None:
+        """Record the run's settled launch config (called by the batch
+        driver after every batch has a clean overflow flag)."""
+        self.store.record(
+            self.sig,
+            tile=int(self.plan.tile),
+            cmax=int(cmax),
+            seeds=int(self.plan.seeds),
+            use_pallas=bool(self.plan.use_pallas),
+            overflow_retries=int(retries),
+            source="feedback",
+        )
+
+    def record_stats(self, prune_rate=None) -> None:
+        """Telemetry-priced enrichment, called by the batch driver's OWN
+        deferred candidate-flush callback with THIS run's prune rate (the
+        process-global gauge would cross-contaminate signatures when
+        several shapes flush together). A rate of 0.0 is recorded too —
+        "prunes nothing" is the degraded geometry an operator most wants
+        to see in the profile. The occupancy quantile is a best-effort
+        process-level read (the histogram is per-build, not per-run)."""
+        stats = {}
+        if prune_rate is not None:
+            stats["prune_rate"] = round(float(prune_rate), 6)
+        occ = occupancy_quantile(0.9)
+        if occ is not None:
+            stats["occupancy_p90"] = occ
+        if stats:
+            self.store.record(self.sig, **stats)
+
+
+def feedback_for(
+    plan, store: Optional[PlanStore] = None,
+) -> Optional[PlanFeedback]:
+    """The feedback handle for an auto-planned tiled run, or None when
+    nothing should be recorded: the store is disabled, or the plan's knobs
+    were forced by the caller (``source == "explicit"`` — recording a
+    user's one-off override would poison the profile for every auto run
+    that follows). Records under ``plan.sig`` — the exact signature
+    ``plan_tiled``'s lookup consulted, so lookup and recording can never
+    drift apart."""
+    if getattr(plan, "source", "explicit") == "explicit":
+        return None
+    sig = getattr(plan, "sig", None)
+    if sig is None:
+        return None
+    store = store if store is not None else default_store()
+    if not store.enabled:
+        return None
+    return PlanFeedback(sig, plan, store)

@@ -1,0 +1,242 @@
+"""Persistent tiled-query plan store: JSON profiles keyed by a quantized
+problem signature.
+
+The tiled engine's launch knobs (tile, cmax, seeds) are STATIC jit
+arguments: a mis-guessed cmax costs a synchronous first-batch settling
+probe plus one fresh XLA compile per doubling round — and because the
+knowledge lived only in-process, every restart paid for the same guess
+again. This store is the process-boundary-crossing half of the auto-tune
+loop: a settled plan (from a previous run's feedback, or an explicit
+``kdtree-tpu tune`` sweep) is written as one small JSON profile under a
+cache dir, and the next run with the same problem *shape* starts from the
+settled configuration directly — no probe, no doubling rounds, no
+recompiles.
+
+**Signature quantization.** Profiles are keyed by
+:class:`PlanSignature`: (Q-bucket, D, n-bucket, k, bucket size,
+num-buckets, backend, device count), where Q and n are rounded UP to the
+next power of two. Quantizing keeps run-to-run jitter in the row counts
+(a 1.00M vs 1.05M ingest) from scattering profiles across hundreds of
+near-identical keys, while everything that changes the compiled program
+or the density model (D, k, bucket geometry, backend, shard count) keys
+exactly. The same quantization idea as ``_shard_n_real``'s occupancy
+rounding — track the shape, don't bust the cache on noise.
+
+**Trust model.** Profiles are advisory launch configurations, never
+correctness inputs: the tiled engine's overflow-retry contract still
+guards every batch, so a stale or even adversarially wrong profile can
+only cost speed. Corrupt files, unknown versions, and out-of-range values
+all read as a miss (:meth:`PlanStore.get` returns None) — the caller
+falls back to the static density heuristic exactly as if no profile
+existed.
+
+Layout: one ``plan-<signature>.json`` per signature under the cache dir
+(``KDTREE_TPU_PLAN_CACHE`` env var; default
+``$XDG_CACHE_HOME/kdtree_tpu/plans``; ``none``/``off``/``0``/empty
+disables the store entirely). Writes are atomic (tmp + ``os.replace``)
+and never raise into the run they observe — same contract as the
+telemetry exporters. See ``docs/TUNING.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import NamedTuple, Optional
+
+from kdtree_tpu import obs
+
+PROFILE_VERSION = 1
+
+ENV_CACHE_DIR = "KDTREE_TPU_PLAN_CACHE"
+_DISABLED_VALUES = ("", "0", "none", "off")
+
+# the launch knobs a profile must carry to be usable; everything else
+# (prune_rate, occupancy_p90, ...) is observability payload
+_REQUIRED_INT_FIELDS = ("tile", "cmax", "seeds")
+
+
+def _pow2_ceil(x: int) -> int:
+    """Smallest power of two >= x (1 for x <= 1)."""
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+class PlanSignature(NamedTuple):
+    """Quantized problem signature — the plan-store key."""
+
+    q_bucket: int
+    dim: int
+    n_bucket: int
+    k: int
+    bucket_size: int
+    num_buckets: int
+    backend: str
+    devices: int
+
+    @property
+    def key(self) -> str:
+        return (
+            f"q{self.q_bucket}-d{self.dim}-n{self.n_bucket}-k{self.k}"
+            f"-b{self.bucket_size}-nb{self.num_buckets}"
+            f"-{self.backend}-p{self.devices}"
+        )
+
+
+def make_signature(
+    Q: int, D: int, n: int, k: int, bucket_size: int, num_buckets: int,
+    devices: int = 1, backend: Optional[str] = None,
+) -> PlanSignature:
+    """Signature for one tiled-query problem shape. ``backend`` defaults to
+    the backend jax would actually run on (lazy import — signature
+    construction must stay cheap for jax-free callers that pass it
+    explicitly)."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return PlanSignature(
+        q_bucket=_pow2_ceil(Q),
+        dim=int(D),
+        n_bucket=_pow2_ceil(n),
+        k=int(k),
+        bucket_size=int(bucket_size),
+        num_buckets=int(num_buckets),
+        backend=str(backend),
+        devices=int(devices),
+    )
+
+
+# In-process read memo: {profile path: (mtime_ns, size, validated profile)}.
+# Steady-state serving consults the store on EVERY query call (lookup +
+# the recorder's read-modify-write); without a memo that is two file
+# reads + JSON parses per call forever. A stat() is enough to stay
+# coherent with other processes (any writer replaces the file, changing
+# mtime/size), so the steady state costs one stat instead of a parse.
+_read_memo: dict = {}
+
+
+def default_cache_dir() -> Optional[str]:
+    """Resolve the cache dir from the environment; None = store disabled."""
+    raw = os.environ.get(ENV_CACHE_DIR)
+    if raw is not None:
+        return None if raw.strip().lower() in _DISABLED_VALUES else raw
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "kdtree_tpu", "plans")
+
+
+class PlanStore:
+    """File-backed plan profiles; every operation is failure-tolerant (a
+    broken cache dir degrades to the heuristic path, never to an error)."""
+
+    def __init__(self, cache_dir: Optional[str] = None) -> None:
+        self.cache_dir = cache_dir if cache_dir is not None else default_cache_dir()
+
+    @property
+    def enabled(self) -> bool:
+        return self.cache_dir is not None
+
+    def path_for(self, sig: PlanSignature) -> str:
+        return os.path.join(self.cache_dir or "", f"plan-{sig.key}.json")
+
+    def get(self, sig: PlanSignature) -> Optional[dict]:
+        """The validated profile for ``sig``, or None on miss / corrupt
+        file / stale version / unusable launch knobs."""
+        if not self.enabled:
+            return None
+        path = self.path_for(sig)
+        try:
+            st = os.stat(path)
+        except OSError:
+            _read_memo.pop(path, None)
+            return None
+        memo = _read_memo.get(path)
+        if memo is not None and memo[0] == st.st_mtime_ns and \
+                memo[1] == st.st_size:
+            return memo[2]
+        try:
+            with open(path) as f:
+                prof = json.load(f)
+        except ValueError:
+            prof = None  # corrupt file: memoize the miss too, or a
+            # permanently broken profile re-pays the parse every call
+        except OSError:
+            return None  # transient read error: retry next call
+        else:
+            prof = self._validate(prof)
+        _read_memo[path] = (st.st_mtime_ns, st.st_size, prof)
+        return prof
+
+    @staticmethod
+    def _validate(prof) -> Optional[dict]:
+        if not isinstance(prof, dict):
+            return None
+        if prof.get("version") != PROFILE_VERSION:
+            return None  # stale format: treat as a miss, never guess
+        for field in _REQUIRED_INT_FIELDS:
+            v = prof.get(field)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                return None
+        return prof
+
+    def put(self, sig: PlanSignature, profile: dict) -> bool:
+        """Atomically write ``profile`` (version stamp + timestamp added).
+        Returns False (without raising) when the store is disabled or the
+        write fails — plan persistence must never fail the run."""
+        if not self.enabled:
+            return False
+        rec = dict(profile)
+        rec["version"] = PROFILE_VERSION
+        rec["signature"] = sig._asdict()
+        rec["updated_unix"] = time.time()
+        path = self.path_for(sig)
+        # pid AND thread id: concurrent same-shape queries from a threaded
+        # serving process must not interleave into one tmp file and
+        # os.replace a corrupt profile into place
+        import threading
+
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(rec, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+            st = os.stat(path)
+            _read_memo[path] = (st.st_mtime_ns, st.st_size,
+                                self._validate(rec))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        obs.get_registry().counter("kdtree_plan_cache_writes_total").inc()
+        return True
+
+    def record(self, sig: PlanSignature, **fields) -> bool:
+        """Merge ``fields`` into the profile for ``sig``, writing only when
+        something other than the timestamp actually changed — a steady-state
+        serving loop that re-observes the same settled plan on every query
+        call must not rewrite the file each time."""
+        if not self.enabled:
+            return False
+        existing = self.get(sig) or {}
+        base = {
+            k: v for k, v in existing.items()
+            if k not in ("version", "signature", "updated_unix")
+        }
+        merged = dict(base)
+        merged.update(fields)
+        if merged == base:
+            return False
+        return self.put(sig, merged)
+
+
+def default_store() -> PlanStore:
+    """A store bound to the current environment's cache dir. Constructed
+    per call (it holds only the resolved path) so env changes — tests,
+    operator overrides — take effect without process-global state."""
+    return PlanStore()

@@ -1,0 +1,115 @@
+"""Explicit (tile, cmax) sweep: measure candidates on a query sample and
+persist the winner — the operator-driven way to seed the plan store
+(``kdtree-tpu tune``), complementing the passive per-run feedback loop.
+
+The sweep is deliberately simple and honest: every candidate pair gets a
+warmup run (compile + cap settling excluded from timing, same discipline
+as bench.py) and one timed run synced by a host fetch; a candidate whose
+timed run still needed overflow-retry doubling is marked invalid (its cap
+does not hold for this geometry, so its time includes retry recompiles
+and its steady state would too). The winner is the fastest valid pair —
+persisted under the sample's signature, so serve-time ``plan_tiled``
+calls with the same shape start there directly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from kdtree_tpu import obs
+from kdtree_tpu.tuning.store import PlanStore, default_store, make_signature
+
+DEFAULT_TILES = (64, 128, 256, 512, 1024)
+DEFAULT_CMAXS = (32, 64, 128, 256)
+
+
+def sweep(
+    tree,
+    queries,
+    k: int,
+    tiles: Optional[Sequence[int]] = None,
+    cmaxs: Optional[Sequence[int]] = None,
+    store: Optional[PlanStore] = None,
+    log=None,
+) -> dict:
+    """Time each (tile, cmax) candidate on ``queries`` against ``tree``,
+    persist the winner, and return the full result table.
+
+    Returns ``{"results": [...], "winner": {...}, "persisted": bool,
+    "path": str | None}``; each result row carries tile, cmax, seconds,
+    qps, and the overflow-retry count its timed run incurred.
+    """
+    import jax
+
+    from kdtree_tpu.ops.tile_query import DEFAULT_SEEDS, morton_knn_tiled
+
+    use_pallas = jax.default_backend() == "tpu"
+    Q = queries.shape[0]
+    nbp = tree.num_buckets
+    tiles = [t for t in (tiles or DEFAULT_TILES) if t <= max(Q, 1)] or [
+        max(Q, 1)
+    ]
+    cmaxs = [c for c in (cmaxs or DEFAULT_CMAXS) if c <= nbp] or [nbp]
+    retc = obs.get_registry().counter("kdtree_tile_overflow_retries_total")
+
+    results = []
+    for tile in tiles:
+        for cmax in cmaxs:
+            d2, _ = morton_knn_tiled(tree, queries, k=k, tile=tile, cmax=cmax)
+            obs.hard_sync(d2)  # warmup: compile + first cap settle
+            r0 = retc.value
+            t0 = time.perf_counter()
+            d2, _ = morton_knn_tiled(tree, queries, k=k, tile=tile, cmax=cmax)
+            obs.hard_sync(d2)
+            dt = time.perf_counter() - t0
+            row = {
+                "tile": tile,
+                "cmax": cmax,
+                "seconds": dt,
+                "qps": Q / dt if dt > 0 else None,
+                "overflow_retries": int(retc.value - r0),
+            }
+            results.append(row)
+            if log is not None:
+                log(row)
+
+    valid = [r for r in results if r["overflow_retries"] == 0]
+    store = store if store is not None else default_store()
+    sig = make_signature(
+        Q, queries.shape[1], tree.n_real, k, tree.bucket_size, nbp,
+        devices=1,
+    )
+    if not valid:
+        # every candidate's cap overflowed. The retry COUNTER can't tell
+        # doubling rounds from per-batch straggler increments, so the true
+        # settled cap is unrecoverable here — persisting the raw candidate
+        # would hand warm runs a cap known to overflow, and an inflated
+        # guess would lock in oversized buffers (feedback never shrinks a
+        # cap). Persist nothing and tell the operator to widen the grid.
+        winner = min(results, key=lambda r: r["seconds"])
+        return {
+            "results": results,
+            "winner": winner,
+            "persisted": False,
+            "path": store.path_for(sig) if store.enabled else None,
+            "reason": "every candidate overflowed its cap; re-run with "
+                      "larger --cmax values",
+        }
+    winner = min(valid, key=lambda r: r["seconds"])
+    persisted = store.put(sig, {
+        "tile": int(winner["tile"]),
+        "cmax": int(winner["cmax"]),
+        "seeds": DEFAULT_SEEDS,
+        "use_pallas": use_pallas,
+        "source": "tune",
+        "tune_qps": winner["qps"],
+        "tune_seconds": winner["seconds"],
+        "overflow_retries": 0,
+    })
+    return {
+        "results": results,
+        "winner": winner,
+        "persisted": persisted,
+        "path": store.path_for(sig) if store.enabled else None,
+    }

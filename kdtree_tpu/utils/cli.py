@@ -628,39 +628,59 @@ def cmd_build(args) -> None:
         if args.engine == "global-morton":
             from kdtree_tpu.parallel import make_mesh
 
-            if "{i}" in args.points:
-                # PRE-SHARDED ingest: --points "dir/part-{i}.npy" maps file
-                # i -> device i verbatim, no redistribution (exactness only
-                # needs the shards to partition the point set — right for
-                # spatially-partitioned exports the sample-sort exchange
-                # would concentrate onto one destination)
+            import os
+
+            # PRE-SHARDED ingest intent: a {i} placeholder, or any other
+            # braces that do NOT name an existing file — so a malformed
+            # placeholder like {i:02d} is rejected crisply here instead of
+            # falling through to a confusing file-load error, while a real
+            # single file whose PATH happens to contain literal braces
+            # ("runs{v2}/points.npy") still loads through the plain branch
+            if "{i}" in args.points or (
+                ("{" in args.points or "}" in args.points)
+                and not os.path.exists(args.points)
+            ):
+                # maps file i -> device i verbatim, no redistribution
+                # (exactness only needs the shards to partition the point
+                # set — right for spatially-partitioned exports the
+                # sample-sort exchange would concentrate onto one
+                # destination)
                 import glob as globmod
-                import os
 
                 from kdtree_tpu.parallel.global_morton import (
                     build_global_morton_from_shard_files,
                 )
 
-                try:
-                    paths = []
-                    while os.path.exists(args.points.format(i=len(paths))):
-                        paths.append(args.points.format(i=len(paths)))
-                except (KeyError, IndexError, ValueError) as e:
-                    # braces other than {i} in the pattern — crisp, not a
-                    # format() traceback (C10)
-                    print(f"bad --points pattern {args.points}: {e} "
-                          "(only the {i} placeholder is substituted)",
+                # only the LITERAL {i} placeholder is substituted; a
+                # formatted variant like {i:02d} would format fine but the
+                # stray-file glob below only knows "{i}" — its pattern
+                # would keep the braces verbatim, match nothing, and the
+                # gap check would silently pass on a partial dataset
+                if "{" in args.points.replace("{i}", "") or \
+                        "}" in args.points.replace("{i}", ""):
+                    print(f"bad --points pattern {args.points}: only the "
+                          "literal {i} placeholder is supported (no format "
+                          "specs like {i:02d}, no other fields)",
                           file=sys.stderr)
                     sys.exit(1)
+                paths = []
+                while os.path.exists(args.points.format(i=len(paths))):
+                    paths.append(args.points.format(i=len(paths)))
                 if not paths:
                     print(f"no shard files match {args.points} (i=0...)",
                           file=sys.stderr)
                     sys.exit(1)
                 # a GAP in the sequence (part-3 deleted) would silently
                 # index a partial dataset: every file matching the pattern
-                # must be part of the contiguous 0..P-1 run
-                stray = (set(globmod.glob(args.points.replace("{i}", "*")))
-                         - set(paths))
+                # must be part of the contiguous 0..P-1 run. The literal
+                # parts are glob-escaped — a path with [, ?, or * in it
+                # must match itself, not act as a wildcard that matches
+                # nothing and waves the gap check through
+                glob_pat = "*".join(
+                    globmod.escape(part)
+                    for part in args.points.split("{i}")
+                )
+                stray = set(globmod.glob(glob_pat)) - set(paths)
                 if stray:
                     print(f"shard sequence has a gap: {len(paths)} "
                           f"contiguous file(s) from i=0, but also found "
@@ -844,6 +864,80 @@ def cmd_stats(args) -> None:
     sys.stdout.write(export.render_report(rep))
 
 
+def _parse_int_list(raw: str | None, what: str):
+    """Comma-separated positive ints for the tune sweep grids."""
+    if raw is None:
+        return None
+    try:
+        vals = [int(x) for x in raw.split(",") if x.strip()]
+    except ValueError:
+        print(f"--{what} must be a comma-separated int list, got {raw!r}",
+              file=sys.stderr)
+        sys.exit(1)
+    if not vals or any(v < 1 for v in vals):
+        print(f"--{what} values must be positive, got {raw!r}",
+              file=sys.stderr)
+        sys.exit(1)
+    return vals
+
+
+def cmd_tune(args) -> None:
+    """Sweep (tile, cmax) candidates for the tiled engine on a query
+    sample and persist the winner to the plan store — after this, every
+    run with the same problem signature (see docs/TUNING.md) starts at
+    the tuned configuration with no cap-settling probe or doubling-retry
+    recompiles."""
+    from kdtree_tpu import tuning
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+    from kdtree_tpu.ops.morton import build_morton
+    from kdtree_tpu.tuning import tuner
+
+    store = tuning.default_store()
+    if not store.enabled:
+        print("plan store is disabled (KDTREE_TPU_PLAN_CACHE is set to "
+              "none/off); nothing to persist a winner into", file=sys.stderr)
+        sys.exit(1)
+    if args.generator != "threefry":
+        # same idiom as the generative scale engines: tune's problem IS the
+        # threefry row stream — silently measuring a different point set
+        # than the flag suggests would misrepresent the persisted winner
+        print("note: tune defines its points by the threefry row stream; "
+              f"--generator {args.generator} does not apply",
+              file=sys.stderr)
+    tiles = _parse_int_list(args.tiles, "tiles")
+    cmaxs = _parse_int_list(args.cmax, "cmax")
+    pts = generate_points_rowwise(args.seed, args.dim, args.n)
+    # a distinct seed for the sample: tuning on the points themselves
+    # would overfit the plan to query==point geometry
+    queries = generate_queries(args.seed + 1, args.dim, args.q)
+    tree = build_morton(pts)
+
+    def log(row):
+        print(f"  tile={row['tile']:<5d} cmax={row['cmax']:<5d} "
+              f"{row['seconds']*1e3:9.1f} ms  "
+              f"{row['qps']:>10.0f} q/s  retries={row['overflow_retries']}",
+              file=sys.stderr)
+
+    print(f"sweeping tiled plans: n={args.n} dim={args.dim} q={args.q} "
+          f"k={args.k}", file=sys.stderr)
+    out = tuner.sweep(tree, queries, k=args.k, tiles=tiles, cmaxs=cmaxs,
+                      store=store, log=log)
+    if out["persisted"]:
+        print(f"persisted winner to {out['path']}", file=sys.stderr)
+    elif "reason" in out:
+        print(f"warning: nothing persisted — {out['reason']}",
+              file=sys.stderr)
+    else:
+        print("warning: winner could not be persisted (cache dir not "
+              "writable?)", file=sys.stderr)
+    print(json.dumps({
+        "winner": out["winner"],
+        "persisted": out["persisted"],
+        "path": out["path"],
+        "candidates": len(out["results"]),
+    }))
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="kdtree-tpu", description=__doc__)
     p.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -936,6 +1030,26 @@ def main(argv=None) -> None:
     st.add_argument("report", metavar="REPORT.json",
                     help="path a previous run's --metrics-out wrote")
     st.set_defaults(fn=cmd_stats)
+
+    tu = sub.add_parser(
+        "tune",
+        help="sweep (tile, cmax) candidates for the tiled engine and "
+             "persist the winner to the plan store (docs/TUNING.md)",
+    )
+    tu.add_argument("--seed", type=int, default=42)
+    tu.add_argument("--dim", type=int, default=3)
+    tu.add_argument("--n", type=int, default=1 << 20,
+                    help="point count of the seeded problem to tune on")
+    tu.add_argument("--q", type=int, default=16384,
+                    help="query-sample size — plans are keyed by the "
+                         "quantized Q bucket, so tune at the Q you serve")
+    tu.add_argument("--k", type=int, default=16)
+    tu.add_argument("--tiles", default=None, metavar="T1,T2,...",
+                    help="candidate tile sizes (default 64..1024 pow2)")
+    tu.add_argument("--cmax", default=None, metavar="C1,C2,...",
+                    help="candidate candidate-bucket caps (default "
+                         "32..256 pow2)")
+    tu.set_defaults(fn=cmd_tune)
 
     args = p.parse_args(argv)
     if args.platform:

@@ -59,10 +59,10 @@ def checked_build_morton(points: jax.Array, **kw):
     """
     from jax.experimental import checkify
 
-    from kdtree_tpu.ops.morton import build_morton_impl
+    from kdtree_tpu.ops.morton import build_morton_impl, default_bits
 
     n, d = points.shape
-    bits = kw.pop("bits", None) or max(1, min(32 // max(d, 1), 16))
+    bits = kw.pop("bits", None) or default_bits(d)
     bucket_cap = kw.pop("bucket_cap", 128)
     # padding +inf rows are deliberate; limit to NaN checks
     checked = checkify.checkify(

@@ -6,6 +6,7 @@ is exercised without a pod. Must run before the first ``import jax``.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -13,6 +14,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Isolate the persistent tiled-plan store (docs/TUNING.md): a fresh per-run
+# tmp dir, so tests never read profiles from the developer's real cache or
+# from a previous suite run — warm-vs-cold behavior inside one run is
+# still exercised (and pinned down) by tests/test_tuning.py.
+if "KDTREE_TPU_PLAN_CACHE" not in os.environ:
+    os.environ["KDTREE_TPU_PLAN_CACHE"] = tempfile.mkdtemp(
+        prefix="kdtree-tpu-plans-"
+    )
 
 import pytest
 

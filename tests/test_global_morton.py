@@ -322,7 +322,9 @@ def test_meshfree_dense_serving_uses_flat_view(monkeypatch):
     monkeypatch.setattr(morton_mod, "check_build_capacity", boom)
     d2s, _ = global_morton_query_tiled(forest2, qs, k=k, mesh=make_mesh(1))
     monkeypatch.undo()
-    assert getattr(forest2, "_dense_view", None) is None
+    # the over-budget outcome is CACHED (round-5 advisor fix): later dense
+    # batches must not re-materialize the flattened view just to fail again
+    assert getattr(forest2, "_dense_view", None) is morton_mod._BUDGET_EXCEEDED
     np.testing.assert_allclose(np.asarray(d2s), np.asarray(d2), rtol=1e-6)
 
 

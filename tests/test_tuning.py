@@ -1,0 +1,348 @@
+"""The closed auto-tune loop: plan store semantics, warm-vs-cold runs,
+the tune sweep, and the round-5 advisor regression fixes that rode along
+(pre-sharded glob escaping, int32 gid overflow, serving_view budget
+sentinel)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kdtree_tpu import build_morton, generate_problem, obs, tuning
+from kdtree_tpu.ops import bruteforce
+from kdtree_tpu.tuning.store import PROFILE_VERSION, PlanStore, make_signature
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    """A test-isolated plan store (and env, so engine-internal lookups see
+    the same one)."""
+    d = str(tmp_path / "plans")
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE", d)
+    return PlanStore(d)
+
+
+# ---------------------------------------------------------------------------
+# store semantics
+# ---------------------------------------------------------------------------
+
+
+def test_signature_quantization():
+    """Q and n round UP to pow2 buckets; everything else keys exactly."""
+    a = make_signature(1000, 3, 1 << 20, 16, 256, 4096, backend="cpu")
+    assert a.q_bucket == 1024 and a.n_bucket == 1 << 20
+    # same bucket -> same key (run-to-run row jitter must not scatter)
+    b = make_signature(513, 3, (1 << 20) - 5, 16, 256, 4096, backend="cpu")
+    assert a.key == b.key
+    # k, D, geometry, backend, devices all key exactly
+    assert make_signature(1000, 3, 1 << 20, 8, 256, 4096,
+                          backend="cpu").key != a.key
+    assert make_signature(1000, 2, 1 << 20, 16, 256, 4096,
+                          backend="cpu").key != a.key
+    assert make_signature(1000, 3, 1 << 20, 16, 256, 4096, devices=8,
+                          backend="cpu").key != a.key
+    assert make_signature(1000, 3, 1 << 20, 16, 256, 4096,
+                          backend="tpu").key != a.key
+
+
+def test_store_hit_vs_miss(store):
+    sig = make_signature(1024, 3, 4096, 4, 256, 16, backend="cpu")
+    assert store.get(sig) is None  # miss before any write
+    assert store.put(sig, {"tile": 64, "cmax": 32, "seeds": 8})
+    prof = store.get(sig)
+    assert prof["tile"] == 64 and prof["cmax"] == 32
+    other = make_signature(1024, 3, 4096, 9, 256, 16, backend="cpu")
+    assert store.get(other) is None
+
+
+def test_store_tolerates_corrupt_and_stale(store):
+    sig = make_signature(512, 2, 1024, 1, 128, 8, backend="cpu")
+    os.makedirs(store.cache_dir, exist_ok=True)
+    # corrupt bytes -> miss, no raise
+    with open(store.path_for(sig), "w") as f:
+        f.write("{not json")
+    assert store.get(sig) is None
+    # stale version -> miss (never guess at an old format)
+    with open(store.path_for(sig), "w") as f:
+        json.dump({"version": PROFILE_VERSION - 1, "tile": 64, "cmax": 32,
+                   "seeds": 8}, f)
+    assert store.get(sig) is None
+    # unusable knobs -> miss (a profile can only cost speed, never crash)
+    with open(store.path_for(sig), "w") as f:
+        json.dump({"version": PROFILE_VERSION, "tile": 0, "cmax": 32,
+                   "seeds": 8}, f)
+    assert store.get(sig) is None
+
+
+def test_store_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE", "none")
+    s = PlanStore()
+    assert not s.enabled
+    sig = make_signature(512, 3, 1024, 1, 128, 8, backend="cpu")
+    assert s.get(sig) is None and not s.put(sig, {"tile": 8})
+    assert tuning.lookup(sig) is None
+
+
+def test_record_suppresses_noop_rewrites(store):
+    sig = make_signature(256, 3, 512, 2, 128, 4, backend="cpu")
+    assert store.record(sig, tile=32, cmax=16, seeds=8)
+    first = os.stat(store.path_for(sig)).st_mtime_ns
+    assert not store.record(sig, tile=32, cmax=16, seeds=8)  # unchanged
+    assert os.stat(store.path_for(sig)).st_mtime_ns == first
+    assert store.record(sig, cmax=32)  # a real change writes
+    assert store.get(sig)["cmax"] == 32 and store.get(sig)["tile"] == 32
+
+
+# ---------------------------------------------------------------------------
+# the closed loop: cold run records, warm run skips settling
+# ---------------------------------------------------------------------------
+
+
+def test_warm_run_zero_retries_identical_results(store, monkeypatch):
+    """The acceptance shape in miniature: a cold run that had to settle its
+    cap through doubling retries records the settled plan; the warm run
+    starts there — ZERO overflow retries, bit-identical (d2, ids)."""
+    import kdtree_tpu.ops.tile_query as tqm
+
+    pts, _ = generate_problem(seed=3, dim=3, num_points=20000, num_queries=1)
+    qs, _ = generate_problem(seed=31, dim=3, num_points=1500, num_queries=1)
+    tree = build_morton(pts)
+    # force the heuristic to undersize the cap so the cold run MUST retry
+    monkeypatch.setattr(tqm, "_auto_tile",
+                        lambda *a, **kw: (64, 2))
+    reg = obs.get_registry()
+    retc = reg.counter("kdtree_tile_overflow_retries_total")
+    hits = reg.counter("kdtree_plan_cache_hits_total")
+
+    r0 = retc.value
+    d2c, gic = tqm.morton_knn_tiled(tree, qs, k=8)
+    cold_retries = retc.value - r0
+    assert cold_retries > 0, "setup failed: cold run never overflowed"
+    prof = store.get(make_signature(1500, 3, 20000, 8, tree.bucket_size,
+                                    tree.num_buckets))
+    assert prof is not None and prof["cmax"] > 2  # settled cap recorded
+
+    h0, r1 = hits.value, retc.value
+    d2w, giw = tqm.morton_knn_tiled(tree, qs, k=8)
+    assert hits.value > h0, "warm run missed the plan store"
+    assert retc.value - r1 == 0, "warm run still paid overflow retries"
+    np.testing.assert_array_equal(np.asarray(d2c), np.asarray(d2w))
+    np.testing.assert_array_equal(np.asarray(gic), np.asarray(giw))
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=8)
+    np.testing.assert_allclose(np.asarray(d2w), np.asarray(bf), rtol=1e-5)
+
+
+def test_warm_plan_survives_stale_cap(store):
+    """A stale/adversarial profile is advisory only: the overflow-retry
+    contract still produces exact results (profiles can cost speed,
+    never correctness)."""
+    pts, _ = generate_problem(seed=5, dim=2, num_points=8000, num_queries=1)
+    qs, _ = generate_problem(seed=51, dim=2, num_points=600, num_queries=1)
+    tree = build_morton(pts)
+    sig = make_signature(600, 2, 8000, 6, tree.bucket_size,
+                         tree.num_buckets)
+    # plant a deliberately undersized cap; tile 16 is valid but tiny
+    assert store.put(sig, {"tile": 16, "cmax": 1, "seeds": 4})
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    d2, _ = morton_knn_tiled(tree, qs, k=6)
+    bf, _ = bruteforce.knn_exact_d2(pts, qs, k=6)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(bf), rtol=1e-5)
+    # and the loop closed: the settled (bigger) cap replaced the stale one
+    assert store.get(sig)["cmax"] > 1
+
+
+def test_explicit_knobs_never_recorded(store):
+    """A caller-forced (tile, cmax) is a one-off override, not knowledge —
+    it must not poison the profile consulted by auto-planned runs."""
+    pts, _ = generate_problem(seed=7, dim=3, num_points=4000, num_queries=1)
+    qs, _ = generate_problem(seed=71, dim=3, num_points=512, num_queries=1)
+    tree = build_morton(pts)
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    morton_knn_tiled(tree, qs, k=3, tile=8, cmax=4)
+    # a cmax HINT with tile unset is still an override: recording its
+    # settled cap would lock the hint into every future auto run
+    morton_knn_tiled(tree, qs, k=3, cmax=4)
+    assert store.get(
+        make_signature(512, 3, 4000, 3, tree.bucket_size, tree.num_buckets)
+    ) is None
+    assert not os.path.isdir(store.cache_dir) or not os.listdir(
+        store.cache_dir)
+
+
+def test_feedback_records_prune_rate_when_metrics_enabled(store):
+    """The telemetry-priced enrichment rides the obs.defer flush: after a
+    metrics-enabled run + flush, the profile carries the observed prune
+    rate (the feedback signal slack selection used to guess at)."""
+    obs.set_enabled(True)
+    try:
+        pts, _ = generate_problem(seed=9, dim=3, num_points=20000,
+                                  num_queries=1)
+        qs, _ = generate_problem(seed=91, dim=3, num_points=1024,
+                                 num_queries=1)
+        tree = build_morton(pts)
+        from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+        morton_knn_tiled(tree, qs, k=4)
+        obs.flush()
+    finally:
+        obs.set_enabled(None)
+    prof = store.get(make_signature(1024, 3, 20000, 4, tree.bucket_size,
+                                    tree.num_buckets))
+    assert prof is not None
+    assert 0.0 < prof.get("prune_rate", -1.0) <= 1.0
+
+
+def test_tuner_sweep_persists_winner(store):
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.tuning import tuner
+
+    pts, _ = generate_problem(seed=11, dim=3, num_points=8000, num_queries=1)
+    qs = generate_queries(13, 3, 1024)
+    tree = build_morton(pts)
+    # nbp=32 here: caps must stay <= nbp, and cmax=32 (= nbp) can never
+    # overflow so the sweep always has at least one valid candidate
+    out = tuner.sweep(tree, qs, k=4, tiles=(64, 256), cmaxs=(16, 32),
+                      store=store)
+    assert len(out["results"]) == 4
+    assert out["persisted"] and os.path.exists(out["path"])
+    prof = store.get(make_signature(1024, 3, 8000, 4, tree.bucket_size,
+                                    tree.num_buckets))
+    assert prof["source"] == "tune"
+    assert prof["tile"] == out["winner"]["tile"]
+    # a tuned plan is consulted by the auto planner
+    from kdtree_tpu.ops.tile_query import plan_tiled
+
+    plan = plan_tiled(1024, 3, 8000, tree.num_buckets, tree.bucket_size, 4)
+    assert plan.source == "warm" and plan.tile == out["winner"]["tile"]
+
+
+def test_tuner_all_overflow_persists_nothing(store):
+    """When EVERY sweep candidate overflows its cap, the true settled cap
+    is unrecoverable from the retry counter — persisting anything would
+    either hand warm runs an overflowing cap or lock in an inflated one.
+    The sweep must refuse and say why."""
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.tuning import tuner
+
+    pts, _ = generate_problem(seed=17, dim=3, num_points=8000, num_queries=1)
+    qs = generate_queries(19, 3, 512)
+    tree = build_morton(pts)
+    out = tuner.sweep(tree, qs, k=8, tiles=(32,), cmaxs=(1,), store=store)
+    assert out["results"][0]["overflow_retries"] > 0  # setup really overflowed
+    assert not out["persisted"] and "overflow" in out["reason"]
+    assert store.get(make_signature(512, 3, 8000, 8, tree.bucket_size,
+                                    tree.num_buckets)) is None
+
+
+def test_drive_batches_warm_skips_settle_probe():
+    """settle_first=False (a warm plan) dispatches every batch exactly once
+    when the cap holds — no synchronous first-batch probe round."""
+    from kdtree_tpu.ops.tile_query import drive_batches
+
+    calls = []
+
+    def run_batch(b0, cap):
+        calls.append((b0, cap))
+        return (
+            jnp.zeros((2, 1)),
+            jnp.zeros((2, 1), jnp.int32),
+            jnp.asarray(False),
+        )
+
+    drive_batches(run_batch, [0, 2, 4], cmax=8, nbp=64, settle_first=False)
+    assert calls == [(0, 8), (2, 8), (4, 8)], calls
+
+
+# ---------------------------------------------------------------------------
+# round-5 advisor regressions
+# ---------------------------------------------------------------------------
+
+
+def test_build_rejects_nonliteral_shard_placeholder(tmp_path, capsys):
+    """{i:02d}-style placeholders format fine but the stray-file glob only
+    substitutes the literal {i} — the gap check would silently match
+    nothing. The CLI must refuse them crisply."""
+    from kdtree_tpu.utils.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--engine", "global-morton", "build",
+              "--points", str(tmp_path / "part-{i:02d}.npy"),
+              "--out", str(tmp_path / "t.npz")])
+    assert exc.value.code == 1
+    assert "placeholder" in capsys.readouterr().err
+
+
+def test_build_shard_gap_detected_with_glob_metachars(tmp_path, capsys):
+    """Literal [ ] in the shard paths must be escaped in the gap-check
+    glob: pre-fix, the char class matched nothing and a deleted middle
+    shard slipped through as a silently partial index."""
+    from kdtree_tpu.utils.cli import main
+
+    d = tmp_path / "runs[v2]"
+    d.mkdir()
+    for i in (0, 1, 3):  # shard 2 missing: a gap
+        np.save(d / f"part-{i}.npy",
+                np.random.default_rng(i).random((32, 3)).astype(np.float32))
+    with pytest.raises(SystemExit) as exc:
+        main(["--engine", "global-morton", "build",
+              "--points", str(d / "part-{i}.npy"),
+              "--out", str(tmp_path / "t.npz")])
+    assert exc.value.code == 1
+    assert "gap" in capsys.readouterr().err
+
+
+def test_build_single_file_with_literal_braces_loads(tmp_path):
+    """A real file whose PATH contains literal braces must still load as a
+    plain single-file ingest — only brace patterns that do NOT name an
+    existing file are treated as (and validated as) shard placeholders."""
+    from kdtree_tpu.utils.cli import main
+
+    f = tmp_path / "runs{v2}.npy"
+    np.save(f, np.random.default_rng(0).random((600, 3)).astype(np.float32))
+    out = tmp_path / "t.npz"
+    main(["--engine", "global-morton", "build", "--points", str(f),
+          "--out", str(out)])
+    assert out.exists()
+
+
+def test_ingest_rejects_int32_row_overflow():
+    """n >= 2**31 would wrap int32 gids negative and silently drop those
+    rows as padding — must be a crisp ValueError at the door."""
+    from kdtree_tpu.parallel.global_morton import (
+        _check_rows_fit_i32, build_global_morton_from_points,
+    )
+
+    with pytest.raises(ValueError, match="int32"):
+        _check_rows_fit_i32(1 << 31, "points array")
+    _check_rows_fit_i32((1 << 31) - 1, "points array")  # max n passes
+
+    class FakeBigPoints:
+        shape = (1 << 31, 3)
+
+    with pytest.raises(ValueError, match="int32"):
+        build_global_morton_from_points(FakeBigPoints())
+
+
+def test_serving_view_caches_budget_exceeded():
+    """After the first BuildCapacityError the over-budget outcome is
+    cached: later dense batches fall back WITHOUT re-running make_inputs
+    (whose flattened bucket-points copy is the expensive part)."""
+    from kdtree_tpu.ops.morton import BuildCapacityError, serving_view
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    calls = []
+
+    def make_inputs():
+        calls.append(1)
+        raise BuildCapacityError("over budget")
+
+    assert serving_view(owner, make_inputs, cache_attr="_v") is None
+    assert serving_view(owner, make_inputs, cache_attr="_v") is None
+    assert len(calls) == 1, "make_inputs re-ran after a budget failure"
