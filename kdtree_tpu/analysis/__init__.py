@@ -1,0 +1,41 @@
+"""kdtree_tpu.analysis — the project-invariant linter (``kdtree-tpu lint``).
+
+Compilers check what the language promises; this package checks what THIS
+project promises. Every rule is the mechanized form of a bug we actually
+shipped (or caught in review) and never want to re-litigate — the int32
+gid wrap, the device sync slipped into an async dispatch loop, the
+outer-jit-around-shard_map legacy miscompile. See
+``docs/STATIC_ANALYSIS.md`` for the catalog, the originating bug behind
+each rule, and the suppression/baseline workflow.
+
+The analysis code is deliberately stdlib-only (``ast`` + ``tokenize`` —
+no jax API anywhere on the lint path), so linting costs a parse, not a
+backend init. Caveat: importing it as ``kdtree_tpu.analysis`` still runs
+the ``kdtree_tpu`` package ``__init__`` (which imports jax), so the
+environment needs jax *installed* even though the linter never uses it.
+
+Pieces:
+
+- :mod:`~kdtree_tpu.analysis.registry` — rule metadata + the
+  :class:`Finding` record and checker registration;
+- :mod:`~kdtree_tpu.analysis.checkers` — the rule implementations;
+- :mod:`~kdtree_tpu.analysis.walker` — file collection, suppression
+  comments, per-file checker driving;
+- :mod:`~kdtree_tpu.analysis.baseline` — the committed
+  grandfather file (CI fails only on findings NOT in it);
+- :mod:`~kdtree_tpu.analysis.reporting` — human and JSON output.
+"""
+
+from __future__ import annotations
+
+from kdtree_tpu.analysis.registry import Finding, Rule, all_rules
+from kdtree_tpu.analysis.walker import LintResult, lint_file, run_lint
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "lint_file",
+    "run_lint",
+]

@@ -1,0 +1,93 @@
+"""The committed grandfather file: CI fails only on NEW findings.
+
+Turning a linter on over a living codebase is an adoption problem:
+demanding a zero-finding repo on day one means the linter never lands.
+The baseline records today's known findings (by line-number-free
+fingerprint — rule, file, scope, offending line text), so the gate is
+"no NEW violations" from the first commit, while the grandfathered debt
+stays visible and burns down monotonically (``--update-baseline`` after
+fixing some).
+
+Multiplicity matters: two identical syncs in one function are two
+findings, so fingerprints are counted, not set-membership-tested — fixing
+one of two and adding another elsewhere in the same shape still fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, Iterable, List
+
+from kdtree_tpu.analysis.registry import Finding
+
+FORMAT_VERSION = 1
+
+
+def load(path: str) -> Counter:
+    """Fingerprint -> allowed count. A missing file is an empty baseline
+    (the common steady state: everything fixed or suppressed inline)."""
+    if not path or not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(
+            f"{path} is not a kdt-lint baseline (missing 'findings')"
+        )
+    out: Counter = Counter()
+    for entry in data["findings"]:
+        fp = "|".join((
+            entry["rule"], entry["path"], entry.get("scope", "<module>"),
+            entry.get("line_text", ""),
+        ))
+        out[fp] += int(entry.get("count", 1))
+    return out
+
+
+def save(path: str, findings: Iterable[Finding]) -> int:
+    """Write the current findings as the new baseline; returns the entry
+    count. Entries keep human-readable fields so a reviewer can audit the
+    debt without running the linter."""
+    grouped: Dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in grouped:
+            grouped[fp]["count"] += 1
+        else:
+            grouped[fp] = {
+                "rule": f.rule,
+                "name": f.name,
+                "path": f.path,
+                "scope": f.scope,
+                "line_text": f.line_text,
+                "count": 1,
+            }
+    entries = sorted(
+        grouped.values(), key=lambda e: (e["path"], e["rule"], e["line_text"])
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"version": FORMAT_VERSION, "findings": entries}, f, indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+    return len(entries)
+
+
+def partition(
+    findings: Iterable[Finding], baseline: Counter
+) -> List[Finding]:
+    """Mark baselined findings in place; return the NEW (unbaselined)
+    ones. Consumes baseline counts first-come within a fingerprint."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            f.baselined = True
+        else:
+            new.append(f)
+    return new

@@ -1,0 +1,742 @@
+"""The rule implementations.
+
+Each checker is grounded in a bug this project actually had (the
+``origin`` field; docs/STATIC_ANALYSIS.md renders the full stories).
+They are deliberately SYNTACTIC: a linter that needs whole-program type
+inference to fire is a linter nobody trusts or runs. Where a rule needs
+dataflow (sync-in-hot-path), it uses a small, explicit, forward-only
+taint pass whose seeds are named in this file — predictable false
+negatives over unpredictable false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from kdtree_tpu.analysis.registry import (
+    CORRECTNESS,
+    HYGIENE,
+    PERFORMANCE,
+    Finding,
+    Rule,
+    checker,
+    register,
+)
+
+# --------------------------------------------------------------------------
+# rule metadata
+# --------------------------------------------------------------------------
+
+R_I32_GUARD = register(Rule(
+    "KDT101", "missing-i32-guard", CORRECTNESS,
+    "a function materializing a row-id (gid) array must call "
+    "check_rows_fit_i32 on the row count",
+    "int32 gid wrap found at 3 forest-build sites (PR 2): n >= 2**31 rows "
+    "wrap gids negative and every downstream mask silently treats them as "
+    "padding — data loss, not an error",
+))
+
+R_JIT_SHARD_MAP = register(Rule(
+    "KDT102", "jit-over-shard_map", CORRECTNESS,
+    "jax.jit wrapping a shard_map-calling function must be gated on the "
+    "_FUSED_JIT_SAFE predicate (or carry a reasoned suppression)",
+    "legacy-jax (0.4.x experimental shard_map) miscompiles an outer jit "
+    "around the fused ensemble build+query shard_map — wrong per-shard "
+    "answers, verified vs oracle; parallel/ensemble.py sidesteps it with "
+    "_FUSED_JIT_SAFE",
+))
+
+R_LISTENER = register(Rule(
+    "KDT103", "unsafe-listener", CORRECTNESS,
+    "jax.monitoring listener bodies must be exception-contained "
+    "(entire body inside try/except, no raise in the handler)",
+    "a listener exception propagates INTO the jax caller that emitted the "
+    "event; PR 1's compile_time_saved_sec crash (signed delta fed to a "
+    "monotone counter) surfaced exactly there",
+))
+
+R_NONDET = register(Rule(
+    "KDT104", "nondeterminism", CORRECTNESS,
+    "no unseeded np.random / stdlib random, no time-derived seeds, "
+    "anywhere in the engine",
+    "every engine answers the same seeded problem (threefry row stream / "
+    "mt19937 replay); one unseeded draw silently breaks the "
+    "engines-agree-bit-for-bit contract the oracle tests stand on",
+))
+
+R_SYNC = register(Rule(
+    "KDT201", "sync-in-hot-path", PERFORMANCE,
+    "no device->host syncs (np.asarray / .item() / block_until_ready / "
+    "int()/float()/bool() of device values) inside ops/, parallel/, "
+    "pallas/ functions unless inside an obs.defer callback",
+    "a per-batch bool(overflow) fetch serialized the async dispatch loop "
+    "~8x at the 10M-query north-star shape (PR 1); obs.defer exists "
+    "precisely so metrics fetches leave the hot path",
+))
+
+R_DUP_BITS = register(Rule(
+    "KDT301", "dup-morton-bits-rule", HYGIENE,
+    "do not re-derive the Morton quantization-bit rule (32 // ... "
+    "patterns) outside ops.morton.default_bits",
+    "the bits rule was copy-pasted across 7 files before PR 2 deduped it "
+    "into ops.morton.default_bits; a tree built with one rule and queried "
+    "through a planner using another mismatches silently",
+))
+
+R_SUPPRESS = register(Rule(
+    "KDT302", "bad-suppression", HYGIENE,
+    "a kdt-lint suppression must name a reason and known rule ids",
+    "an unreasoned suppression is a finding with the evidence deleted; "
+    "reviewers can't tell a justified sync from a silenced bug",
+))
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jnp.stack' for Attribute chains, 'shard_map' for Names, '' else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)
+
+
+def iter_funcs(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    """Every (sync or async) function def, any nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def func_qualname(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Dotted enclosing-function path for a node ('outer.inner'), or
+    '<module>'."""
+    names: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.append(cur.name)
+        elif isinstance(cur, ast.ClassDef):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def _is_const_expr(node: ast.AST) -> bool:
+    """Literal-only expression (safe for int()/float()/np.asarray())."""
+    return all(
+        isinstance(
+            sub,
+            (ast.Constant, ast.BinOp, ast.UnaryOp, ast.Tuple, ast.List,
+             ast.operator, ast.unaryop, ast.Load),
+        )
+        for sub in ast.walk(node)
+    )
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _contains_name(node: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(sub, ast.Name) and sub.id == name for sub in ast.walk(node)
+    )
+
+
+def _mk(rule: Rule, ctx, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    return Finding(
+        rule=rule.id,
+        name=rule.name,
+        path=ctx.relpath,
+        line=line,
+        col=getattr(node, "col_offset", 0),
+        scope=func_qualname(node, ctx.parents),
+        message=message,
+        line_text=" ".join(ctx.line(line).split()),
+    )
+
+
+# --------------------------------------------------------------------------
+# KDT101 — missing-i32-guard
+# --------------------------------------------------------------------------
+
+_GUARD_SUFFIX = "check_rows_fit_i32"
+
+
+def _creates_gid_arange(stmt: ast.stmt) -> Optional[ast.Assign]:
+    """``gid = ...arange(...)...`` with a single gid-named Name target."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None
+    tgt = stmt.targets[0]
+    if not (isinstance(tgt, ast.Name) and "gid" in tgt.id.lower()):
+        return None
+    for sub in ast.walk(stmt.value):
+        if isinstance(sub, ast.Call) and call_name(sub).split(".")[-1] == "arange":
+            return stmt
+    return None
+
+
+@checker(R_I32_GUARD)
+def check_i32_guard(ctx) -> Iterator[Finding]:
+    # one pass over the ASSIGNMENTS (not per-function — a creation site
+    # inside a nested def must yield exactly one finding), checking every
+    # ENCLOSING function for a guard: a guard in the outer scope covers
+    # gid creation in a closure it wraps
+    guard_memo: Dict[ast.AST, bool] = {}
+
+    def has_guard(func: ast.AST) -> bool:
+        if func not in guard_memo:
+            guard_memo[func] = any(
+                isinstance(n, ast.Call)
+                and call_name(n).split(".")[-1].endswith(_GUARD_SUFFIX)
+                for n in ast.walk(func)
+            )
+        return guard_memo[func]
+
+    for stmt in ast.walk(ctx.tree):
+        if not isinstance(stmt, ast.Assign) or not _creates_gid_arange(stmt):
+            continue
+        innermost = None
+        guarded = False
+        cur = ctx.parents.get(stmt)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                innermost = innermost or cur
+                if has_guard(cur):
+                    guarded = True
+                    break
+            cur = ctx.parents.get(cur)
+        if innermost is None or guarded:
+            continue  # module-level constants / guarded scope
+        yield _mk(
+            R_I32_GUARD, ctx, stmt,
+            f"'{innermost.name}' materializes a gid array via arange but "
+            "never calls check_rows_fit_i32 on the row count; "
+            "n >= 2**31 would wrap ids negative (silent data loss)",
+        )
+
+
+# --------------------------------------------------------------------------
+# KDT102 — jit-over-shard_map
+# --------------------------------------------------------------------------
+
+
+def _calls_shard_map(func: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and call_name(n).split(".")[-1] == "shard_map"
+        for n in ast.walk(func)
+    )
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit, or functools.partial(jax.jit, ...)."""
+    if dotted_name(node).split(".")[-1] == "jit":
+        return True
+    if isinstance(node, ast.Call) and call_name(node).endswith("partial"):
+        return bool(node.args) and dotted_name(node.args[0]).endswith("jit")
+    return False
+
+
+@checker(R_JIT_SHARD_MAP)
+def check_jit_over_shard_map(ctx) -> Iterator[Finding]:
+    shard_funcs = {
+        f.name for f in iter_funcs(ctx.tree) if _calls_shard_map(f)
+    }
+
+    # decorator form: @jax.jit / @functools.partial(jax.jit, ...) on a
+    # function whose body calls shard_map — nothing can gate a decorator,
+    # so the only clean outcomes are un-jitting or a reasoned suppression
+    for func in iter_funcs(ctx.tree):
+        if func.name not in shard_funcs:
+            continue
+        for dec in func.decorator_list:
+            if _is_jit_expr(dec):
+                yield _mk(
+                    R_JIT_SHARD_MAP, ctx, dec,
+                    f"'{func.name}' calls shard_map and is jit-decorated; "
+                    "legacy jax miscompiles outer-jit-around-shard_map — "
+                    "gate call sites on _FUSED_JIT_SAFE or suppress with "
+                    "the evidence it is safe",
+                )
+
+    # assignment form: X = jax.jit(F) where F calls shard_map; every later
+    # use of X must sit in a statement that consults _FUSED_JIT_SAFE
+    jitted: Dict[str, ast.Assign] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        val = node.value
+        if (
+            isinstance(val, ast.Call)
+            and _is_jit_expr(val.func)
+            and any(
+                isinstance(a, ast.Name) and a.id in shard_funcs
+                for a in val.args
+            )
+        ):
+            jitted[tgt.id] = node
+    if not jitted:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Name) and node.id in jitted):
+            continue
+        if isinstance(node.ctx, ast.Store):
+            continue
+        stmt = ctx.enclosing_stmt(node)
+        if stmt is not None and _contains_name(stmt, "_FUSED_JIT_SAFE"):
+            continue
+        yield _mk(
+            R_JIT_SHARD_MAP, ctx, node,
+            f"'{node.id}' jit-wraps a shard_map program; this use is not "
+            "gated on _FUSED_JIT_SAFE (legacy-jax outer-jit miscompile)",
+        )
+
+
+# --------------------------------------------------------------------------
+# KDT103 — unsafe-listener
+# --------------------------------------------------------------------------
+
+
+def _exception_contained(func: ast.FunctionDef) -> bool:
+    body = list(func.body)
+    if (
+        body
+        and isinstance(body[0], ast.Expr)
+        and isinstance(body[0].value, ast.Constant)
+        and isinstance(body[0].value.value, str)
+    ):
+        body = body[1:]  # docstring
+    if len(body) != 1 or not isinstance(body[0], ast.Try):
+        return False
+    try_stmt = body[0]
+    for handler in try_stmt.handlers:
+        caught = handler.type
+        broad = caught is None or dotted_name(caught).split(".")[-1] in (
+            "Exception", "BaseException",
+        )
+        if broad:
+            return not any(
+                isinstance(n, ast.Raise) for n in ast.walk(handler)
+            )
+    return False
+
+
+@checker(R_LISTENER)
+def check_listener_safety(ctx) -> Iterator[Finding]:
+    defs = {f.name: f for f in iter_funcs(ctx.tree)}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if "register_event" not in call_name(node).split(".")[-1]:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                yield _mk(
+                    R_LISTENER, ctx, arg,
+                    "lambda registered as a jax.monitoring listener cannot "
+                    "contain exceptions; use a def whose whole body is "
+                    "try/except",
+                )
+                continue
+            fname = dotted_name(arg).split(".")[-1]
+            func = defs.get(fname)
+            if func is not None and not _exception_contained(func):
+                yield _mk(
+                    R_LISTENER, ctx, func,
+                    f"listener '{func.name}' is not exception-contained: "
+                    "its entire body must be one try/except (broad catch, "
+                    "no raise) — a listener exception propagates into the "
+                    "jax caller that emitted the event",
+                )
+
+
+# --------------------------------------------------------------------------
+# KDT104 — nondeterminism
+# --------------------------------------------------------------------------
+
+_NP_GLOBAL_RNG_FNS = {
+    "seed", "rand", "randn", "randint", "random", "uniform", "normal",
+    "choice", "shuffle", "permutation", "standard_normal", "random_sample",
+}
+_STDLIB_RANDOM_FNS = {
+    "random", "randint", "uniform", "shuffle", "choice", "randrange",
+    "sample", "gauss", "seed",
+}
+_TIME_FNS = {"time.time", "time.time_ns", "time.monotonic"}
+
+
+def _time_derived(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Call) and call_name(sub) in _TIME_FNS
+        for sub in ast.walk(node)
+    )
+
+
+@checker(R_NONDET)
+def check_nondeterminism(ctx) -> Iterator[Finding]:
+    np_aliases = _numpy_aliases(ctx.tree)
+    stdlib_random = {
+        a.asname or "random"
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.Import)
+        for a in node.names
+        if a.name == "random"
+    }
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            parts = name.split(".")
+            if (
+                len(parts) == 3
+                and parts[0] in np_aliases
+                and parts[1] == "random"
+                and parts[2] in _NP_GLOBAL_RNG_FNS
+            ):
+                yield _mk(
+                    R_NONDET, ctx, node,
+                    f"{name}() draws from numpy's process-global RNG; use "
+                    "a seeded Generator (or the threefry row stream) so "
+                    "every engine answers the same problem",
+                )
+            elif parts[-1] in ("default_rng", "RandomState") and (
+                parts[0] in np_aliases or name in ("default_rng", "RandomState")
+            ):
+                if not node.args and not node.keywords:
+                    yield _mk(
+                        R_NONDET, ctx, node,
+                        f"{name}() without a seed is entropy-seeded — "
+                        "results change run to run",
+                    )
+                elif any(_time_derived(a) for a in node.args):
+                    yield _mk(
+                        R_NONDET, ctx, node,
+                        f"{name}(<time-derived>) is a wall-clock seed — "
+                        "results change run to run",
+                    )
+            elif (
+                len(parts) == 2
+                and parts[0] in stdlib_random
+                and parts[1] in _STDLIB_RANDOM_FNS
+            ):
+                yield _mk(
+                    R_NONDET, ctx, node,
+                    f"stdlib {name}() uses the process-global RNG",
+                )
+        elif isinstance(node, ast.Assign):
+            if (
+                any(
+                    isinstance(t, ast.Name) and "seed" in t.id.lower()
+                    for t in node.targets
+                )
+                and _time_derived(node.value)
+            ):
+                yield _mk(
+                    R_NONDET, ctx, node,
+                    "time-derived seed: the run cannot be replayed",
+                )
+        elif isinstance(node, ast.keyword):
+            if node.arg and "seed" in node.arg.lower() and _time_derived(node.value):
+                yield _mk(
+                    R_NONDET, ctx, node.value,
+                    "time-derived seed argument: the run cannot be replayed",
+                )
+
+
+# --------------------------------------------------------------------------
+# KDT201 — sync-in-hot-path
+# --------------------------------------------------------------------------
+
+_HOT_DIRS = ("ops", "parallel", "pallas")
+# jax.* calls that return host/callable objects, not device values
+_JAX_HOST_CALLS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.default_backend",
+    "jax.devices", "jax.local_devices", "jax.device_count",
+}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_CAST_BUILTINS = {"bool", "int", "float"}
+
+
+def _in_hot_dir(relpath: str) -> bool:
+    parts = relpath.split("/")
+    if "kdtree_tpu" in parts:
+        parts = parts[parts.index("kdtree_tpu") + 1:]
+    return bool(parts) and parts[0] in _HOT_DIRS
+
+
+class _Taint:
+    """Forward-only, per-scope device-value taint.
+
+    Seeds: calls into jnp.* / lax.* / most jax.*; calls of names bound to
+    shard_map(...)/jax.jit(...) results or imported with a ``_jit``
+    suffix (the project convention for jitted programs); calls of
+    Callable-annotated parameters (e.g. ``run_batch`` in
+    ``drive_batches``). Propagates through assignment, tuple unpack,
+    subscripts, for-targets, and comprehensions. No fixpoint — one pass
+    in statement order, which matches how this codebase is written.
+    """
+
+    def __init__(self, device_callables: Set[str], parent: "_Taint" = None):
+        self.tainted: Set[str] = set(parent.tainted) if parent else set()
+        self.device_callables: Set[str] = set(device_callables)
+        # parameters of the enclosing function: unknown provenance — a
+        # np.asarray() of one is assumed to fetch (callers pass device
+        # arrays through these APIs), while np.asarray() of a host-built
+        # local (a Python list of ints) is not
+        self.params: Set[str] = set(parent.params) if parent else set()
+        if parent:
+            self.device_callables |= parent.device_callables
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+            if isinstance(sub, ast.Call):
+                name = call_name(sub)
+                root = name.split(".")[0]
+                leaf = name.split(".")[-1]
+                if root in ("jnp", "lax") and len(name.split(".")) > 1:
+                    return True
+                if root == "jax" and name not in _JAX_HOST_CALLS:
+                    return True
+                if leaf.endswith("_jit") or name in self.device_callables:
+                    return True
+        return False
+
+    def bind(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                self.tainted.add(sub.id)
+
+    def feed(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            if isinstance(stmt.value, ast.Call) and _mints_device_callable(
+                stmt.value
+            ):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.device_callables.add(t.id)
+                return
+            if self.expr_tainted(stmt.value):
+                for t in stmt.targets:
+                    self.bind(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None and self.expr_tainted(stmt.value):
+                self.bind(stmt.target)
+        elif isinstance(stmt, ast.For):
+            if self.expr_tainted(stmt.iter):
+                self.bind(stmt.target)
+
+
+def _mints_device_callable(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name.split(".")[-1] == "shard_map" or name in ("jax.jit", "jit"):
+        return True
+    # functools.partial(jax.jit, ...) — the partial IS the jit
+    if name.endswith("partial") and call.args:
+        return dotted_name(call.args[0]).endswith("jit")
+    return False
+
+
+def _callable_params(func: ast.FunctionDef) -> Set[str]:
+    out = set()
+    args = func.args
+    for a in list(args.args) + list(args.kwonlyargs) + list(args.posonlyargs):
+        ann = a.annotation
+        if ann is not None and "Callable" in ast.dump(ann):
+            out.add(a.arg)
+    return out
+
+
+def _deferred_scopes(tree: ast.Module) -> Set[ast.AST]:
+    """Function/lambda nodes whose body runs at obs.flush time, not in the
+    hot path: lambdas passed straight to obs.defer, and defs whose NAME is
+    later passed to obs.defer."""
+    out: Set[ast.AST] = set()
+    deferred_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node).split(".")[-1] == "defer":
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    out.add(arg)
+                elif isinstance(arg, ast.Name):
+                    deferred_names.add(arg.id)
+    for func in iter_funcs(tree):
+        if func.name in deferred_names:
+            out.add(func)
+    return out
+
+
+_COMPOUND_HEADERS = {
+    ast.If: ("test",),
+    ast.While: ("test",),
+    ast.For: ("iter",),
+    ast.With: ("items",),
+}
+
+
+@checker(R_SYNC)
+def check_sync_in_hot_path(ctx) -> Iterator[Finding]:
+    if not _in_hot_dir(ctx.relpath):
+        return
+    np_aliases = _numpy_aliases(ctx.tree)
+    deferred = _deferred_scopes(ctx.tree)
+
+    def in_deferred(node: ast.AST) -> bool:
+        cur = node
+        while cur is not None:
+            if cur in deferred:
+                return True
+            cur = ctx.parents.get(cur)
+        return False
+
+    def flag_in(node: ast.AST, taint: _Taint) -> Iterator[Finding]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                yield from flag_call(sub, taint)
+
+    def scan_stmts(stmts: List[ast.stmt], taint: _Taint) -> Iterator[Finding]:
+        """One pass in statement order: feed assignments into the taint
+        set, flag sync calls, recurse into compound bodies with the SAME
+        taint scope and into nested defs with a fresh child scope."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = _Taint(set(), parent=taint)
+                inner.device_callables |= _callable_params(stmt)
+                a = stmt.args
+                inner.params |= {
+                    x.arg
+                    for x in (list(a.posonlyargs) + list(a.args)
+                              + list(a.kwonlyargs))
+                }
+                yield from scan_stmts(stmt.body, inner)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                yield from scan_stmts(stmt.body, taint)
+                continue
+            taint.feed(stmt)
+            if isinstance(stmt, (ast.If, ast.While, ast.For, ast.With,
+                                 ast.Try)):
+                for fieldname in _COMPOUND_HEADERS.get(type(stmt), ()):
+                    val = getattr(stmt, fieldname)
+                    for header in val if isinstance(val, list) else [val]:
+                        yield from flag_in(header, taint)
+                for blk in ("body", "orelse", "finalbody"):
+                    sub_stmts = getattr(stmt, blk, None)
+                    if sub_stmts:
+                        yield from scan_stmts(sub_stmts, taint)
+                for handler in getattr(stmt, "handlers", []):
+                    yield from scan_stmts(handler.body, taint)
+            else:
+                yield from flag_in(stmt, taint)
+
+    def flag_call(sub: ast.Call, taint: _Taint) -> Iterator[Finding]:
+        if in_deferred(sub):
+            return
+        name = call_name(sub)
+        parts = name.split(".")
+        if (
+            len(parts) == 2
+            and parts[0] in np_aliases
+            and parts[1] in ("asarray", "array")
+            and sub.args
+            and not _is_const_expr(sub.args[0])
+            and (
+                taint.expr_tainted(sub.args[0])
+                or any(
+                    isinstance(n, ast.Name) and n.id in taint.params
+                    for n in ast.walk(sub.args[0])
+                )
+            )
+        ):
+            yield _mk(
+                R_SYNC, ctx, sub,
+                f"{name}() on a device value blocks the host; defer the "
+                "fetch (obs.defer) or suppress with the reason the sync "
+                "is required",
+            )
+            return
+        if isinstance(sub.func, ast.Attribute) and sub.func.attr in _SYNC_METHODS:
+            yield _mk(
+                R_SYNC, ctx, sub,
+                f".{sub.func.attr}() is a host sync; defer it or suppress "
+                "with the reason it is required",
+            )
+            return
+        if (
+            isinstance(sub.func, ast.Name)
+            and sub.func.id in _CAST_BUILTINS
+            and len(sub.args) == 1
+            and taint.expr_tainted(sub.args[0])
+        ):
+            yield _mk(
+                R_SYNC, ctx, sub,
+                f"{sub.func.id}() of a device value is a host sync; defer "
+                "it or suppress with the reason it is required",
+            )
+
+    # module scope: jitted bindings (X = jax.jit(F) / shard_map results)
+    # and imported *_jit names are device callables everywhere in the file
+    module_callables: Set[str] = set()
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _mints_device_callable(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_callables.add(t.id)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if (a.asname or a.name).endswith("_jit"):
+                    module_callables.add(a.asname or a.name)
+
+    yield from scan_stmts(ctx.tree.body, _Taint(module_callables))
+
+
+# --------------------------------------------------------------------------
+# KDT301 — dup-morton-bits-rule
+# --------------------------------------------------------------------------
+
+
+@checker(R_DUP_BITS)
+def check_dup_bits_rule(ctx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.FloorDiv)
+            and isinstance(node.left, ast.Constant)
+            and node.left.value == 32
+        ):
+            continue
+        scope = func_qualname(node, ctx.parents)
+        if scope.split(".")[-1] == "default_bits":
+            continue  # the one canonical definition
+        yield _mk(
+            R_DUP_BITS, ctx, node,
+            "re-derives the Morton quantization-bit rule (32 // ...); call "
+            "ops.morton.default_bits so tree geometry and query planning "
+            "can never disagree",
+        )

@@ -1,0 +1,105 @@
+"""Rule registry: ids, metadata, and the Finding record.
+
+Rule ids are stable API — suppression comments and baselines reference
+them — so they are never renumbered or reused. Bands by category:
+``KDT1xx`` correctness, ``KDT2xx`` performance, ``KDT3xx`` hygiene.
+
+A checker is a function ``(ctx: FileContext) -> Iterable[Finding]``
+registered against one rule with :func:`checker`; the walker runs every
+registered checker over every file and owns suppression/baseline
+semantics, so checkers only ever YIELD findings — they never decide
+whether a finding is shown.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+CORRECTNESS = "correctness"
+PERFORMANCE = "performance"
+HYGIENE = "hygiene"
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One lint rule's identity and provenance.
+
+    ``origin`` names the shipped/caught bug the rule mechanizes — it is
+    rendered into the docs catalog so nobody has to trust a rule that
+    can't say why it exists."""
+
+    id: str
+    name: str  # kebab-case slug, shown next to the id
+    category: str  # correctness | performance | hygiene
+    summary: str
+    origin: str
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    name: str
+    path: str  # posix relpath from the lint root
+    line: int
+    col: int
+    scope: str  # enclosing function qualname, or "<module>"
+    message: str
+    line_text: str = ""  # stripped source line (baseline fingerprint input)
+    baselined: bool = False
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity: unrelated edits above a grandfathered
+        finding must not churn the baseline, so the fingerprint is
+        (rule, file, enclosing scope, the offending line's own text)."""
+        return "|".join((self.rule, self.path, self.scope, self.line_text))
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+
+RULES: Dict[str, Rule] = {}
+_CHECKERS: List[Callable] = []
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    RULES[rule.id] = rule
+    return rule
+
+
+def checker(rule: Rule) -> Callable[[Callable], Callable]:
+    """Decorator binding a checker function to its rule."""
+
+    def wrap(fn: Callable) -> Callable:
+        fn.rule = rule
+        _CHECKERS.append(fn)
+        return fn
+
+    return wrap
+
+
+def all_rules() -> List[Rule]:
+    return [RULES[k] for k in sorted(RULES)]
+
+
+def all_checkers() -> List[Callable]:
+    # import-for-effect: the checker module registers itself on first use
+    from kdtree_tpu.analysis import checkers  # noqa: F401
+
+    return list(_CHECKERS)
+
+
+def get_rule(rule_id: str) -> Optional[Rule]:
+    from kdtree_tpu.analysis import checkers  # noqa: F401
+
+    return RULES.get(rule_id)
+
+
+def known_rule_ids() -> List[str]:
+    from kdtree_tpu.analysis import checkers  # noqa: F401
+
+    return sorted(RULES)

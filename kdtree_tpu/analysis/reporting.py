@@ -1,0 +1,80 @@
+"""Human and JSON rendering of a lint run.
+
+Human output is grep/editor-friendly (``path:line:col: RULE [slug]
+message``); JSON is the machine contract CI uploads as an artifact —
+stable keys, schema versioned alongside the baseline format.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from kdtree_tpu.analysis.registry import RULES
+from kdtree_tpu.analysis.walker import LintResult
+
+FORMAT_VERSION = 1
+
+
+def render_human(result: LintResult, new_count: Optional[int] = None) -> str:
+    lines: List[str] = []
+    for f in result.findings:
+        tag = " (baselined)" if f.baselined else ""
+        lines.append(
+            f"{f.location()}: {f.rule} [{f.name}]{tag} {f.message}"
+        )
+    for err in result.errors:
+        lines.append(f"error: {err}")
+    n = len(result.findings)
+    base = sum(1 for f in result.findings if f.baselined)
+    summary = (
+        f"{result.files} file(s): {n} finding(s)"
+        f" ({base} baselined, {len(result.suppressed)} suppressed inline)"
+    )
+    if new_count is not None:
+        summary += f"; {new_count} NEW"
+    lines.append(summary)
+    return "\n".join(lines) + "\n"
+
+
+def render_json(result: LintResult, new_count: Optional[int] = None) -> str:
+    def enc(f):
+        return {
+            "rule": f.rule,
+            "name": f.name,
+            "category": RULES[f.rule].category if f.rule in RULES else "",
+            "path": f.path,
+            "line": f.line,
+            "col": f.col + 1,
+            "scope": f.scope,
+            "message": f.message,
+            "line_text": f.line_text,
+            "baselined": f.baselined,
+        }
+
+    doc = {
+        "version": FORMAT_VERSION,
+        "files": result.files,
+        "findings": [enc(f) for f in result.findings],
+        "suppressed": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "reason": s.reason,
+            }
+            for f, s in result.suppressed
+        ],
+        "errors": list(result.errors),
+        "summary": {
+            "total": len(result.findings),
+            "baselined": sum(1 for f in result.findings if f.baselined),
+            "suppressed": len(result.suppressed),
+            "new": (
+                new_count
+                if new_count is not None
+                else sum(1 for f in result.findings if not f.baselined)
+            ),
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,244 @@
+"""File collection, suppression comments, and per-file checker driving.
+
+Suppression syntax (inline, same line as the finding, or on a
+comment-only line directly above it — for findings anchored on decorators
+or long expressions):
+
+    # kdt-lint: disable=KDT201 one stacked flag fetch guards exactness
+    # kdt-lint: disable=KDT101,KDT201 <reason covering both>
+
+The reason is MANDATORY: a suppression without one (or naming an unknown
+rule id) is itself a finding (KDT302). Suppressions silence a finding at
+its line; the committed baseline (:mod:`~kdtree_tpu.analysis.baseline`)
+grandfathers findings repo-wide so CI fails only on NEW violations —
+different tools for different jobs: suppressions are forever-with-a-
+-reason, the baseline is debt-to-burn-down.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kdtree_tpu.analysis.registry import (
+    Finding,
+    all_checkers,
+    known_rule_ids,
+)
+
+# the id list is one-or-more rule ids separated by commas (spaces around
+# the commas allowed — 'KDT101, KDT201 reason' must NOT eat KDT201 into
+# the reason); everything after the list is the reason
+_SUPPRESS_RE = re.compile(
+    r"#\s*kdt-lint:\s*disable=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"(?:\s+(.*))?$"
+)
+
+
+@dataclass
+class Suppression:
+    line: int  # line the suppression APPLIES to
+    comment_line: int
+    rule_ids: Tuple[str, ...]
+    reason: str
+
+
+@dataclass
+class FileContext:
+    """Everything a checker may ask about one parsed file."""
+
+    path: str
+    relpath: str
+    source: str
+    tree: ast.Module
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self._lines = self.source.splitlines()
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self._lines):
+            return self._lines[lineno - 1]
+        return ""
+
+    def enclosing_stmt(self, node: ast.AST) -> Optional[ast.stmt]:
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parents.get(cur)
+        return cur
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, Suppression]] = field(default_factory=list)
+    files: int = 0
+    errors: List[str] = field(default_factory=list)
+
+    def extend(self, other: "LintResult") -> None:
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+        self.files += other.files
+        self.errors.extend(other.errors)
+
+
+def _extract_suppressions(
+    source: str,
+) -> Tuple[List[Suppression], List[Tuple[int, str]]]:
+    """(suppressions, malformed) from the file's comments.
+
+    A comment on a line with code applies to that line; a comment-only
+    line applies to the next line (decorator/long-call anchors).
+    ``malformed`` carries (line, why) pairs for KDT302.
+    """
+    sups: List[Suppression] = []
+    malformed: List[Tuple[int, str]] = []
+    known = set(known_rule_ids())
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return sups, malformed
+    comment_only_lines = {
+        t.start[0]
+        for t in tokens
+        if t.type == tokenize.COMMENT and t.line[: t.start[1]].strip() == ""
+    }
+    src_lines = source.splitlines()
+
+    def skippable(lineno: int) -> bool:
+        """Lines a standalone suppression reads THROUGH to find its code
+        line: later comment lines of the block, and blank lines."""
+        if lineno in comment_only_lines:
+            return True
+        return (
+            1 <= lineno <= len(src_lines) and not src_lines[lineno - 1].strip()
+        )
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        if "kdt-lint" not in tok.string:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        lineno = tok.start[0]
+        if not m:
+            malformed.append((
+                lineno,
+                "kdt-lint comment is not of the form "
+                "'# kdt-lint: disable=KDTxxx <reason>'",
+            ))
+            continue
+        ids = tuple(x.strip() for x in m.group(1).split(",") if x.strip())
+        reason = (m.group(2) or "").strip()
+        if lineno in comment_only_lines:
+            # a standalone comment (or the first line of a comment block)
+            # covers the first CODE line after the block, reading through
+            # trailing comment lines and blanks
+            applies = lineno + 1
+            while applies <= len(src_lines) and skippable(applies):
+                applies += 1
+        else:
+            applies = lineno
+        unknown = [i for i in ids if i not in known]
+        if not ids:
+            malformed.append((lineno, "suppression names no rule ids"))
+            continue
+        if unknown:
+            malformed.append((
+                lineno, f"suppression names unknown rule id(s): "
+                f"{', '.join(unknown)}",
+            ))
+        if not reason:
+            malformed.append((
+                lineno,
+                f"suppression of {', '.join(ids)} gives no reason — say "
+                "why the violation is required here",
+            ))
+            continue
+        sups.append(Suppression(applies, lineno, ids, reason))
+    return sups, malformed
+
+
+def lint_file(path: str, root: Optional[str] = None) -> LintResult:
+    """Run every registered checker over one file."""
+    result = LintResult(files=1)
+    root = root or os.getcwd()
+    relpath = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError) as e:
+        result.errors.append(f"{relpath}: cannot lint: {e}")
+        return result
+    ctx = FileContext(path=path, relpath=relpath, source=source, tree=tree)
+
+    sups, malformed = _extract_suppressions(source)
+    by_line: Dict[int, List[Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+
+    raw: List[Finding] = []
+    for check in all_checkers():
+        raw.extend(check(ctx))
+
+    for f in raw:
+        matched = None
+        for s in by_line.get(f.line, []):
+            if f.rule in s.rule_ids:
+                matched = s
+                break
+        if matched is not None:
+            result.suppressed.append((f, matched))
+        else:
+            result.findings.append(f)
+
+    from kdtree_tpu.analysis.checkers import R_SUPPRESS, _mk
+
+    for lineno, why in malformed:
+        marker = ast.Module(body=[], type_ignores=[])
+        marker.lineno = lineno  # type: ignore[attr-defined]
+        marker.col_offset = 0  # type: ignore[attr-defined]
+        result.findings.append(_mk(R_SUPPRESS, ctx, marker, why))
+
+    result.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return result
+
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    # dedup by absolute path: overlapping arguments ('pkg pkg/ops', a dir
+    # plus a file inside it) must not lint a file twice — duplicate
+    # findings would double-count against the baseline's multiplicities
+    out: Dict[str, str] = {}
+    for p in paths:
+        if os.path.isfile(p):
+            out.setdefault(os.path.abspath(p), p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    full = os.path.join(dirpath, name)
+                    out.setdefault(os.path.abspath(full), full)
+    return list(out.values())
+
+
+def run_lint(paths: Iterable[str], root: Optional[str] = None) -> LintResult:
+    """Lint every .py file under ``paths``; findings carry paths relative
+    to ``root`` (default: cwd) so baselines are machine-portable."""
+    result = LintResult()
+    for path in collect_files(paths):
+        result.extend(lint_file(path, root=root))
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 LabelItems = Tuple[Tuple[str, str], ...]
 

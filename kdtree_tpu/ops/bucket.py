@@ -38,7 +38,6 @@ import numpy as np
 from jax import lax
 
 from kdtree_tpu import obs
-from kdtree_tpu.models.tree import node_levels
 
 DEFAULT_BUCKET = 128
 

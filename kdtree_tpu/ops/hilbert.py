@@ -66,6 +66,8 @@ def hilbert_codes(
         # order by the leading axes only (same graceful degradation as
         # morton_codes for D > 32: ordering quality drops, correctness of
         # consumers never depends on WHICH order, only that one exists)
+        # kdt-lint: disable=KDT301 inverse map (how many AXES fit a u32 at
+        # this bits), not the bits-per-axis rule default_bits owns
         d = max(32 // max(bits, 1), 1)
         points = points[:, :d]
     x = _quantize(points, bits, lo, hi)

@@ -51,6 +51,7 @@ from jax import lax
 
 from kdtree_tpu import obs
 from kdtree_tpu.ops.topk import scan_bucket_block
+from kdtree_tpu.utils.guards import check_rows_fit_i32
 
 # bucket-occupancy histogram bounds (points per bucket) — spans both the
 # single-chip default cap (256) and the forest cap (128); the +Inf bucket
@@ -180,6 +181,7 @@ def _tree_shape(n: int, bucket_cap: int) -> Tuple[int, int, int]:
 
 def build_morton_impl(points: jax.Array, *, bucket_cap: int, bits: int) -> MortonTree:
     n, d = points.shape
+    check_rows_fit_i32(n, "point set")  # gids below are int32
     nbp, heap_size, num_levels = _tree_shape(n, bucket_cap)
     code = morton_codes(points, bits)
     gid = jnp.arange(n, dtype=jnp.int32)
@@ -287,8 +289,11 @@ def build_morton(
     n, d = points.shape
     check_build_capacity(n, d)
     if bits is None:
-        bits = 32 // max(d, 1)
-    bits = max(1, min(bits, 32 // max(d, 1), 16))
+        bits = default_bits(d)
+    else:
+        # user-supplied bits are clamped by the same rule: more than
+        # default_bits(d) cannot fit the u32 interleaved code anyway
+        bits = max(1, min(bits, default_bits(d)))
     tree = _build_morton_jit(points, bucket_cap, bits)
     if not obs.is_tracer(points):
         obs.count_build("morton", n)

@@ -11,7 +11,6 @@ distances decide unless equal, and equal-inf entries are all discardable.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

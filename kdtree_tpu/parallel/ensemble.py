@@ -25,11 +25,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from kdtree_tpu.models.tree import tree_spec
 from kdtree_tpu.ops.build import build_impl, spec_arrays
 from kdtree_tpu.ops.query import _knn_batch
+from kdtree_tpu.utils.guards import check_rows_fit_i32
 
 from .mesh import SHARD_AXIS, shard_map
 
@@ -106,6 +107,8 @@ def _local_gen_build_query(start, seed, queries, structure, *, dim: int,
     from .global_morton import _merge_partials
 
     pts = generate_points_shard(seed[0], dim, start[0], rows)
+    # kdt-lint: disable=KDT101 per-shard SPMD body traced under shard_map;
+    # num_points is guarded at the ensemble_knn_gen entry
     gid0 = start[0] + jnp.arange(rows, dtype=jnp.int32)
     valid = gid0 < num_points
     pts = jnp.where(valid[:, None], pts, jnp.inf)
@@ -152,6 +155,7 @@ def ensemble_knn_gen(
         from .mesh import make_mesh
 
         mesh = make_mesh()
+    check_rows_fit_i32(num_points, "generative ensemble problem")
     p = mesh.shape[SHARD_AXIS]
     rows = -(-num_points // p)
     structure = spec_arrays(rows, dim)
@@ -201,9 +205,10 @@ def _dense_forest_knn(points, queries, k: int, mesh: Mesh):
     nl, nh, bp, bg, occ = _local_forest_jit(
         points.reshape(p, n_local, d), gid.reshape(p, n_local), 128, bits
     )
+    occ_max = int(jnp.max(occ))  # kdt-lint: disable=KDT201 one scalar fetch at build end; occ_max is a STATIC planning fact of the new forest
     forest = GlobalMortonForest(
         nl, nh, bp, bg, num_points=n, seed=-1, bucket_cap=128, bits=bits,
-        occ_max=int(jnp.max(occ)),
+        occ_max=occ_max,
     )
     return global_morton_query_tiled(forest, queries, k=k, mesh=mesh)
 

@@ -51,15 +51,14 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kdtree_tpu import obs
 from kdtree_tpu.models.tree import tree_spec
 from kdtree_tpu.ops.build import build_impl, spec_arrays
-from kdtree_tpu.ops.generate import generate_points_shard
 from kdtree_tpu.ops.query import _knn_batch
+from kdtree_tpu.utils.guards import check_rows_fit_i32
 
 from .global_morton import _merge_partials
 from .mesh import SHARD_AXIS, shard_map
@@ -237,6 +236,8 @@ def _build_local_body(start, seed, structure, *, dim, rows, width, num_points,
     # the extra width is headroom for exchange-occupancy fluctuation
     # (binomial ~sqrt(rows) per level), never real data
     pts = _gen_shard(distribution, seed[0], dim, start[0], W)
+    # kdt-lint: disable=KDT101 per-shard SPMD body traced under shard_map;
+    # num_points is guarded at the build_global_exact entry
     gid = (start[0] + jnp.arange(W)).astype(jnp.int32)
     valid0 = (jnp.arange(W) < rows) & (gid < num_points)
     pts = jnp.where(valid0[:, None], pts, jnp.inf)
@@ -297,6 +298,9 @@ def _build_local_body(start, seed, structure, *, dim, rows, width, num_points,
     )
 
 
+# kdt-lint: disable=KDT102 exercised vs the oracle on legacy jax in tier-1
+# (test_global_exact); the 0.4.x miscompile is specific to the fused
+# ensemble build+query program — see parallel/ensemble.py:_FUSED_JIT_SAFE
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "dim", "rows", "width", "num_points", "cap",
@@ -343,6 +347,7 @@ def build_global_exact(
     Raises RuntimeError on mirror-exchange capacity overflow (heavily
     skewed data; retry with higher ``slack``).
     """
+    check_rows_fit_i32(num_points, "generative problem")
     if mesh is None:
         from .mesh import make_mesh
 
@@ -364,9 +369,10 @@ def build_global_exact(
         starts, jnp.asarray([seed], jnp.int32), structure, mesh, dim, rows,
         width, num_points, cap, htop, num_levels, distribution,
     )
-    if int(overflow[0]) > 0:
+    ov = int(overflow[0])  # kdt-lint: disable=KDT201 build-time exactness gate: the overflow count must be read to refuse a partial index
+    if ov > 0:
         raise RuntimeError(
-            f"mirror-exchange capacity overflow ({int(overflow[0])} rows); "
+            f"mirror-exchange capacity overflow ({ov} rows); "
             f"retry with slack > {slack}"
         )
     obs.count_build("global-exact", num_points)
@@ -404,6 +410,9 @@ def _query_local_body(top_pts, top_gid, lpts, lnode, lsplit, lgid, queries,
     return _fold_top(md, mi, top_pts, top_gid, queries, k)
 
 
+# kdt-lint: disable=KDT102 exercised vs the oracle on legacy jax in tier-1
+# (test_global_exact); the miscompile is specific to the fused ensemble
+# build+query program — see parallel/ensemble.py:_FUSED_JIT_SAFE
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "num_levels"))
 def _query_jit(tree_arrays, queries, mesh, k, num_levels):
     fn = shard_map(
@@ -468,9 +477,10 @@ def _exact_to_forest(tree: GlobalExactTree, bucket_cap: int = 128):
 
     nl, nh, bp, bg, occ = _local_forest_jit(tree.local_pts, tree.local_gid,
                                             bucket_cap, bits)
+    occ_max = int(jnp.max(occ))  # kdt-lint: disable=KDT201 one scalar fetch per tree at view-build time; occ_max is a STATIC planning fact
     forest = GlobalMortonForest(
         nl, nh, bp, bg, num_points=tree.num_points, seed=tree.seed,
-        bucket_cap=bucket_cap, bits=bits, occ_max=int(jnp.max(occ)),
+        bucket_cap=bucket_cap, bits=bits, occ_max=occ_max,
     )
     tree._forest_cache = forest
     return forest

@@ -53,6 +53,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from kdtree_tpu import obs
 from kdtree_tpu.models.tree import tree_spec
 from kdtree_tpu.ops.query import _knn_batch_nodes
+from kdtree_tpu.utils.guards import check_rows_fit_i32
 
 from .mesh import SHARD_AXIS, shard_map
 
@@ -235,6 +236,9 @@ def _global_build_local(
     return node_coords, node_gid
 
 
+# kdt-lint: disable=KDT102 exercised vs the single-chip build for identity
+# on legacy jax in tier-1 (test_global_tree); the 0.4.x miscompile is
+# specific to the fused ensemble build+query program — see ensemble.py
 @functools.partial(
     jax.jit, static_argnames=("mesh", "num_levels", "heap_size")
 )
@@ -279,6 +283,7 @@ def build_global(points: jax.Array, mesh: Mesh | None = None) -> GlobalKDTree:
             [points, jnp.full((pad, d), jnp.inf, points.dtype)], axis=0
         )
     n_pad = n + pad
+    check_rows_fit_i32(n_pad, "global tree point set")  # gids are int32
     spec = tree_spec(n_pad)
     gid = jnp.where(jnp.arange(n_pad) < n, jnp.arange(n_pad), -1).astype(jnp.int32)
     consume = jnp.asarray(spec.consume_level)
@@ -305,6 +310,8 @@ def _global_gen_local(start, seed, consume_local, posnode_local, *, dim: int,
     from kdtree_tpu.ops.generate import generate_points_shard
 
     pts = generate_points_shard(seed[0], dim, start[0], rows)
+    # kdt-lint: disable=KDT101 per-shard SPMD body traced under shard_map;
+    # num_points is guarded at the build_global_gen entry
     gid = (start[0] + jnp.arange(rows)).astype(jnp.int32)
     valid = gid < num_points
     pts = jnp.where(valid[:, None], pts, jnp.inf)
@@ -312,6 +319,9 @@ def _global_gen_local(start, seed, consume_local, posnode_local, *, dim: int,
     return _global_build_local(pts, gid, consume_local, posnode_local, **kw)
 
 
+# kdt-lint: disable=KDT102 exercised vs build_global for tree identity on
+# legacy jax in tier-1 (test_global_tree); the 0.4.x miscompile is
+# specific to the fused ensemble build+query program — see ensemble.py
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "dim", "rows", "num_points", "num_levels",
@@ -353,6 +363,7 @@ def build_global_gen(
         raise ValueError(f"global-tree mode needs a power-of-2 device count, got {p}")
     rows = -(-num_points // p)
     n_pad = p * rows
+    check_rows_fit_i32(n_pad, "generative global-tree problem")
     spec = tree_spec(n_pad)
     consume = jnp.asarray(spec.consume_level)
     posnode = jnp.asarray(spec.position_node)

@@ -864,6 +864,55 @@ def cmd_stats(args) -> None:
     sys.stdout.write(export.render_report(rep))
 
 
+def cmd_lint(args) -> None:
+    """Project-invariant linter (docs/STATIC_ANALYSIS.md): AST rules for
+    the bug classes this project actually shipped — int32 gid wrap,
+    device syncs in hot paths, jit-over-shard_map on legacy jax, unsafe
+    telemetry listeners, re-derived Morton bits, nondeterminism. Exits 1
+    when findings exist that are neither suppressed inline (with a
+    reason) nor grandfathered in the committed baseline."""
+    import os
+
+    from kdtree_tpu.analysis import baseline as bl
+    from kdtree_tpu.analysis import reporting, run_lint
+
+    paths = args.paths or ["kdtree_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"cannot lint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        sys.exit(2)
+    result = run_lint(paths)
+    if result.errors and not result.findings:
+        # un-parseable inputs with nothing else to report: that is a
+        # usage-shaped failure, not a lint verdict
+        for err in result.errors:
+            print(f"error: {err}", file=sys.stderr)
+        sys.exit(2)
+    if args.update_baseline:
+        count = bl.save(args.baseline, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) "
+              f"({count} fingerprint(s)) to {args.baseline}")
+        return
+    try:
+        base = bl.load(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"cannot read baseline {args.baseline}: {e}", file=sys.stderr)
+        sys.exit(2)
+    new = bl.partition(result.findings, base)
+    render = (reporting.render_json if args.format == "json"
+              else reporting.render_human)
+    sys.stdout.write(render(result, new_count=len(new)))
+    if new:
+        print(
+            f"{len(new)} new finding(s): fix them, suppress inline with a "
+            "reason (# kdt-lint: disable=KDTxxx <why>), or grandfather "
+            f"with --update-baseline (see docs/STATIC_ANALYSIS.md)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
 def _parse_int_list(raw: str | None, what: str):
     """Comma-separated positive ints for the tune sweep grids."""
     if raw is None:
@@ -1051,6 +1100,25 @@ def main(argv=None) -> None:
                          "32..256 pow2)")
     tu.set_defaults(fn=cmd_tune)
 
+    li = sub.add_parser(
+        "lint",
+        help="project-invariant AST linter (docs/STATIC_ANALYSIS.md): "
+             "fails on findings not suppressed inline or grandfathered "
+             "in the baseline",
+    )
+    li.add_argument("paths", nargs="*", metavar="PATH",
+                    help="files/directories to lint (default: kdtree_tpu)")
+    li.add_argument("--format", choices=["human", "json"], default="human",
+                    help="json is the machine report CI uploads")
+    li.add_argument("--baseline", default="lint_baseline.json",
+                    metavar="PATH",
+                    help="committed grandfather file; only findings NOT in "
+                         "it fail the run")
+    li.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(burn down or grandfather debt) and exit 0")
+    li.set_defaults(fn=cmd_lint)
+
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -1060,6 +1128,12 @@ def main(argv=None) -> None:
         # Usage parity with Utility.cpp:109-112
         print(f"Usage: {p.prog} harness SEED DIM_POINTS  NUM_POINTS", file=sys.stderr)
         sys.exit(1)
+    if args.cmd == "lint":
+        # pure-AST path: dispatch before the engine-error plumbing below.
+        # (The kdtree_tpu package import itself still pulls in jax — the
+        # ANALYSIS code is stdlib-only, the entry point is not.)
+        args.fn(args)
+        return
     metrics_out = getattr(args, "metrics_out", None)
     if metrics_out and args.cmd != "stats":
         from kdtree_tpu import obs

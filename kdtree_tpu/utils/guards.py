@@ -22,6 +22,26 @@ import numpy as np
 from kdtree_tpu.obs import get_registry
 
 
+_MAX_ROWS_I32 = 1 << 31  # global point ids are int32 everywhere
+
+
+def check_rows_fit_i32(n: int, what: str) -> None:
+    """Global point ids (``bucket_gid``, result ids) are int32 throughout
+    the engines; rows past 2**31-1 would wrap their gids negative and be
+    silently treated as padding by every downstream mask — data loss, not
+    an error. Refuse crisply at the door instead.
+
+    Every function that materializes a gid array must call this on the
+    row count — enforced by ``kdtree-tpu lint`` (KDT101, the mechanized
+    form of the wrap found at 3 forest-build sites)."""
+    if n >= _MAX_ROWS_I32:
+        raise ValueError(
+            f"{what} has {n} rows, but global point ids are int32 "
+            f"(max {_MAX_ROWS_I32 - 1} rows per index); split the data "
+            "across multiple forests"
+        )
+
+
 def assert_no_nan(arr: jax.Array, name: str = "points") -> jax.Array:
     """Raise ValueError if ``arr`` contains NaN (host-synced, edge use only).
 

@@ -1,0 +1,452 @@
+"""kdtree-tpu lint: every rule gets a true-positive AND a clean-negative
+fixture, plus the suppression and baseline lifecycles end to end.
+
+No jax API anywhere on this path (the package import aside) and no
+backend warmup, so these tests are tier-1-cheap.
+"""
+
+import json
+
+import pytest
+
+from kdtree_tpu.analysis import baseline as bl
+from kdtree_tpu.analysis import run_lint
+from kdtree_tpu.utils import cli
+
+
+def lint_snippet(tmp_path, source, relpath="ops/mod.py"):
+    """Write ``source`` at ``relpath`` under a fresh root and lint it."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return run_lint([str(tmp_path)], root=str(tmp_path))
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# KDT101 missing-i32-guard
+# ---------------------------------------------------------------------------
+
+
+def test_kdt101_flags_unguarded_gid_arange(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def build(points):\n"
+        "    n = points.shape[0]\n"
+        "    gid = jnp.arange(n, dtype=jnp.int32)\n"
+        "    return gid\n"
+    ))
+    assert rules_of(res) == ["KDT101"]
+    assert res.findings[0].line == 4
+    assert res.findings[0].scope == "build"
+
+
+def test_kdt101_clean_when_guarded(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "from kdtree_tpu.utils.guards import check_rows_fit_i32\n"
+        "def build(points):\n"
+        "    n = points.shape[0]\n"
+        "    check_rows_fit_i32(n, 'point set')\n"
+        "    gid = jnp.arange(n, dtype=jnp.int32)\n"
+        "    return gid\n"
+    ))
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# KDT102 jit-over-shard_map
+# ---------------------------------------------------------------------------
+
+_SHARD_BODY = (
+    "import functools\n"
+    "import jax\n"
+    "from kdtree_tpu.parallel.mesh import shard_map\n"
+    "def _impl(x, mesh):\n"
+    "    fn = shard_map(lambda a: a, mesh=mesh, in_specs=(), out_specs=())\n"
+    "    return fn(x)\n"
+)
+
+
+def test_kdt102_flags_jit_decorated_shard_map(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import functools\n"
+        "import jax\n"
+        "from kdtree_tpu.parallel.mesh import shard_map\n"
+        "@functools.partial(jax.jit, static_argnames=('mesh',))\n"
+        "def _query(x, mesh):\n"
+        "    fn = shard_map(lambda a: a, mesh=mesh, in_specs=(), out_specs=())\n"
+        "    return fn(x)\n"
+    ), relpath="parallel/mod.py")
+    assert rules_of(res) == ["KDT102"]
+    assert res.findings[0].line == 4  # anchored on the decorator
+
+
+def test_kdt102_flags_ungated_use_of_jitted_binding(tmp_path):
+    res = lint_snippet(tmp_path, _SHARD_BODY + (
+        "_impl_jit = jax.jit(_impl)\n"
+        "def run(x, mesh):\n"
+        "    return _impl_jit(x, mesh)\n"
+    ), relpath="parallel/mod.py")
+    assert rules_of(res) == ["KDT102"]
+
+
+def test_kdt102_clean_when_gated_on_fused_jit_safe(tmp_path):
+    res = lint_snippet(tmp_path, _SHARD_BODY + (
+        "_FUSED_JIT_SAFE = hasattr(jax, 'shard_map')\n"
+        "_impl_jit = jax.jit(_impl)\n"
+        "def run(x, mesh):\n"
+        "    f = _impl_jit if _FUSED_JIT_SAFE else _impl\n"
+        "    return f(x, mesh)\n"
+    ), relpath="parallel/mod.py")
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# KDT103 unsafe-listener
+# ---------------------------------------------------------------------------
+
+
+def test_kdt103_flags_listener_that_can_raise(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.monitoring as monitoring\n"
+        "def _on_event(event, **kw):\n"
+        "    counters[event] += 1\n"
+        "monitoring.register_event_listener(_on_event)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == ["KDT103"]
+
+
+def test_kdt103_clean_when_exception_contained(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.monitoring as monitoring\n"
+        "def _on_event(event, **kw):\n"
+        "    \"\"\"doc\"\"\"\n"
+        "    try:\n"
+        "        counters[event] += 1\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "monitoring.register_event_listener(_on_event)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# KDT104 nondeterminism
+# ---------------------------------------------------------------------------
+
+
+def test_kdt104_flags_global_rng_and_time_seed(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import time\n"
+        "import numpy as np\n"
+        "def gen():\n"
+        "    seed = int(time.time())\n"
+        "    return np.random.uniform(0, 1, 10)\n"
+    ), relpath="utils/mod.py")
+    assert sorted(rules_of(res)) == ["KDT104", "KDT104"]
+
+
+def test_kdt104_clean_with_seeded_generator(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import numpy as np\n"
+        "def gen(seed):\n"
+        "    rng = np.random.default_rng(seed)\n"
+        "    return rng.uniform(0, 1, 10)\n"
+    ), relpath="utils/mod.py")
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# KDT201 sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+
+def test_kdt201_flags_casts_and_fetches_of_device_values(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def hot(tree):\n"
+        "    occ = jnp.sum(tree)\n"
+        "    flags = np.asarray(jnp.stack([occ]))\n"
+        "    x = occ.item()\n"
+        "    return int(jnp.max(occ)), flags, x\n"
+    ))
+    assert rules_of(res) == ["KDT201", "KDT201", "KDT201"]
+
+
+def test_kdt201_flags_callable_param_results(tmp_path):
+    # the drive_batches shape: results of a Callable-annotated parameter
+    # are device values; bool() of one is the sync the rule exists for
+    res = lint_snippet(tmp_path, (
+        "from typing import Callable\n"
+        "def drive(run_batch: Callable[[int], tuple], offsets):\n"
+        "    first = run_batch(offsets[0])\n"
+        "    while bool(first[2]):\n"
+        "        first = run_batch(offsets[0])\n"
+        "    return first\n"
+    ))
+    assert rules_of(res) == ["KDT201"]
+
+
+def test_kdt201_exempts_defer_callbacks_and_host_values(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from kdtree_tpu import obs\n"
+        "def hot(x, store):\n"
+        "    occ = jnp.sum(x)\n"
+        "    obs.defer(lambda: hist.observe(np.asarray(occ)))\n"
+        "    def _flush():\n"
+        "        return int(np.asarray(occ).sum())\n"
+        "    obs.defer(_flush)\n"
+        "    prof = store.lookup('key')\n"
+        "    tile = int(prof['tile'])\n"
+        "    med = np.array([1, 2, 3], np.int32)\n"
+        "    return tile, med\n"
+    ))
+    assert rules_of(res) == []
+
+
+def test_kdt201_ignored_outside_hot_dirs(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def render(x):\n"
+        "    return float(jnp.max(x))\n"
+    ), relpath="utils/mod.py")
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# KDT301 dup-morton-bits-rule
+# ---------------------------------------------------------------------------
+
+
+def test_kdt301_flags_rederived_bits_rule(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def plan(dim):\n"
+        "    bits = max(1, min(32 // max(dim, 1), 16))\n"
+        "    return bits\n"
+    ))
+    assert rules_of(res) == ["KDT301"]
+
+
+def test_kdt301_allows_the_canonical_definition(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def default_bits(dim):\n"
+        "    return max(1, min(32 // max(dim, 1), 16))\n"
+    ), relpath="ops/morton.py")
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions (KDT302 + the disable mechanics)
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_reason_silences_finding(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def plan(dim):\n"
+        "    return 32 // dim  # kdt-lint: disable=KDT301 inverse-map helper\n"
+    ))
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0][1].reason == "inverse-map helper"
+
+
+def test_suppression_on_comment_line_above_covers_next_code_line(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def plan(dim):\n"
+        "    # kdt-lint: disable=KDT301 reason spanning a comment block\n"
+        "    # (continuation of the why)\n"
+        "    return 32 // dim\n"
+    ))
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def plan(dim):\n"
+        "    return 32 // dim  # kdt-lint: disable=KDT301\n"
+    ))
+    # the reasonless comment does NOT suppress, and is itself a finding
+    assert sorted(rules_of(res)) == ["KDT301", "KDT302"]
+
+
+def test_suppression_id_list_allows_comma_space(tmp_path):
+    # 'KDT101, KDT201 reason' must parse as TWO ids + reason, not eat
+    # KDT201 into the reason and leave the finding unsuppressed
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def build(points):\n"
+        "    n = points.shape[0]\n"
+        "    # kdt-lint: disable=KDT101, KDT201 both covered by the entry guard\n"
+        "    gid = jnp.arange(n, dtype=jnp.int32)\n"
+        "    return int(jnp.max(gid))"
+        "  # kdt-lint: disable=KDT201 test sync\n"
+    ))
+    assert rules_of(res) == []
+    assert res.suppressed[0][1].rule_ids == ("KDT101", "KDT201")
+
+
+def test_suppression_block_reads_through_blank_line(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def plan(dim):\n"
+        "    # kdt-lint: disable=KDT301 reason here\n"
+        "\n"
+        "    return 32 // dim\n"
+    ))
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+def test_kdt101_nested_def_yields_one_finding(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def outer(points):\n"
+        "    def inner(n):\n"
+        "        gid = jnp.arange(n, dtype=jnp.int32)\n"
+        "        return gid\n"
+        "    return inner(points.shape[0])\n"
+    ))
+    assert rules_of(res) == ["KDT101"]  # exactly one, not outer+inner
+
+
+def test_kdt101_outer_guard_covers_nested_creation(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def outer(points):\n"
+        "    check_rows_fit_i32(points.shape[0], 'points')\n"
+        "    def inner(n):\n"
+        "        gid = jnp.arange(n, dtype=jnp.int32)\n"
+        "        return gid\n"
+        "    return inner(points.shape[0])\n"
+    ))
+    assert rules_of(res) == []
+
+
+def test_overlapping_paths_lint_each_file_once(tmp_path):
+    mod = tmp_path / "ops" / "mod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(_VIOLATION)
+    res = run_lint([str(tmp_path), str(tmp_path / "ops"), str(mod)],
+                   root=str(tmp_path))
+    assert len(res.findings) == 1
+    assert res.files == 1
+
+
+def test_suppression_of_unknown_rule_is_a_finding(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "x = 1  # kdt-lint: disable=KDT999 no such rule\n"
+    ))
+    assert rules_of(res) == ["KDT302"]
+
+
+# ---------------------------------------------------------------------------
+# baseline lifecycle (library level)
+# ---------------------------------------------------------------------------
+
+_VIOLATION = "def plan(dim):\n    return 32 // dim\n"
+
+
+def test_baseline_partition_counts_multiplicity(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def plan(dim):\n"
+        "    a = 32 // dim\n"
+        "    b = 32 // dim\n"
+        "    return a + b\n"
+    ))
+    assert len(res.findings) == 2
+    bpath = tmp_path / "base.json"
+    bl.save(str(bpath), res.findings[:1])  # grandfather ONE of the two
+    new = bl.partition(res.findings, bl.load(str(bpath)))
+    # identical line_text: one consumed by the baseline, one still new
+    assert len(new) == 1
+    assert sum(1 for f in res.findings if f.baselined) == 1
+
+
+def test_baseline_round_trip_is_line_number_stable(tmp_path):
+    res = lint_snippet(tmp_path, _VIOLATION)
+    bpath = tmp_path / "base.json"
+    bl.save(str(bpath), res.findings)
+    # shift the finding down two lines: fingerprint must still match
+    res2 = lint_snippet(tmp_path, "# comment\n\n" + _VIOLATION)
+    assert bl.partition(res2.findings, bl.load(str(bpath))) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI lifecycle: exit codes, --update-baseline, --format json
+# ---------------------------------------------------------------------------
+
+
+def _write_pkg(tmp_path, source):
+    mod = tmp_path / "pkg" / "mod.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(source)
+    return str(mod.parent)
+
+
+def test_cli_new_finding_fails_baselined_passes(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    pkg = _write_pkg(tmp_path, _VIOLATION)
+    bpath = str(tmp_path / "lint_baseline.json")
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", pkg, "--baseline", bpath])
+    assert exc.value.code == 1
+    assert "KDT301" in capsys.readouterr().out
+
+    cli.main(["lint", pkg, "--baseline", bpath, "--update-baseline"])
+    capsys.readouterr()
+
+    # same findings, now grandfathered: exits 0 (no SystemExit)
+    cli.main(["lint", pkg, "--baseline", bpath])
+    out = capsys.readouterr().out
+    assert "0 NEW" in out and "(baselined)" in out
+
+    # a NEW violation on top of the baselined one fails again
+    _write_pkg(tmp_path, _VIOLATION + "def other(d):\n    return 32 // d\n")
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", pkg, "--baseline", bpath])
+    assert exc.value.code == 1
+
+
+def test_cli_json_format_is_machine_readable(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    pkg = _write_pkg(tmp_path, _VIOLATION)
+    with pytest.raises(SystemExit):
+        cli.main(["lint", pkg, "--format", "json",
+                  "--baseline", str(tmp_path / "b.json")])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["new"] == 1
+    assert doc["findings"][0]["rule"] == "KDT301"
+    assert doc["findings"][0]["category"] == "hygiene"
+
+
+def test_cli_missing_path_is_usage_error(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "no/such/dir"])
+    assert exc.value.code == 2
+
+
+# ---------------------------------------------------------------------------
+# the repo itself stays clean (the CI gate, in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = run_lint([os.path.join(repo, "kdtree_tpu")], root=repo)
+    base = bl.load(os.path.join(repo, "lint_baseline.json"))
+    new = bl.partition(res.findings, base)
+    assert new == [], (
+        "unbaselined lint findings:\n"
+        + "\n".join(f"{f.location()}: {f.rule} {f.message}" for f in new)
+    )

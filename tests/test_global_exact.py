@@ -85,11 +85,8 @@ def test_radix_select_duplicate_keys():
     produce. Sharded crafted data: only 3 distinct key values spread over 8
     devices; the selected (key, id) must equal the host-sorted k-th pair
     for every rank k."""
-    import functools
-
     import jax
     import jax.numpy as jnp
-    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from kdtree_tpu.parallel.global_exact import _f32_key, _radix_select

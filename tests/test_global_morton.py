@@ -1,7 +1,6 @@
 """Global Morton forest (sample-sort all_to_all partition) on the virtual
 8-device CPU mesh — the --oversubscribe analog (SURVEY.md §4 item 4)."""
 
-import jax
 import numpy as np
 import pytest
 

@@ -6,15 +6,12 @@ single-chip build over the same global array, because both run the identical
 level-synchronous algorithm — only the sort is distributed.
 """
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from kdtree_tpu import build_jit, generate_problem, tree_spec
-from kdtree_tpu.models.tree import node_levels
+from kdtree_tpu import build_jit, generate_problem
 from kdtree_tpu.ops import bruteforce
-from kdtree_tpu.parallel import build_global, global_build_knn, global_knn, make_mesh
+from kdtree_tpu.parallel import build_global, global_build_knn, make_mesh
 
 
 @pytest.mark.parametrize("n,d", [(512, 3), (1024, 5), (256, 2)])

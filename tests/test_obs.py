@@ -272,10 +272,10 @@ def test_jsonl_event_log(tmp_path):
         export.emit_event({"type": "marker", "note": "hi"})
     finally:
         export.configure_jsonl(None)
-    lines = [json.loads(l) for l in open(path)]
-    kinds = [l["type"] for l in lines]
+    lines = [json.loads(ln) for ln in open(path)]
+    kinds = [ln["type"] for ln in lines]
     assert "span" in kinds and "marker" in kinds
-    sp = next(l for l in lines if l["type"] == "span")
+    sp = next(ln for ln in lines if ln["type"] == "span")
     assert sp["span"] == "logged_span" and sp["seconds"] >= 0.0
 
 

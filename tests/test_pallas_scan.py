@@ -1,6 +1,18 @@
 """Fused Pallas scan kernel vs the XLA reference path (identity pattern of
 tests/test_build_presort.py: same algorithm, two implementations) plus the
-brute-force oracle. Runs in interpreter mode on the CPU test mesh."""
+brute-force oracle. Runs in interpreter mode on the CPU test mesh.
+
+The whole module gates on an interpret-path PROBE (not a version pin):
+older jax (e.g. the 0.4.37 line) raises NotImplementedError inside the
+CPU interpret machinery for this kernel's primitives while the kernel is
+fine on real TPU backends — a known-environment limitation, not a
+regression, so it must read as SKIPPED, not FAILED (ROADMAP "Pallas
+on-CPU interpret parity"; the kernel-port half of that item stays open).
+A probe beats a version gate because it keeps working when a future jax
+implements the missing discharge rules — the tests un-skip themselves.
+"""
+
+import functools
 
 import numpy as np
 import pytest
@@ -24,6 +36,29 @@ def _mk_tiles(pts, qs, tile, k, cmax, seeds=8):
     bound = jnp.max(sd[..., k - 1], axis=1)
     cand, lb, _ = tq._frontier(tree, box_lo, box_hi, bound, cmax)
     return tree, tiles, cand, lb
+
+
+@functools.lru_cache(maxsize=1)
+def _interpret_supported() -> bool:
+    """Probe the ACTUAL kernel (tiny shape) in interpret mode — a trivial
+    probe kernel would pass on jax versions whose interpreter lacks only
+    the state-discharge rules this kernel's while_loop/run_scoped use."""
+    try:
+        pts, _ = generate_problem(seed=11, dim=2, num_points=256, num_queries=1)
+        qs, _ = generate_problem(seed=12, dim=2, num_points=16, num_queries=1)
+        tree, tiles, cand, lb = _mk_tiles(pts, qs, tile=8, k=2, cmax=16)
+        np.asarray(scan_tiles_fused(tree, tiles, cand, lb, 2, interpret=True))
+        return True
+    except NotImplementedError:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _interpret_supported(),
+    reason="pallas CPU interpret path lacks primitives this kernel needs "
+           "on this jax (NotImplementedError); kernel verified on real TPU "
+           "backends — ROADMAP 'Pallas on-CPU interpret parity'",
+)
 
 
 @pytest.mark.parametrize("n,d,k,tile", [(4096, 3, 4, 16), (2000, 2, 16, 8)])
