@@ -69,10 +69,13 @@ R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
     "int()/float()/bool() of device values) inside ops/, parallel/, "
-    "pallas/ functions unless inside an obs.defer callback",
+    "pallas/, serve/ functions unless inside an obs.defer callback or an "
+    "HTTP handler class (BaseHTTPRequestHandler subclasses legitimately "
+    "materialize responses)",
     "a per-batch bool(overflow) fetch serialized the async dispatch loop "
     "~8x at the 10M-query north-star shape (PR 1); obs.defer exists "
-    "precisely so metrics fetches leave the hot path",
+    "precisely so metrics fetches leave the hot path — and the serving "
+    "batch-dispatch path (PR 4) is the hottest loop of all",
 ))
 
 R_DUP_BITS = register(Rule(
@@ -470,7 +473,13 @@ def check_nondeterminism(ctx) -> Iterator[Finding]:
 # KDT201 — sync-in-hot-path
 # --------------------------------------------------------------------------
 
-_HOT_DIRS = ("ops", "parallel", "pallas")
+_HOT_DIRS = ("ops", "parallel", "pallas", "serve")
+# HTTP handler glue is the sanctioned response-materialization boundary:
+# a do_POST that np.asarray()s a result into JSON is the endpoint working
+# as designed, not a hot-path sync. Detected by base-class name (the
+# stdlib handler types), the same by-detection idea as the obs.defer
+# exemption — no suppression comments needed for the normal pattern.
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
 # jax.* calls that return host/callable objects, not device values
 _JAX_HOST_CALLS = {
     "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.default_backend",
@@ -635,6 +644,11 @@ def check_sync_in_hot_path(ctx) -> Iterator[Finding]:
                 yield from scan_stmts(stmt.body, inner)
                 continue
             if isinstance(stmt, ast.ClassDef):
+                if any(
+                    dotted_name(base).split(".")[-1] in _HANDLER_BASES
+                    for base in stmt.bases
+                ):
+                    continue  # handler glue: response boundary by design
                 yield from scan_stmts(stmt.body, taint)
                 continue
             taint.feed(stmt)

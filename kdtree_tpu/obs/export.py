@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from kdtree_tpu.obs.registry import MetricsRegistry, format_key, get_registry
+from kdtree_tpu.obs.registry import MetricsRegistry, get_registry
 
 REPORT_VERSION = 1
 
@@ -119,27 +119,80 @@ def write_report(
     return rep
 
 
+# Help strings for the metric families a scraper is most likely to alert
+# on (serving + plan cache + JAX runtime). Families not listed here simply
+# emit no # HELP line — an empty help is worse than none.
+METRIC_HELP = {
+    "kdtree_serve_requests_total": "k-NN serving requests by outcome",
+    "kdtree_serve_request_seconds":
+        "per-request latency by phase (queue/dispatch/total)",
+    "kdtree_serve_batch_rows": "coalesced rows per dispatched micro-batch",
+    "kdtree_serve_batch_requests": "requests coalesced per micro-batch",
+    "kdtree_serve_queue_depth": "query rows waiting in the admission queue",
+    "kdtree_serve_shed_total": "requests shed (429) at the admission gate",
+    "kdtree_serve_degraded_total":
+        "requests answered by the brute-force degradation path, by reason",
+    "kdtree_serve_batches_total":
+        "dispatched micro-batches by plan-cache temperature",
+    "kdtree_serve_ready": "1 once the index is loaded and warmup compiled",
+    "kdtree_plan_cache_hits_total": "tiled-plan store lookups that hit",
+    "kdtree_plan_cache_misses_total": "tiled-plan store lookups that missed",
+    "jax_backend_compiles_total":
+        "XLA backend compiles; growth after warmup means recompiles",
+}
+
+
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash first, then
+    quote and newline (exposition format spec, version 0.0.4)."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _prom_key(name: str, label_items) -> str:
+    """Like :func:`kdtree_tpu.obs.registry.format_key` but with label
+    values escaped for the exposition format — span paths, engine names
+    and shed reasons are data, and a stray quote or newline in one would
+    corrupt every series that follows it in the scrape."""
+    if not label_items:
+        return name
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in label_items
+    )
+    return f"{name}{{{inner}}}"
+
+
 def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     """Prometheus text exposition format (version 0.0.4) of the whole
     registry. Histograms emit cumulative ``_bucket{le=...}`` series plus
-    ``_sum`` / ``_count``, counters emit ``_total``-as-named values."""
+    ``_sum`` / ``_count``, counters emit ``_total``-as-named values.
+    ``# HELP`` (when the family is in :data:`METRIC_HELP`) and ``# TYPE``
+    are emitted exactly once per metric family — before its first series,
+    never between label sets — and label values are escaped
+    (backslash/quote/newline); both are hard scrape-format requirements
+    now that a live ``/metrics`` endpoint serves this output."""
     reg = registry or get_registry()
     lines = []
-    seen_type = set()
+    seen_family = set()
     for name, kind, items, inst in reg.collect():
-        if name not in seen_type:
+        if name not in seen_family:
+            help_text = METRIC_HELP.get(name)
+            if help_text:
+                escaped = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {name} {escaped}")
             lines.append(f"# TYPE {name} {kind}")
-            seen_type.add(name)
+            seen_family.add(name)
         if kind in ("counter", "gauge"):
-            lines.append(f"{format_key(name, items)} {inst.value:g}")
+            lines.append(f"{_prom_key(name, items)} {inst.value:g}")
             continue
         snap = inst.snapshot()
         base = dict(items)
         for upper, cum in snap["buckets"].items():
             le_items = tuple(sorted({**base, "le": upper}.items()))
-            lines.append(f"{format_key(name + '_bucket', le_items)} {cum}")
-        lines.append(f"{format_key(name + '_sum', items)} {snap['sum']:g}")
-        lines.append(f"{format_key(name + '_count', items)} {snap['count']}")
+            lines.append(f"{_prom_key(name + '_bucket', le_items)} {cum}")
+        lines.append(f"{_prom_key(name + '_sum', items)} {snap['sum']:g}")
+        lines.append(f"{_prom_key(name + '_count', items)} {snap['count']}")
     return "\n".join(lines) + "\n"
 
 

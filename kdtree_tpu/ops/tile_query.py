@@ -532,6 +532,7 @@ def morton_knn_tiled(
     cmax: int = DEFAULT_CMAX,
     seeds: int = DEFAULT_SEEDS,
     use_pallas: bool | None = None,
+    plan: TiledPlan | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact batched k-NN via Hilbert-sorted query tiles and dense scans.
 
@@ -545,7 +546,11 @@ def morton_knn_tiled(
     candidate set overflows — geometry-driven, rare for sane tiles.
     ``use_pallas=None`` enables the fused scan kernel
     (:mod:`kdtree_tpu.pallas.scan_knn`) on TPU backends and uses the XLA
-    scan elsewhere.
+    scan elsewhere. A caller that already resolved a plan (the serving
+    batcher inspects ``plan.source`` for its warm/cold metrics before
+    dispatching) passes it via ``plan`` so the store is consulted — and
+    its hit/miss counters advanced — exactly once; the tile/cmax/seeds/
+    use_pallas knob arguments are ignored then.
     """
     Q, D = queries.shape
     k = min(k, tree.n_real)
@@ -555,10 +560,11 @@ def morton_knn_tiled(
             jnp.zeros((0, k), jnp.int32),
         )
     obs.count_query("tiled", Q)
-    plan = plan_tiled(
-        Q, D, tree.n_real, tree.num_buckets, tree.bucket_size, k,
-        tile, cmax, seeds, use_pallas,
-    )
+    if plan is None:
+        plan = plan_tiled(
+            Q, D, tree.n_real, tree.num_buckets, tree.bucket_size, k,
+            tile, cmax, seeds, use_pallas,
+        )
     from kdtree_tpu import tuning
 
     feedback = tuning.feedback_for(plan)

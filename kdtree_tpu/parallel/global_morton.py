@@ -86,6 +86,42 @@ def _count_sharded_query(engine: str, q: int, devices: int) -> None:
 DEFAULT_SAMPLES = 256
 DEFAULT_SLACK = 2.0
 
+
+def _resolve_slack(
+    slack: float | None, dim: int, n: int, bucket_cap: int, p: int,
+) -> float:
+    """Size the sample-sort exchange capacity factor.
+
+    An explicit ``slack`` always wins (the overflow error names it as the
+    remedy — an operator's retry must not be second-guessed). Otherwise
+    the warm plan-store profiles are consulted
+    (:func:`kdtree_tpu.tuning.occupancy_p90_hint`): a recorded
+    ``occupancy_p90`` at bucket capacity means previous builds of this
+    shape packed buckets full — the clustered-data signature whose
+    concentrated (src, dst) routes are exactly what overflows the
+    exchange — so the factor scales up to 2x as the observed p90
+    approaches capacity. Guarded on both sides: never below the static
+    ``DEFAULT_SLACK`` floor (a cold store changes nothing) and never
+    above ``max(P, floor)`` (at slack >= P the per-pair capacity already
+    admits a shard's every row). Profiles are advisory — the overflow
+    counter still refuses a partial index either way."""
+    if slack is not None:
+        return float(slack)
+    sized = DEFAULT_SLACK
+    from kdtree_tpu import tuning
+
+    occ = tuning.occupancy_p90_hint(dim, n, bucket_cap, p)
+    if occ is not None:
+        sized = max(DEFAULT_SLACK,
+                    DEFAULT_SLACK * 2.0 * float(occ) / float(bucket_cap))
+        sized = min(sized, max(float(p), DEFAULT_SLACK))
+        if sized > DEFAULT_SLACK:
+            obs.get_registry().counter(
+                "kdtree_slack_occupancy_sized_total"
+            ).inc()
+    obs.get_registry().gauge("kdtree_exchange_slack").set(sized)
+    return sized
+
 # canonical definition moved to utils.guards (ops/ builds need it too and
 # cannot import parallel/); the old private name stays importable — it is
 # the spelling ensemble.py and the regression tests grew around
@@ -465,7 +501,7 @@ def build_global_morton(
     num_points: int,
     mesh: Mesh | None = None,
     bucket_cap: int = 128,
-    slack: float = DEFAULT_SLACK,
+    slack: float | None = None,
     distribution: str = "uniform",
 ) -> GlobalMortonForest:
     """Build the scale-mode index: shard-local generation, ONE all_to_all
@@ -474,7 +510,11 @@ def build_global_morton(
     row stream ("uniform" | "clustered" — the Gaussian-mixture stress
     shape; oracle view is ``generate_points_shard_clustered(seed, d, 0, n)``).
 
-    Raises RuntimeError on sample-sort capacity overflow (retry with higher
+    ``slack=None`` sizes the exchange capacity automatically: the static
+    ``DEFAULT_SLACK`` floor, scaled up when a warm plan-store profile's
+    recorded ``occupancy_p90`` says this shape packs buckets full (see
+    :func:`_resolve_slack`); an explicit value always wins. Raises
+    RuntimeError on sample-sort capacity overflow (retry with higher
     ``slack``).
     """
     _check_rows_fit_i32(num_points, "generative problem")
@@ -483,6 +523,7 @@ def build_global_morton(
 
         mesh = make_mesh()
     p = mesh.shape[SHARD_AXIS]
+    slack = _resolve_slack(slack, dim, num_points, bucket_cap, p)
     rows = -(-num_points // p)  # ceil; past-N rows masked in _build_local
     bits = default_bits(dim)
     cap = max(1, int(rows / p * slack))
@@ -630,7 +671,7 @@ def build_global_morton_from_points(
     points,
     mesh: Mesh | None = None,
     bucket_cap: int = 128,
-    slack: float = DEFAULT_SLACK,
+    slack: float | None = None,
 ) -> GlobalMortonForest:
     """Build the scale-mode index over USER data instead of a seeded stream.
 
@@ -643,7 +684,9 @@ def build_global_morton_from_points(
     in the same streaming pass and shared by every device.
 
     Raises RuntimeError on sample-sort capacity overflow (retry with higher
-    ``slack``) and ValueError on non-finite input rows.
+    ``slack``) and ValueError on non-finite input rows. ``slack=None``
+    auto-sizes from warm occupancy profiles exactly as
+    :func:`build_global_morton` does.
     """
     n, dim = points.shape
     if n < 1:
@@ -654,6 +697,7 @@ def build_global_morton_from_points(
 
         mesh = make_mesh()
     p = mesh.shape[SHARD_AXIS]
+    slack = _resolve_slack(slack, dim, n, bucket_cap, p)
     rows = -(-n // p)
     bits = default_bits(dim)
     pts_sh, gid_sh, lo, hi = _stream_rows_to_mesh(points, mesh, rows)
@@ -992,7 +1036,7 @@ def global_morton_knn(
     k: int = 1,
     mesh: Mesh | None = None,
     bucket_cap: int = 128,
-    slack: float = DEFAULT_SLACK,
+    slack: float | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN over a problem too big for one device: shard-local
     generation, one all_to_all code-range partition, per-device Morton trees,

@@ -1,0 +1,57 @@
+"""kdtree_tpu.serve — the online k-NN serving subsystem.
+
+The reference endpoint is a batch harness: build once, answer a fixed
+query file, exit. The ROADMAP north star is a process that serves heavy
+live traffic — which is a different organ, not a bigger batch. This
+package is that organ (see ``docs/SERVING.md``):
+
+- :mod:`~kdtree_tpu.serve.server` — a stdlib ``ThreadingHTTPServer``
+  exposing ``POST /v1/knn`` (JSON queries in, ids + distances out),
+  ``GET /healthz`` (readiness: index loaded + warmup compiled) and
+  ``GET /metrics`` (the Prometheus text exposition of the whole obs
+  registry — closing the ROADMAP scrape-endpoint item);
+- :mod:`~kdtree_tpu.serve.batcher` — micro-batching: concurrent requests
+  coalesce into one padded batch whose row count is pow2-bucketed to
+  match the ``tuning/`` plan-store signature quantization, so
+  steady-state batches dispatch on warm plans with zero cap-settling
+  probes or recompiles;
+- :mod:`~kdtree_tpu.serve.admission` — bounded queue depth with
+  429-style shedding, per-request deadlines, and the request/future
+  handshake between handler threads and the batch worker;
+- :mod:`~kdtree_tpu.serve.lifecycle` — startup (load or build the
+  index, warmup-compile one dummy batch per pow2 bucket, install the
+  JAX runtime listeners), the engine facade the batcher dispatches
+  through, the brute-force degradation path, and graceful shutdown
+  (stop accepting, drain in-flight batches, flush the telemetry
+  sidecar).
+
+Design rule inherited from the rest of the codebase: exactness is never
+load-dependent. Shedding and deadline degradation change *latency* and
+*engine* (the brute-force fallback is exact too), never answers; an
+overloaded server says 429, it does not approximate.
+"""
+
+from __future__ import annotations
+
+from kdtree_tpu.serve.admission import (
+    AdmissionQueue,
+    PendingRequest,
+    QueueClosedError,
+    QueueFullError,
+)
+from kdtree_tpu.serve.batcher import MicroBatcher
+from kdtree_tpu.serve.lifecycle import ServeEngine, ServeState, build_state
+from kdtree_tpu.serve.server import KnnServer, make_server
+
+__all__ = [
+    "AdmissionQueue",
+    "KnnServer",
+    "MicroBatcher",
+    "PendingRequest",
+    "QueueClosedError",
+    "QueueFullError",
+    "ServeEngine",
+    "ServeState",
+    "build_state",
+    "make_server",
+]

@@ -1,0 +1,195 @@
+"""Admission control: the bounded queue between HTTP handlers and the
+batch worker.
+
+Load shedding happens HERE, at the door, not in the engine: a query row
+admitted past capacity would not fail — it would wait, and a queue that
+only ever waits converts overload into unbounded latency for every
+client instead of a crisp 429 for the marginal one. Depth is counted in
+query ROWS (the unit of engine work), not requests, so one 1024-row
+request and 1024 singletons cost the same admission budget.
+
+The handshake: each handler thread submits a :class:`PendingRequest`
+and blocks on its event; the batch worker pops, coalesces, dispatches,
+and fulfills. Deadlines are carried as absolute monotonic times — the
+worker checks them at dispatch, where the remedy (the brute-force
+degradation path, :mod:`kdtree_tpu.serve.lifecycle`) is cheap to apply
+per straggler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from kdtree_tpu import obs
+
+
+class QueueFullError(Exception):
+    """Admission refused: queue depth at capacity (HTTP 429)."""
+
+
+class QueueClosedError(Exception):
+    """Admission refused: the server is shutting down (HTTP 503)."""
+
+
+class PendingRequest:
+    """One in-flight k-NN request: inputs, the completion event the
+    handler thread waits on, and the result slots the worker fills."""
+
+    __slots__ = (
+        "queries", "k", "deadline", "enqueued_at", "dispatched_at",
+        "event", "d2", "ids", "degraded", "error",
+    )
+
+    def __init__(
+        self, queries: np.ndarray, k: int,
+        deadline: Optional[float] = None,
+    ) -> None:
+        self.queries = queries  # f32[q, D], validated by the handler
+        self.k = k
+        self.deadline = deadline  # absolute time.monotonic(), or None
+        self.enqueued_at = time.monotonic()
+        self.dispatched_at: Optional[float] = None
+        self.event = threading.Event()
+        self.d2: Optional[np.ndarray] = None
+        self.ids: Optional[np.ndarray] = None
+        self.degraded: Optional[str] = None  # None | "deadline" | "oversized"
+        self.error: Optional[str] = None
+
+    @property
+    def rows(self) -> int:
+        return int(self.queries.shape[0])
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+    def fulfill(
+        self, d2: np.ndarray, ids: np.ndarray,
+        degraded: Optional[str] = None,
+    ) -> None:
+        self.d2, self.ids, self.degraded = d2, ids, degraded
+        self.event.set()
+
+    def fail(self, message: str) -> None:
+        self.error = message
+        self.event.set()
+
+
+class AdmissionQueue:
+    """Bounded FIFO of :class:`PendingRequest` with row-counted depth.
+
+    ``submit`` is the admission gate (raises :class:`QueueFullError` /
+    :class:`QueueClosedError`); ``pop``/``pop_wait`` feed the batch
+    worker; ``push_front`` returns an over-coalesced pop without losing
+    FIFO order. Closing stops admission but NOT draining — accepted
+    requests are a promise the shutdown path keeps.
+    """
+
+    def __init__(self, max_rows: int) -> None:
+        if max_rows < 1:
+            raise ValueError(f"queue depth must be >= 1 rows, got {max_rows}")
+        self.max_rows = int(max_rows)
+        self._items: deque = deque()
+        self._rows = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        reg = obs.get_registry()
+        self._depth = reg.gauge("kdtree_serve_queue_depth")
+        self._shed = reg.counter("kdtree_serve_shed_total")
+
+    @property
+    def rows(self) -> int:
+        return self._rows
+
+    def submit(self, req: PendingRequest) -> None:
+        with self._cond:
+            if self._closed:
+                raise QueueClosedError("server is shutting down")
+            if self._rows + req.rows > self.max_rows:
+                self._shed.inc()
+                raise QueueFullError(
+                    f"admission queue at capacity ({self._rows}/"
+                    f"{self.max_rows} rows)"
+                )
+            self._items.append(req)
+            self._rows += req.rows
+            self._depth.set(self._rows)
+            self._cond.notify()
+
+    def reserve(self, rows: int) -> int:
+        """Charge ``rows`` against the admission budget WITHOUT enqueueing
+        — the oversized degradation path runs outside the batch queue but
+        must not escape shedding: unbounded concurrent brute-force scans
+        are exactly the overload the 429 gate exists to refuse. The charge
+        is clamped to the whole budget so a single request larger than the
+        budget is still admissible on an idle server (taking everything).
+        Returns the charged amount; pass it back to :meth:`release`."""
+        with self._cond:
+            if self._closed:
+                raise QueueClosedError("server is shutting down")
+            charge = min(int(rows), self.max_rows)
+            if self._rows + charge > self.max_rows:
+                self._shed.inc()
+                raise QueueFullError(
+                    f"admission queue at capacity ({self._rows}/"
+                    f"{self.max_rows} rows)"
+                )
+            self._rows += charge
+            self._depth.set(self._rows)
+            return charge
+
+    def release(self, charge: int) -> None:
+        """Return a :meth:`reserve` charge to the budget."""
+        with self._cond:
+            self._rows -= charge
+            self._depth.set(self._rows)
+            self._cond.notify_all()
+
+    def pop(self) -> Optional[PendingRequest]:
+        """Immediately pop the oldest request, or None when empty."""
+        with self._cond:
+            if not self._items:
+                return None
+            req = self._items.popleft()
+            self._rows -= req.rows
+            self._depth.set(self._rows)
+            return req
+
+    def pop_wait(self, timeout: float) -> Optional[PendingRequest]:
+        """Pop the oldest request, waiting up to ``timeout`` seconds for
+        one to arrive; None on timeout (or an empty closed queue)."""
+        end = time.monotonic() + timeout
+        with self._cond:
+            while not self._items:
+                remaining = end - time.monotonic()
+                if remaining <= 0 or (self._closed and not self._items):
+                    return None
+                self._cond.wait(remaining)
+            req = self._items.popleft()
+            self._rows -= req.rows
+            self._depth.set(self._rows)
+            return req
+
+    def push_front(self, req: PendingRequest) -> None:
+        """Return a popped request to the head (it did not fit the batch
+        being assembled). Never sheds: the rows were already admitted."""
+        with self._cond:
+            self._items.appendleft(req)
+            self._rows += req.rows
+            self._depth.set(self._rows)
+            self._cond.notify()
+
+    def close(self) -> None:
+        """Stop admitting; wake any waiting worker so it can drain."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,215 @@
+"""Micro-batching: coalesce concurrent requests into warm-plan batches.
+
+The tiled engine's unit of efficiency is the batch — its launch plans
+are keyed by a pow2-quantized row count (:mod:`kdtree_tpu.tuning`), and
+every distinct padded shape costs one XLA compile. So the worker here
+does two things at once:
+
+1. **Coalesce**: pop the oldest admitted request, then keep absorbing
+   arrivals until ``max_batch`` rows or ``max_wait_ms`` elapse —
+   concurrency is converted into batch width instead of queue depth.
+2. **Quantize**: pad the coalesced rows up to the next power of two
+   (floor ``min_bucket``). The padded row count IS the plan-store
+   signature's Q bucket, so the steady state cycles through a handful
+   of shapes, every one of them compiled once and planned warm —
+   ``drive_batches(..., settle_first=False)`` with zero cap-settling
+   probes and zero recompiles.
+
+Requests whose deadline expired while queued are split off and answered
+through the engine's brute-force degradation path (exact, flagged
+``degraded`` — see :mod:`kdtree_tpu.serve.lifecycle`), so one slow burst
+degrades its stragglers instead of erroring them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from kdtree_tpu import obs
+from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
+from kdtree_tpu.tuning.store import _pow2_ceil
+
+DEFAULT_MAX_BATCH = 1024
+DEFAULT_MAX_WAIT_MS = 2.0
+MIN_BUCKET = 8  # smallest padded batch: sub-8-row traffic shares one shape
+
+# serving latencies are ms-scale; the generic span buckets start too coarse
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+_BATCH_ROW_BUCKETS = tuple(float(1 << i) for i in range(13))  # 1..4096
+_BATCH_REQ_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+def batch_bucket(rows: int, max_batch: int, min_bucket: int = MIN_BUCKET) -> int:
+    """The padded row count a ``rows``-row batch dispatches at: pow2-ceil
+    with a floor, capped at ``max_batch`` (itself pow2 by construction,
+    so the cap never truncates below ``rows``)."""
+    return min(_pow2_ceil(max(rows, min_bucket)), max_batch)
+
+
+class MicroBatcher:
+    """The batch worker: one daemon-less thread draining an
+    :class:`~kdtree_tpu.serve.admission.AdmissionQueue` through a
+    :class:`~kdtree_tpu.serve.lifecycle.ServeEngine`."""
+
+    def __init__(
+        self,
+        engine,
+        queue: AdmissionQueue,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
+        min_bucket: int = MIN_BUCKET,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.engine = engine
+        self.queue = queue
+        # pow2: every bucket (including the cap itself) is then a plan-
+        # signature quantum, and batch_bucket can never exceed it for an
+        # admitted row count
+        self.max_batch = _pow2_ceil(max_batch)
+        self.max_wait = max(float(max_wait_ms), 0.0) / 1e3
+        self.min_bucket = min_bucket
+        self._thread: Optional[threading.Thread] = None
+        reg = obs.get_registry()
+        self._lat = {
+            phase: reg.histogram(
+                "kdtree_serve_request_seconds", buckets=_LATENCY_BUCKETS,
+                labels={"phase": phase},
+            )
+            for phase in ("queue", "dispatch", "total")
+        }
+        self._batch_rows = reg.histogram(
+            "kdtree_serve_batch_rows", buckets=_BATCH_ROW_BUCKETS
+        )
+        self._batch_reqs = reg.histogram(
+            "kdtree_serve_batch_requests", buckets=_BATCH_REQ_BUCKETS
+        )
+        self._batches = {
+            temp: reg.counter(
+                "kdtree_serve_batches_total", labels={"plan_cache": temp}
+            )
+            for temp in ("warm", "cold")
+        }
+        self._deadline = reg.counter("kdtree_serve_deadline_timeouts_total")
+        self._degraded = {
+            reason: reg.counter(
+                "kdtree_serve_degraded_total", labels={"reason": reason}
+            )
+            for reason in ("deadline", "oversized")
+        }
+        self._errors = reg.counter("kdtree_serve_batch_errors_total")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._worker, name="kdtree-serve-batcher"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Graceful: close admission, drain every accepted request, join.
+        Accepted requests always get an answer — shedding happens at the
+        admission gate or not at all."""
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- worker -------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            first = self.queue.pop_wait(0.05)
+            if first is None:
+                # exit gates on the QUEUE's closed flag, not a separate
+                # stop flag: close() happens-before any post-close submit
+                # raises, so a request this check can't see was never
+                # admitted — a separate flag set before close() would let
+                # one slip into the gap and wait out its timeout unserved
+                if self.queue.closed and self.queue.rows == 0:
+                    return
+                continue
+            self._dispatch(self._collect(first))
+
+    def _collect(self, first: PendingRequest) -> List[PendingRequest]:
+        """Absorb arrivals behind ``first`` until the batch is full or
+        ``max_wait`` has elapsed since coalescing began."""
+        batch = [first]
+        rows = first.rows
+        t_end = time.monotonic() + self.max_wait
+        while rows < self.max_batch:
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                break
+            nxt = self.queue.pop_wait(remaining)
+            if nxt is None:
+                break
+            if rows + nxt.rows > self.max_batch:
+                self.queue.push_front(nxt)  # keeps FIFO; next batch leads with it
+                break
+            batch.append(nxt)
+            rows += nxt.rows
+        return batch
+
+    def _dispatch(self, batch: List[PendingRequest]) -> None:
+        now = time.monotonic()
+        for req in batch:
+            req.dispatched_at = now
+            self._lat["queue"].observe(now - req.enqueued_at)
+        live = [r for r in batch if not r.expired(now)]
+        late = [r for r in batch if r.expired(now)]
+        if live:
+            self._run_batch(live)
+        for req in late:
+            self._deadline.inc()
+            self._run_fallback(req, reason="deadline")
+
+    def _run_batch(self, live: List[PendingRequest]) -> None:
+        rows = sum(r.rows for r in live)
+        bucket = batch_bucket(rows, self.max_batch, self.min_bucket)
+        q = np.concatenate([r.queries for r in live], axis=0)
+        if bucket > rows:
+            # repeat the last row: harmless real coordinates, results are
+            # sliced away — same trick as the tiled engine's own qpad
+            pad = np.broadcast_to(q[-1], (bucket - rows, q.shape[1]))
+            q = np.concatenate([q, pad], axis=0)
+        try:
+            d2, ids, source = self.engine.knn_batch(q)
+        except Exception as e:
+            self._errors.inc()
+            for r in live:
+                r.fail(f"batch dispatch failed: {e!r}")
+            return
+        done = time.monotonic()
+        self._batches["warm" if source == "warm" else "cold"].inc()
+        self._batch_rows.observe(rows)
+        self._batch_reqs.observe(len(live))
+        off = 0
+        for r in live:
+            r.fulfill(d2[off:off + r.rows, :r.k], ids[off:off + r.rows, :r.k])
+            off += r.rows
+            self._lat["dispatch"].observe(done - r.dispatched_at)
+            self._lat["total"].observe(done - r.enqueued_at)
+
+    def _run_fallback(self, req: PendingRequest, reason: str) -> None:
+        """Answer one straggler through the exact brute-force path."""
+        self._degraded[reason].inc()
+        try:
+            d2, ids = self.engine.fallback_knn(req.queries, req.k)
+        except Exception as e:
+            self._errors.inc()
+            req.fail(f"fallback dispatch failed: {e!r}")
+            return
+        done = time.monotonic()
+        req.fulfill(d2, ids, degraded=reason)
+        if req.dispatched_at is not None:
+            self._lat["dispatch"].observe(done - req.dispatched_at)
+        self._lat["total"].observe(done - req.enqueued_at)

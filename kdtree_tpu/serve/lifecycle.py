@@ -1,0 +1,223 @@
+"""Serving lifecycle: startup, the engine facade, degradation, shutdown.
+
+Startup does the expensive, failure-prone things ONCE, before the first
+request can observe them: load the checkpoint (or build from points /
+the seeded stream), install the JAX runtime listeners (so a recompile
+in steady state shows up as a growing counter on ``/metrics``), and
+warmup-compile one dummy batch per pow2 row bucket. Warmup is what makes
+``/healthz`` honest — a server that reports ready and then spends 30 s
+in XLA on the first request is not ready — and it doubles as the plan
+seeder: each warmup batch settles its bucket's launch plan into the
+plan store, so even the first real batch of a shape can dispatch warm.
+
+The engine facade is the ONLY place serving code touches jax: one tiled
+dispatch per micro-batch (plan resolved first, so the batcher can label
+the batch warm/cold without a second store lookup), and the brute-force
+fallback for degraded stragglers. Both materialize their results here —
+the response boundary — so the batcher and HTTP layers stay pure host
+code.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from kdtree_tpu import obs
+
+DEFAULT_REQUEST_TIMEOUT_S = 60.0
+
+
+class ServeEngine:
+    """The jax-touching facade the batch worker dispatches through."""
+
+    def __init__(self, tree, k: int) -> None:
+        from kdtree_tpu.ops.morton import MortonTree
+
+        if not isinstance(tree, MortonTree):
+            raise TypeError(
+                f"serving needs a MortonTree index, got {type(tree).__name__}"
+            )
+        self.tree = tree
+        self.k = min(int(k), tree.n_real)
+        # flat bucket storage for the brute-force degradation path: padding
+        # rows carry +inf coords (never selected while k <= n_real) and map
+        # to id -1 through the gid table
+        self._flat_pts = tree.bucket_pts.reshape(-1, tree.dim)
+        self._flat_gid = tree.bucket_gid.reshape(-1)
+
+    def knn_batch(
+        self, queries: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray, str]:
+        """Exact k-NN for one padded micro-batch via the tiled engine.
+
+        Returns host arrays (d2 f32[Q, k], ids i32[Q, k]) plus the plan
+        source ("warm" | "heuristic" | "explicit") — resolved here, once,
+        so the store's hit/miss counters advance exactly once per batch
+        and the batcher can label its warm/cold metric from the same
+        lookup the dispatch used."""
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops.tile_query import morton_knn_tiled, plan_tiled
+
+        t = self.tree
+        Q, D = queries.shape
+        plan = plan_tiled(Q, D, t.n_real, t.num_buckets, t.bucket_size,
+                          self.k)
+        with obs.span("serve.batch", sync=False, q=Q, plan=plan.source):
+            d2, gid = morton_knn_tiled(
+                t, jnp.asarray(queries), k=self.k, plan=plan
+            )
+            # response materialization boundary: the batch is complete and
+            # per-request slices leave as JSON from here
+            out = (np.asarray(d2), np.asarray(gid))  # kdt-lint: disable=KDT201 response boundary: the batch result must be host-materialized to answer HTTP requests
+        return out[0], out[1], plan.source
+
+    def fallback_knn(
+        self, queries: np.ndarray, k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The degradation path: exact brute force over the flat bucket
+        storage — no tiles, no plans, no batch coupling. Slower per row,
+        but immune to batch-shape compiles: the right engine for an
+        oversized one-off or an already-late straggler."""
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops import bruteforce
+
+        k = min(int(k), self.tree.n_real)
+        d2, idx = bruteforce.knn(self._flat_pts, jnp.asarray(queries), k=k)
+        ids = jnp.where(idx >= 0, self._flat_gid[jnp.maximum(idx, 0)], -1)
+        return (
+            np.asarray(d2),  # kdt-lint: disable=KDT201 response boundary: degraded answers are host-materialized here
+            np.asarray(ids),  # kdt-lint: disable=KDT201 response boundary: degraded answers are host-materialized here
+        )
+
+
+class ServeState:
+    """Everything the HTTP layer needs: the engine, the knobs, readiness."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        max_batch: int,
+        min_bucket: int,
+        request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+        meta: Optional[dict] = None,
+    ) -> None:
+        self.engine = engine
+        self.max_batch = max_batch
+        self.min_bucket = min_bucket
+        self.request_timeout_s = request_timeout_s
+        self.meta = dict(meta or {})
+        self._ready = threading.Event()
+        self._ready_gauge = obs.get_registry().gauge("kdtree_serve_ready")
+        self._ready_gauge.set(0)
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def warmup_buckets(self) -> List[int]:
+        from kdtree_tpu.serve.batcher import batch_bucket
+
+        lo = batch_bucket(1, self.max_batch, self.min_bucket)
+        buckets = []
+        b = lo
+        while b < self.max_batch:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_batch)
+        return buckets
+
+    def warmup(self, buckets: Optional[List[int]] = None) -> None:
+        """Compile one dummy batch per pow2 bucket (and seed its plan into
+        the store), then flip readiness. ``buckets`` narrows the ladder —
+        tests warm a single shape instead of the full ladder."""
+        if buckets is None:
+            buckets = self.warmup_buckets()
+        t = self.engine.tree
+        lo = np.asarray(t.node_lo[0], dtype=np.float64)
+        hi = np.asarray(t.node_hi[0], dtype=np.float64)
+        lo = np.where(np.isfinite(lo), lo, 0.0)
+        hi = np.where(np.isfinite(hi) & (hi > lo), hi, lo + 1.0)
+        with obs.span("serve.warmup", buckets=len(buckets)):
+            for b in buckets:
+                # dummy rows spread across the root box: real coordinates,
+                # representative tile geometry, deterministic
+                frac = (np.arange(b, dtype=np.float64)[:, None] + 0.5) / b
+                q = (lo[None, :] + frac * (hi - lo)[None, :]).astype(
+                    np.float32
+                )
+                self.engine.knn_batch(q)
+        obs.get_registry().gauge("kdtree_serve_warmup_buckets").set(
+            len(buckets)
+        )
+        self._ready.set()
+        self._ready_gauge.set(1)
+
+
+def tree_for_serving(tree):
+    """Adapt a checkpointed index to the MortonTree the tiled serving path
+    needs: Morton trees serve as-is; a classic KDTree serves through its
+    Morton view (same storage trick as the CLI's dense dispatch). Other
+    kinds fail crisply — rebuild with ``--engine morton``."""
+    from kdtree_tpu.models.tree import KDTree
+    from kdtree_tpu.ops.morton import MortonTree, morton_view
+
+    if isinstance(tree, MortonTree):
+        return tree
+    if isinstance(tree, KDTree):
+        return morton_view(points=tree.points)
+    raise TypeError(
+        f"cannot serve a {type(tree).__name__} checkpoint: the serving "
+        "path needs a Morton(-viewable) tree — rebuild with "
+        "`kdtree-tpu --engine morton build`"
+    )
+
+
+def build_state(
+    tree=None,
+    points: Optional[np.ndarray] = None,
+    problem: Optional[tuple] = None,
+    k: int = 1,
+    max_batch: int = 1024,
+    min_bucket: Optional[int] = None,
+    request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+    meta: Optional[dict] = None,
+    install_listeners: bool = True,
+) -> ServeState:
+    """Assemble a ready-to-warmup :class:`ServeState` from exactly one
+    index source: a loaded ``tree``, a materialized ``points`` array, or
+    a seeded ``problem`` (seed, dim, n) on the threefry row stream."""
+    from kdtree_tpu.serve.batcher import MIN_BUCKET
+    from kdtree_tpu.tuning.store import _pow2_ceil
+
+    if sum(x is not None for x in (tree, points, problem)) != 1:
+        raise ValueError("need exactly one of tree=, points=, problem=")
+    if install_listeners:
+        from kdtree_tpu.obs import jaxrt
+
+        jaxrt.install()
+    if tree is not None:
+        tree = tree_for_serving(tree)
+    else:
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops.morton import build_morton
+
+        if points is None:
+            from kdtree_tpu.ops.generate import generate_points_rowwise
+
+            seed, dim, n = (int(x) for x in problem[:3])
+            points = generate_points_rowwise(seed, dim, n)
+        tree = build_morton(jnp.asarray(points))
+    engine = ServeEngine(tree, k)
+    return ServeState(
+        engine,
+        max_batch=_pow2_ceil(max_batch),
+        min_bucket=MIN_BUCKET if min_bucket is None else min_bucket,
+        request_timeout_s=request_timeout_s,
+        meta=meta,
+    )

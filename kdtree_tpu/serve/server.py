@@ -1,0 +1,351 @@
+"""The HTTP layer: stdlib ``ThreadingHTTPServer``, three endpoints.
+
+- ``POST /v1/knn`` — JSON ``{"queries": [[x, y, ...], ...], "k": int?,
+  "deadline_ms": number?}`` in; ``{"ids": [[...]], "distances": [[...]],
+  "k": int, "degraded": null | reason}`` out. Distances are Euclidean
+  (sqrt of the engines' d2, float64 — the same transform the protocol
+  lines use), ids are the original point rows.
+- ``GET /healthz`` — 200 once the index is loaded and warmup compiles
+  are done, 503 (with ``Retry-After``) while warming.
+- ``GET /metrics`` — the Prometheus text exposition of the whole obs
+  registry (deferred device fetches flushed first), closing the ROADMAP
+  scrape-endpoint item.
+
+Handler threads are glue: validate, admit, block on the request future,
+serialize. All engine work happens in the batch worker — except the
+oversized-request degradation, which runs brute force right here rather
+than letting one huge request distort every micro-batch behind it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from kdtree_tpu import obs
+from kdtree_tpu.serve.admission import (
+    AdmissionQueue,
+    PendingRequest,
+    QueueClosedError,
+    QueueFullError,
+)
+from kdtree_tpu.serve.batcher import (
+    DEFAULT_MAX_WAIT_MS,
+    MicroBatcher,
+)
+from kdtree_tpu.serve.lifecycle import ServeState
+
+MAX_BODY_BYTES = 64 << 20  # a [max_batch, D] float batch is far smaller
+
+
+def _count_request(status: str) -> None:
+    obs.get_registry().counter(
+        "kdtree_serve_requests_total", labels={"status": status}
+    ).inc()
+
+
+class KnnRequestHandler(BaseHTTPRequestHandler):
+    """Request glue. Methods of this class legitimately materialize
+    device results into JSON — the KDT201 hot-path rule exempts
+    BaseHTTPRequestHandler subclasses by detection for exactly this
+    boundary (docs/STATIC_ANALYSIS.md)."""
+
+    protocol_version = "HTTP/1.1"
+    server_version = "kdtree-tpu-serve/1.0"
+    # idle keep-alive connections park their handler thread in readline();
+    # with daemon_threads=False server_close() would join that thread
+    # FOREVER and a persistent scraper (Prometheus' default) would wedge
+    # the SIGTERM drain. The socket timeout bounds the idle wait: readline
+    # raises, handle_one_request closes the connection, shutdown completes
+    # within ~this many seconds.
+    timeout = 5
+
+    # the default handler logs every request line to stderr; serving
+    # telemetry lives in the metrics registry instead
+    def log_message(self, format: str, *args) -> None:
+        pass
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send_bytes(
+        self, code: int, body: bytes, content_type: str,
+        extra_headers: Optional[dict] = None,
+    ) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for key, val in (extra_headers or {}).items():
+            self.send_header(key, val)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(
+        self, code: int, obj: dict, extra_headers: Optional[dict] = None,
+    ) -> None:
+        self._send_bytes(
+            code, (json.dumps(obj) + "\n").encode("utf-8"),
+            "application/json", extra_headers,
+        )
+
+    # -- GET ----------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            state: ServeState = self.server.state
+            if state.ready:
+                self._send_json(200, {
+                    "status": "ok",
+                    "n": state.engine.tree.n_real,
+                    "dim": state.engine.tree.dim,
+                    "k_max": state.engine.k,
+                    "max_batch": state.max_batch,
+                })
+            else:
+                self._send_json(503, {"status": "warming"},
+                                extra_headers={"Retry-After": "1"})
+            return
+        if path == "/metrics":
+            from kdtree_tpu.obs.export import prometheus_text
+
+            obs.flush()  # run deferred device fetches before snapshotting
+            self._send_bytes(
+                200, prometheus_text().encode("utf-8"),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        self._send_json(404, {"error": f"no such path: {path}"})
+
+    # -- POST ---------------------------------------------------------------
+
+    def do_POST(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path != "/v1/knn":
+            self._send_json(404, {"error": f"no such path: {path}"})
+            return
+        parsed = self._parse_knn_body()
+        if parsed is None:
+            return  # error response already sent
+        queries, k, deadline_s = parsed
+        state: ServeState = self.server.state
+        if not state.ready:
+            _count_request("unready")
+            self._send_json(503, {"error": "index is still warming up"},
+                            extra_headers={"Retry-After": "1"})
+            return
+        if queries.shape[0] > state.max_batch:
+            # oversized: one request bigger than any micro-batch. Answer it
+            # HERE via brute force — exact, flagged degraded — instead of
+            # erroring or letting it distort the batch pipeline. The rows
+            # still charge the admission budget (reserve/release): the
+            # most expensive requests must be the FIRST the 429 gate can
+            # refuse, not the only ones it cannot see.
+            try:
+                charge = self.server.queue.reserve(queries.shape[0])
+            except QueueFullError:
+                _count_request("shed")
+                self._send_json(429, {"error": "overloaded: admission "
+                                               "queue at capacity"},
+                                extra_headers={"Retry-After": "1"})
+                return
+            except QueueClosedError:
+                _count_request("unready")
+                self._send_json(503, {"error": "server is shutting down"})
+                return
+            obs.get_registry().counter(
+                "kdtree_serve_degraded_total", labels={"reason": "oversized"}
+            ).inc()
+            try:
+                d2, ids = state.engine.fallback_knn(queries, k)
+            except Exception as e:
+                _count_request("error")
+                self._send_json(500, {"error": f"engine failure: {e!r}"})
+                return
+            finally:
+                self.server.queue.release(charge)
+            _count_request("degraded")
+            self._send_json(
+                200, self._result_json(d2, ids, k, degraded="oversized")
+            )
+            return
+        import time as _time
+
+        deadline = (_time.monotonic() + deadline_s) if deadline_s else None
+        req = PendingRequest(queries, k, deadline)
+        try:
+            self.server.queue.submit(req)
+        except QueueFullError:
+            _count_request("shed")
+            self._send_json(429, {"error": "overloaded: admission queue "
+                                           "at capacity"},
+                            extra_headers={"Retry-After": "1"})
+            return
+        except QueueClosedError:
+            _count_request("unready")
+            self._send_json(503, {"error": "server is shutting down"})
+            return
+        if not req.event.wait(timeout=state.request_timeout_s):
+            _count_request("timeout")
+            self._send_json(504, {"error": "request timed out in service"})
+            return
+        if req.error is not None:
+            _count_request("error")
+            self._send_json(500, {"error": req.error})
+            return
+        _count_request("degraded" if req.degraded else "ok")
+        self._send_json(
+            200, self._result_json(req.d2, req.ids, k, degraded=req.degraded)
+        )
+
+    def _parse_knn_body(
+        self,
+    ) -> Optional[Tuple[np.ndarray, int, Optional[float]]]:
+        """Validated (queries f32[q, D], k, deadline seconds | None), or
+        None with the 4xx already written. Every rejection names what was
+        wrong — the same crisp-contract idea as the CLI's loaders."""
+        state: ServeState = self.server.state
+        try:
+            length = int(self.headers.get("Content-Length", ""))
+        except ValueError:
+            self._send_json(411, {"error": "Content-Length required"})
+            return None
+        if length < 0:
+            # rfile.read(-1) would mean read-to-EOF: the handler would
+            # stall to the socket timeout and answer nothing at all
+            self._send_json(400, {"error": "Content-Length must be >= 0"})
+            return None
+        if length > MAX_BODY_BYTES:
+            self._send_json(413, {"error": f"body exceeds {MAX_BODY_BYTES} "
+                                           "bytes"})
+            return None
+        try:
+            payload = json.loads(self.rfile.read(length).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._send_json(400, {"error": "body is not valid JSON"})
+            return None
+        if not isinstance(payload, dict) or "queries" not in payload:
+            self._send_json(400, {"error": 'body must be a JSON object '
+                                           'with "queries"'})
+            return None
+        try:
+            queries = np.asarray(payload["queries"], dtype=np.float32)
+        except (TypeError, ValueError):
+            self._send_json(400, {"error": "queries must be a [q, d] "
+                                           "number array"})
+            return None
+        dim = state.engine.tree.dim
+        if queries.ndim != 2 or queries.shape[0] < 1:
+            self._send_json(400, {"error": f"queries must be non-empty "
+                                           f"[q, {dim}], got shape "
+                                           f"{queries.shape}"})
+            return None
+        if queries.shape[1] != dim:
+            self._send_json(400, {"error": f"queries are "
+                                           f"{queries.shape[1]}-D but the "
+                                           f"index is {dim}-D"})
+            return None
+        if not np.isfinite(queries).all():
+            self._send_json(400, {"error": "queries contain non-finite "
+                                           "values"})
+            return None
+        k = payload.get("k", state.engine.k)
+        if not isinstance(k, int) or isinstance(k, bool) or \
+                not (1 <= k <= state.engine.k):
+            self._send_json(400, {"error": f"k must be an int in "
+                                           f"[1, {state.engine.k}] (the "
+                                           "server's --k caps the compiled "
+                                           f"batch width), got {k!r}"})
+            return None
+        deadline_ms = payload.get("deadline_ms")
+        deadline_s: Optional[float] = None
+        if deadline_ms is not None:
+            if not isinstance(deadline_ms, (int, float)) or \
+                    isinstance(deadline_ms, bool) or deadline_ms <= 0:
+                self._send_json(400, {"error": "deadline_ms must be a "
+                                               "positive number"})
+                return None
+            deadline_s = float(deadline_ms) / 1e3
+        return queries, k, deadline_s
+
+    @staticmethod
+    def _result_json(
+        d2: np.ndarray, ids: np.ndarray, k: int, degraded: Optional[str],
+    ) -> dict:
+        dist = np.sqrt(d2[:, :k].astype(np.float64))
+        return {
+            "k": k,
+            "ids": ids[:, :k].tolist(),
+            "distances": dist.tolist(),
+            "degraded": degraded,
+        }
+
+
+class KnnServer(ThreadingHTTPServer):
+    """The serving process object: HTTP accept loop + admission queue +
+    batch worker, with an explicit graceful-stop sequence."""
+
+    # non-daemon handler threads + block_on_close: server_close() joins
+    # every in-flight handler, so stop() cannot drop an accepted request
+    daemon_threads = False
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        state: ServeState,
+        max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
+        queue_rows: Optional[int] = None,
+    ) -> None:
+        super().__init__(address, KnnRequestHandler)
+        self.state = state
+        # default admission budget: a few batches' worth of rows — deep
+        # enough to ride a burst, shallow enough that shed beats queueing
+        self.queue = AdmissionQueue(
+            queue_rows if queue_rows is not None else 4 * state.max_batch
+        )
+        self.batcher = MicroBatcher(
+            state.engine, self.queue,
+            max_batch=state.max_batch,
+            max_wait_ms=max_wait_ms,
+            min_bucket=state.min_bucket,
+        )
+        self._serve_thread: Optional[threading.Thread] = None
+
+    def start(self, warmup: bool = True, warmup_buckets=None) -> None:
+        """Start the batch worker and the accept loop, then (by default)
+        run warmup synchronously — ``/healthz`` answers 503-warming while
+        compiles run, and flips to 200 the moment this returns."""
+        self.batcher.start()
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="kdtree-serve-accept"
+        )
+        self._serve_thread.start()
+        if warmup and not self.state.ready:
+            self.state.warmup(warmup_buckets)
+
+    def stop(self) -> None:
+        """Graceful shutdown: stop accepting, drain every accepted
+        request, join the handler threads, flush deferred telemetry."""
+        self.shutdown()  # stops serve_forever; no new connections accepted
+        if self._serve_thread is not None:
+            self._serve_thread.join()
+            self._serve_thread = None
+        self.batcher.stop()  # closes admission, drains, fulfills futures
+        self.server_close()  # joins in-flight handler threads
+        obs.flush()
+
+
+def make_server(
+    state: ServeState,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
+    queue_rows: Optional[int] = None,
+) -> KnnServer:
+    """Bind (port 0 = ephemeral; read ``server_address[1]``) but do not
+    start — callers decide when the accept loop and warmup run."""
+    return KnnServer((host, port), state, max_wait_ms=max_wait_ms,
+                     queue_rows=queue_rows)

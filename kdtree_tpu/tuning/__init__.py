@@ -25,7 +25,11 @@ from __future__ import annotations
 from typing import Optional
 
 from kdtree_tpu import obs
-from kdtree_tpu.tuning.feedback import PlanFeedback, feedback_for
+from kdtree_tpu.tuning.feedback import (
+    PlanFeedback,
+    feedback_for,
+    occupancy_p90_hint,
+)
 from kdtree_tpu.tuning.store import (
     ENV_CACHE_DIR,
     PlanSignature,
@@ -73,4 +77,5 @@ __all__ = [
     "feedback_for",
     "lookup",
     "make_signature",
+    "occupancy_p90_hint",
 ]

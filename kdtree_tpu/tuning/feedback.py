@@ -27,7 +27,12 @@ from __future__ import annotations
 from typing import Optional
 
 from kdtree_tpu import obs
-from kdtree_tpu.tuning.store import PlanSignature, PlanStore, default_store
+from kdtree_tpu.tuning.store import (
+    PlanSignature,
+    PlanStore,
+    _pow2_ceil,
+    default_store,
+)
 
 
 def occupancy_quantile(q: float, registry=None) -> Optional[float]:
@@ -45,6 +50,53 @@ def occupancy_quantile(q: float, registry=None) -> Optional[float]:
         if cum >= target:
             return None if upper == "+Inf" else float(upper)
     return None
+
+
+def occupancy_p90_hint(
+    dim: int, n: int, bucket_cap: int, devices: int,
+    backend: Optional[str] = None, store: Optional[PlanStore] = None,
+) -> Optional[float]:
+    """The best available ``occupancy_p90`` observation for a build of
+    this shape, read from warm plan-store profiles — the signal the
+    sample-sort slack sizing consults (docs/TUNING.md).
+
+    Profiles are keyed by *query* signatures, so the match is on the
+    build-relevant fields only: same dim, same bucket capacity, same
+    backend, and a device/row-bucket combination this build could have
+    produced — ``devices`` equal to the forest's shard count (the SPMD
+    per-shard plans) or 1 (the single-tree and mesh-free paths), with the
+    profile's quantized row bucket no larger than this build's total and
+    no smaller than half a shard's share (a profile from a much smaller
+    problem says nothing about this one's skew). The MAX over matches is
+    returned: overestimating occupancy only buys slack headroom, while
+    underestimating re-creates the overflow-retry the sizing exists to
+    avoid. None when no matching profile carries the field."""
+    store = store if store is not None else default_store()
+    if not store.enabled:
+        return None
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    n_hi = _pow2_ceil(max(int(n), 1))
+    n_lo = max(1, _pow2_ceil(max(int(n) // max(int(devices), 1), 1)) // 2)
+    best: Optional[float] = None
+    for sig, prof in store.scan():
+        occ = prof.get("occupancy_p90")
+        if not isinstance(occ, (int, float)) or isinstance(occ, bool) \
+                or occ <= 0:
+            continue
+        if sig.get("dim") != int(dim) or \
+                sig.get("bucket_size") != int(bucket_cap) or \
+                sig.get("backend") != str(backend):
+            continue
+        if sig.get("devices") not in (1, int(devices)):
+            continue
+        nb = sig.get("n_bucket")
+        if not isinstance(nb, int) or not (n_lo <= nb <= n_hi):
+            continue
+        best = occ if best is None else max(best, occ)
+    return best
 
 
 class PlanFeedback:

@@ -216,6 +216,38 @@ class PlanStore:
         obs.get_registry().counter("kdtree_plan_cache_writes_total").inc()
         return True
 
+    def scan(self):
+        """Yield ``(signature dict, raw profile dict)`` for every readable
+        profile in the store — the cross-signature view consumers like the
+        occupancy→slack sizing need (they match on *parts* of a signature,
+        so the keyed :meth:`get` path cannot serve them). Failure-tolerant
+        like everything else here: unreadable files and profiles without a
+        signature are skipped, never raised. Enrichment-only profiles
+        (occupancy recorded before any settled launch config) are yielded
+        too — :meth:`_validate`'s launch-knob check guards *launching* from
+        a profile, not reading its observability payload."""
+        if not self.enabled:
+            return
+        try:
+            names = sorted(os.listdir(self.cache_dir))
+        except OSError:
+            return
+        for fname in names:
+            if not (fname.startswith("plan-") and fname.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.cache_dir, fname)) as f:
+                    prof = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(prof, dict) or \
+                    prof.get("version") != PROFILE_VERSION:
+                continue
+            sig = prof.get("signature")
+            if not isinstance(sig, dict):
+                continue
+            yield sig, prof
+
     def record(self, sig: PlanSignature, **fields) -> bool:
         """Merge ``fields`` into the profile for ``sig``, writing only when
         something other than the timestamp actually changed — a steady-state

@@ -844,6 +844,80 @@ def cmd_query(args) -> None:
     print("DONE")
 
 
+def cmd_serve(args) -> None:
+    """Long-lived online k-NN serving (docs/SERVING.md): micro-batched
+    ``POST /v1/knn``, ``GET /healthz`` readiness, and the live Prometheus
+    ``GET /metrics`` endpoint over the whole telemetry registry."""
+    import signal
+    import threading
+    import zipfile
+
+    from kdtree_tpu.serve import lifecycle, server as srv
+
+    sources = [s for s in (args.index, args.points) if s]
+    if len(sources) > 1:
+        print("serve needs ONE index source: --index, --points, or the "
+              "seeded --seed/--dim/--n problem", file=sys.stderr)
+        sys.exit(1)
+    tree = points = problem = None
+    meta = {}
+    if args.index:
+        from kdtree_tpu.utils.checkpoint import load_tree
+
+        try:
+            tree, meta = load_tree(args.index)
+        except (OSError, ValueError, zipfile.BadZipFile) as e:
+            print(f"cannot load tree {args.index}: {e}", file=sys.stderr)
+            sys.exit(1)
+    elif args.points:
+        points = _load_array(args.points, "points")
+        meta = {"points": args.points}
+    else:
+        if args.generator != "threefry":
+            print("note: serve's seeded problem is the threefry row "
+                  f"stream; --generator {args.generator} does not apply",
+                  file=sys.stderr)
+        problem = (args.seed, args.dim, args.n)
+        meta = {"seed": args.seed, "generator": "threefry"}
+    try:
+        state = lifecycle.build_state(
+            tree=tree, points=points, problem=problem, k=args.k,
+            max_batch=args.max_batch, meta=meta,
+        )
+    except TypeError as e:
+        # un-servable checkpoint kind — crisp stderr + exit code (C10)
+        print(f"cannot serve: {e}", file=sys.stderr)
+        sys.exit(1)
+    httpd = srv.make_server(
+        state, host=args.host, port=args.port,
+        max_wait_ms=args.max_wait_ms, queue_rows=args.queue_depth,
+    )
+    host, port = httpd.server_address[:2]
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    print(f"kdtree-tpu serve: binding http://{host}:{port} "
+          f"(n={state.engine.tree.n_real}, dim={state.engine.tree.dim}, "
+          f"k<={state.engine.k}); warming up...", file=sys.stderr)
+    try:
+        httpd.start()  # returns once warmup compiles are done
+    except Exception:
+        # a failed warmup must not leave the non-daemon accept thread
+        # holding the process open with /healthz stuck at 503 forever
+        httpd.stop()
+        raise
+    print(f"ready: POST /v1/knn, GET /healthz, GET /metrics on port "
+          f"{port}", file=sys.stderr)
+    stop.wait()
+    print("shutting down: draining in-flight requests...", file=sys.stderr)
+    httpd.stop()
+    print("drained; bye", file=sys.stderr)
+
+
 def cmd_stats(args) -> None:
     """Render a --metrics-out JSON telemetry report human-readably (the
     registry snapshot is machine-first; this is the operator view)."""
@@ -1072,6 +1146,41 @@ def main(argv=None) -> None:
                         "assemble ALL shards in host memory (otherwise "
                         "loads above the host budget fail crisply)")
     q.set_defaults(fn=cmd_query)
+
+    sv = sub.add_parser(
+        "serve",
+        help="online k-NN serving: micro-batched POST /v1/knn + /healthz "
+             "+ Prometheus /metrics (docs/SERVING.md)",
+    )
+    sv.add_argument("--index", default=None, metavar="FILE",
+                    help="serve a checkpoint (a `build --out` npz; must be "
+                         "a Morton-servable tree)")
+    sv.add_argument("--points", default=None, metavar="FILE",
+                    help="build a Morton index over user data ([N, D] "
+                         ".npy/.npz) at startup and serve it")
+    sv.add_argument("--seed", type=int, default=42,
+                    help="seeded threefry problem (with --dim/--n) when no "
+                         "--index/--points is given")
+    sv.add_argument("--dim", type=int, default=3)
+    sv.add_argument("--n", type=int, default=1 << 20)
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default loopback; 0.0.0.0 exposes "
+                         "the server)")
+    sv.add_argument("--port", type=int, default=8080,
+                    help="TCP port (0 = ephemeral, printed on stderr)")
+    sv.add_argument("--k", type=int, default=16,
+                    help="max neighbors per query; batches compile at this "
+                         "k and per-request k<=K slices the result")
+    sv.add_argument("--max-batch", type=int, default=1024,
+                    help="micro-batch row cap (rounded up to a power of "
+                         "two — the plan-store bucket quantum)")
+    sv.add_argument("--max-wait-ms", type=float, default=2.0,
+                    help="how long the batcher holds the first request of "
+                         "a batch to coalesce arrivals")
+    sv.add_argument("--queue-depth", type=int, default=None, metavar="ROWS",
+                    help="admission budget in query rows; beyond it "
+                         "requests shed with 429 (default 4x max-batch)")
+    sv.set_defaults(fn=cmd_serve)
 
     st = sub.add_parser(
         "stats", help="render a --metrics-out telemetry report"

@@ -220,6 +220,40 @@ def test_kdt201_ignored_outside_hot_dirs(tmp_path):
     assert rules_of(res) == []
 
 
+def test_kdt201_covers_serve_batch_dispatch(tmp_path):
+    # the serving batch-dispatch path is the hottest loop in the repo —
+    # a sync smuggled into it must be flagged exactly like ops/
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def dispatch(tree, queries):\n"
+        "    d2 = jnp.sum(queries)\n"
+        "    return np.asarray(d2)\n"
+    ), relpath="serve/batcher.py")
+    assert rules_of(res) == ["KDT201"]
+
+
+def test_kdt201_exempts_http_handler_glue(tmp_path):
+    # BaseHTTPRequestHandler subclasses ARE the response boundary:
+    # materializing a result into JSON there is the endpoint working as
+    # designed, detected by base class — no suppression comment needed
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class Handler(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self):\n"
+        "        d2 = jnp.sum(self.server.batch)\n"
+        "        self.wfile.write(np.asarray(d2).tobytes())\n"
+        "def worker(batch):\n"
+        "    d2 = jnp.sum(batch)\n"
+        "    return float(d2)\n"
+    ), relpath="serve/server.py")
+    # the handler method is exempt; the module's non-handler worker is not
+    assert rules_of(res) == ["KDT201"]
+    assert res.findings[0].scope == "worker"
+
+
 # ---------------------------------------------------------------------------
 # KDT301 dup-morton-bits-rule
 # ---------------------------------------------------------------------------

@@ -242,6 +242,55 @@ def test_prometheus_text_format():
     assert "z_seconds_count 1" in text
 
 
+def test_prometheus_text_escapes_label_values():
+    reg = MetricsRegistry()
+    reg.counter(
+        "x_total", labels={"path": 'a"b\\c\nd'}
+    ).inc()
+    text = export.prometheus_text(reg)
+    # backslash, quote and newline must all be escaped — the scrape
+    # format is line-oriented, one raw newline corrupts every series
+    # after it. Escape order matters: backslash first, so the escaped
+    # quote/newline backslashes are not themselves re-escaped.
+    assert 'x_total{path="a\\"b\\\\c\\nd"} 1' in text
+    # exactly TYPE + series: the raw newline did not split the series line
+    assert len(text.splitlines()) == 2
+
+
+def test_prometheus_type_and_help_once_per_family():
+    reg = MetricsRegistry()
+    # several label sets in one family: TYPE/HELP must lead the family
+    # once, not repeat per series
+    reg.counter("kdtree_serve_requests_total", labels={"status": "ok"}).inc()
+    reg.counter(
+        "kdtree_serve_requests_total", labels={"status": "shed"}
+    ).inc()
+    reg.histogram(
+        "kdtree_serve_request_seconds", buckets=(0.1,),
+        labels={"phase": "queue"},
+    ).observe(0.05)
+    reg.histogram(
+        "kdtree_serve_request_seconds", buckets=(0.1,),
+        labels={"phase": "total"},
+    ).observe(0.2)
+    text = export.prometheus_text(reg)
+    assert text.count("# TYPE kdtree_serve_requests_total counter") == 1
+    assert text.count("# HELP kdtree_serve_requests_total") == 1
+    assert text.count("# TYPE kdtree_serve_request_seconds histogram") == 1
+    # the TYPE line precedes every series of its family
+    lines = text.splitlines()
+    first_series = min(
+        i for i, line in enumerate(lines)
+        if line.startswith("kdtree_serve_requests_total{")
+    )
+    type_line = lines.index("# TYPE kdtree_serve_requests_total counter")
+    assert type_line < first_series
+    # unknown families emit no HELP line at all
+    reg2 = MetricsRegistry()
+    reg2.counter("totally_unknown_total").inc()
+    assert "# HELP totally_unknown_total" not in export.prometheus_text(reg2)
+
+
 def test_report_and_render(tmp_path):
     reg = MetricsRegistry()
     reg.counter("kdtree_builds_total", labels={"engine": "morton"}).inc()

@@ -1,0 +1,480 @@
+"""Serving subsystem (docs/SERVING.md): e2e over localhost.
+
+The contract under test is the serving design rule: load changes latency
+and engine, never answers. Concurrent HTTP clients must get answers
+byte-identical to the in-process oracle, coalescing must land on the
+pow2 plan bucket (warm on the second same-bucket batch, zero overflow
+retries), overload must shed with 429, expired deadlines must degrade to
+exact brute force (flagged), and graceful shutdown must answer every
+admitted request.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import obs
+from kdtree_tpu.serve import lifecycle, server as srv
+from kdtree_tpu.serve.admission import (
+    AdmissionQueue,
+    PendingRequest,
+    QueueClosedError,
+    QueueFullError,
+)
+from kdtree_tpu.serve.batcher import batch_bucket
+
+DIM, N, K = 3, 4096, 4
+SEED = 7
+
+
+@pytest.fixture(scope="module")
+def tree():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+    from kdtree_tpu.ops.morton import build_morton
+
+    return build_morton(generate_points_rowwise(SEED, DIM, N))
+
+
+@pytest.fixture(scope="module")
+def server(tree):
+    state = lifecycle.build_state(tree=tree, k=K, max_batch=64)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0)
+    httpd.start(warmup_buckets=[8])
+    yield httpd
+    httpd.stop()
+
+
+@contextlib.contextmanager
+def fresh_server(tree, *, max_wait_ms=1.0, queue_rows=None, start_batcher=True):
+    """A per-test server on an ephemeral port, readiness flipped without
+    the warmup ladder (``warmup(buckets=[])`` runs zero compiles), torn
+    down even when the test body raises."""
+    state = lifecycle.build_state(tree=tree, k=K, max_batch=64)
+    httpd = srv.make_server(state, port=0, max_wait_ms=max_wait_ms,
+                            queue_rows=queue_rows)
+    accept = threading.Thread(target=httpd.serve_forever)
+    accept.start()
+    if start_batcher:
+        httpd.batcher.start()
+    state.warmup(buckets=[])
+    try:
+        yield httpd
+    finally:
+        if httpd.batcher._thread is None:
+            httpd.batcher.start()  # stop() drains through the worker
+        httpd.shutdown()
+        accept.join()
+        httpd.batcher.stop()
+        httpd.server_close()
+
+
+def _url(httpd, path):
+    return f"http://127.0.0.1:{httpd.server_address[1]}{path}"
+
+
+def _post(httpd, payload, timeout=120.0):
+    """(status, parsed body) for one POST /v1/knn, 4xx/5xx included."""
+    req = urllib.request.Request(
+        _url(httpd, "/v1/knn"), data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(httpd, path, timeout=30.0):
+    try:
+        with urllib.request.urlopen(_url(httpd, path), timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _oracle(tree, queries, k):
+    """The in-process answer the HTTP path must reproduce exactly."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    d2, ids = morton_knn_tiled(tree, jnp.asarray(queries), k=k)
+    return (
+        np.sqrt(np.asarray(d2).astype(np.float64)).tolist(),
+        np.asarray(ids).tolist(),
+    )
+
+
+def _counter(key):
+    return obs.get_registry().snapshot()["counters"].get(key, 0.0)
+
+
+def _queries(rows, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((rows, DIM)) * 200.0 - 100.0).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_reports_ready_and_shape(server):
+    status, body = _get(server, "/healthz")
+    assert status == 200
+    facts = json.loads(body)
+    assert facts["status"] == "ok"
+    assert facts["n"] == N and facts["dim"] == DIM and facts["k_max"] == K
+
+
+def test_unknown_paths_404(server):
+    assert _get(server, "/nope")[0] == 404
+    assert _post(server, {"queries": [[0.0] * DIM]})[0] == 200
+    status, body = _post_path(server, "/v2/knn")
+    assert status == 404
+
+
+def _post_path(httpd, path):
+    req = urllib.request.Request(
+        _url(httpd, path), data=b"{}",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_validation_rejections(server):
+    assert _post(server, {"queries": [[1.0, 2.0]]})[0] == 400  # wrong D
+    assert _post(server, {"queries": []})[0] == 400
+    assert _post(server, {"queries": [[0.0] * DIM], "k": K + 1})[0] == 400
+    assert _post(server, {"queries": [[0.0] * DIM], "k": 0})[0] == 400
+    assert _post(server, {"nope": 1})[0] == 400
+    status, out = _post(
+        server, {"queries": [[float("nan")] * DIM]}
+    )
+    assert status == 400 and "non-finite" in out["error"]
+    assert _post(
+        server, {"queries": [[0.0] * DIM], "deadline_ms": -5}
+    )[0] == 400
+
+
+def test_negative_content_length_rejected_not_stalled(server):
+    # a raw negative Content-Length must get a crisp 400 now, not a
+    # read-to-EOF stall that drops the connection with no response
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      server.server_address[1], timeout=10)
+    try:
+        conn.putrequest("POST", "/v1/knn")
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert b"Content-Length" in resp.read()
+    finally:
+        conn.close()
+
+
+def test_metrics_prometheus_exposition(server):
+    _post(server, {"queries": _queries(3).tolist()})
+    status, text = _get(server, "/metrics")
+    assert status == 200
+    assert "# TYPE kdtree_serve_requests_total counter" in text
+    assert "# TYPE kdtree_serve_request_seconds histogram" in text
+    assert 'kdtree_serve_request_seconds_bucket{le="+Inf",phase="total"}' \
+        in text
+    assert "kdtree_serve_queue_depth" in text
+    # one TYPE line per family, even with several label sets live
+    type_lines = [line for line in text.splitlines()
+                  if line.startswith("# TYPE ")]
+    assert len(type_lines) == len(set(type_lines))
+
+
+# ---------------------------------------------------------------------------
+# answers == oracle
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_clients_match_oracle(server, tree):
+    """The acceptance e2e: concurrent HTTP clients, every response
+    byte-identical (ids AND distances) to the in-process oracle."""
+    jobs = [(_queries(3 + i, seed=i), 1 + i % K) for i in range(6)]
+    results = [None] * len(jobs)
+
+    def client(i):
+        q, k = jobs[i]
+        results[i] = _post(server, {"queries": q.tolist(), "k": k})
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(jobs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for (q, k), out in zip(jobs, results):
+        status, body = out
+        assert status == 200
+        dist, ids = _oracle(tree, q, k)
+        assert body["ids"] == ids
+        assert body["distances"] == dist
+        assert body["degraded"] is None
+
+
+def test_per_request_k_slices_the_batch(server, tree):
+    q = _queries(5, seed=42)
+    status, body = _post(server, {"queries": q.tolist(), "k": 2})
+    assert status == 200
+    dist, ids = _oracle(tree, q, K)
+    assert body["ids"] == [row[:2] for row in ids]
+    assert body["distances"] == [row[:2] for row in dist]
+
+
+# ---------------------------------------------------------------------------
+# coalescing + warm plans
+# ---------------------------------------------------------------------------
+
+
+def test_batch_bucket_quantization():
+    assert batch_bucket(1, 64) == 8  # MIN_BUCKET floor
+    assert batch_bucket(8, 64) == 8
+    assert batch_bucket(9, 64) == 16
+    assert batch_bucket(64, 64) == 64
+    assert batch_bucket(33, 64) == 64
+
+
+def test_same_bucket_second_batch_is_warm(tree, tmp_path, monkeypatch):
+    """The auto-tune acceptance: batch one of a shape-bucket settles the
+    plan (cold), batch two dispatches warm with zero overflow retries."""
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE", str(tmp_path / "plans"))
+    cold_key = 'kdtree_serve_batches_total{plan_cache="cold"}'
+    warm_key = 'kdtree_serve_batches_total{plan_cache="warm"}'
+    retry_key = "kdtree_tile_overflow_retries_total"
+    with fresh_server(tree) as httpd:
+        c0, w0 = _counter(cold_key), _counter(warm_key)
+        status, _ = _post(httpd, {"queries": _queries(5, seed=1).tolist()})
+        assert status == 200
+        assert _counter(cold_key) == c0 + 1 and _counter(warm_key) == w0
+        # the settled plan landed in the store under the pow2 bucket the
+        # 5-row batch padded to (Q=8), proving coalescing matched the
+        # tuning signature quantization
+        plans = list((tmp_path / "plans").glob("plan-q8-*.json"))
+        assert len(plans) == 1, plans
+        r0 = _counter(retry_key)
+        status, _ = _post(httpd, {"queries": _queries(5, seed=2).tolist()})
+        assert status == 200
+        assert _counter(warm_key) == w0 + 1
+        assert _counter(retry_key) == r0  # warm dispatch: 0 retries
+
+
+def test_coalesced_requests_share_one_batch(tree):
+    """Requests arriving inside the wait window dispatch as ONE batch."""
+    batch_key = "kdtree_serve_batch_rows"
+    with fresh_server(tree, max_wait_ms=400.0) as httpd:
+        before = obs.get_registry().snapshot()["histograms"].get(batch_key)
+        n_before = int(before["count"]) if before else 0
+        outs = [None, None]
+
+        def client(i):
+            outs[i] = _post(
+                httpd, {"queries": _queries(3, seed=10 + i).tolist()}
+            )
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(o[0] == 200 for o in outs)
+        snap = obs.get_registry().snapshot()["histograms"][batch_key]
+        assert int(snap["count"]) == n_before + 1  # one batch, two requests
+
+
+# ---------------------------------------------------------------------------
+# admission control + degradation
+# ---------------------------------------------------------------------------
+
+
+def test_admission_queue_unit():
+    q = AdmissionQueue(max_rows=8)
+    a = PendingRequest(np.zeros((5, DIM), np.float32), k=1)
+    b = PendingRequest(np.zeros((5, DIM), np.float32), k=1)
+    q.submit(a)
+    with pytest.raises(QueueFullError):
+        q.submit(b)  # 5 + 5 > 8
+    got = q.pop()
+    assert got is a and q.rows == 0
+    q.push_front(a)
+    assert q.rows == 5
+    q.close()
+    with pytest.raises(QueueClosedError):
+        q.submit(b)
+    assert q.pop() is a  # closing never drops admitted work
+
+
+def test_queue_full_sheds_429(tree):
+    shed_key = "kdtree_serve_shed_total"
+    with fresh_server(tree, queue_rows=8, start_batcher=False) as httpd:
+        s0 = _counter(shed_key)
+        first = [None]
+
+        def client_a():
+            first[0] = _post(httpd, {"queries": _queries(5, seed=3).tolist()})
+
+        ta = threading.Thread(target=client_a)
+        ta.start()
+        deadline = time.monotonic() + 10
+        while httpd.queue.rows < 5 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert httpd.queue.rows == 5
+        status, body = _post(httpd, {"queries": _queries(5, seed=4).tolist()})
+        assert status == 429
+        assert "overloaded" in body["error"]
+        assert _counter(shed_key) == s0 + 1
+        httpd.batcher.start()  # drain so client A completes
+        ta.join()
+        assert first[0][0] == 200
+
+
+def test_deadline_falls_back_to_bruteforce_degraded(tree):
+    deg_key = 'kdtree_serve_degraded_total{reason="deadline"}'
+    with fresh_server(tree, start_batcher=False) as httpd:
+        d0 = _counter(deg_key)
+        q = _queries(5, seed=5)
+        out = [None]
+
+        def client():
+            out[0] = _post(
+                httpd, {"queries": q.tolist(), "deadline_ms": 1}
+            )
+
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.monotonic() + 10
+        while httpd.queue.rows < 5 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.05)  # let the 1 ms deadline expire while queued
+        httpd.batcher.start()
+        t.join()
+        status, body = out[0]
+        assert status == 200
+        assert body["degraded"] == "deadline"
+        assert _counter(deg_key) == d0 + 1
+        # degraded is still EXACT: brute force answers match the oracle
+        dist, ids = _oracle(tree, q, K)
+        assert body["ids"] == ids
+        assert body["distances"] == dist
+
+
+def test_oversized_request_degrades_not_errors(server, tree):
+    q = _queries(server.state.max_batch + 1, seed=6)
+    status, body = _post(server, {"queries": q.tolist(), "k": 2})
+    assert status == 200
+    assert body["degraded"] == "oversized"
+    dist, ids = _oracle(tree, q, 2)
+    assert body["ids"] == ids
+    assert body["distances"] == dist
+
+
+def test_oversized_requests_charge_the_admission_budget(tree):
+    """The degradation path must not escape shedding: with the budget
+    held, an oversized request sheds 429 like any other."""
+    with fresh_server(tree, queue_rows=100) as httpd:
+        charge = httpd.queue.reserve(50)
+        try:
+            q = _queries(65, seed=7)  # oversized (max_batch 64), 65 > 50 left
+            status, body = _post(httpd, {"queries": q.tolist()})
+            assert status == 429
+        finally:
+            httpd.queue.release(charge)
+        status, body = _post(httpd, {"queries": q.tolist()})
+        assert status == 200 and body["degraded"] == "oversized"
+
+
+def test_reserve_clamps_to_whole_budget():
+    q = AdmissionQueue(max_rows=8)
+    charge = q.reserve(1000)  # bigger than the budget: takes all of it
+    assert charge == 8 and q.rows == 8
+    with pytest.raises(QueueFullError):
+        q.reserve(1)
+    q.release(charge)
+    assert q.rows == 0
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_shutdown_drains_admitted_requests(tree):
+    """Every request admitted before stop() gets a real answer."""
+    jobs = [_queries(3, seed=20 + i) for i in range(3)]
+    outs = [None] * len(jobs)
+    with fresh_server(tree, max_wait_ms=5.0, start_batcher=False) as httpd:
+        def client(i):
+            try:
+                outs[i] = _post(httpd, {"queries": jobs[i].tolist()})
+            except OSError as e:  # a dropped request must fail the test
+                outs[i] = ("refused", repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(jobs))]
+        for t in threads:
+            t.start()
+        # no worker running yet: admission is observable and deterministic
+        total = sum(j.shape[0] for j in jobs)
+        deadline = time.monotonic() + 10
+        while httpd.queue.rows < total and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert httpd.queue.rows == total
+        # now shut down with the queue still full: the stop sequence must
+        # answer all three before the handler threads are joined
+        httpd.batcher.start()
+        httpd.stop()
+        for t in threads:
+            t.join()
+        for out in outs:
+            assert out is not None and out[0] == 200
+        # post-stop requests are refused at the TCP level (accept loop gone)
+        with pytest.raises(OSError):
+            _post(httpd, {"queries": _queries(2).tolist()}, timeout=2)
+
+
+def test_shutdown_not_wedged_by_idle_keepalive_connection(tree):
+    """A persistent scraper connection (Prometheus' default) parks a
+    handler thread in readline(); the socket timeout must bound it so
+    server_close() can join and the SIGTERM drain completes."""
+    import http.client
+
+    state = lifecycle.build_state(tree=tree, k=K, max_batch=64)
+    httpd = srv.make_server(state, port=0)
+    accept = threading.Thread(target=httpd.serve_forever)
+    accept.start()
+    httpd.batcher.start()
+    state.warmup(buckets=[])
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      httpd.server_address[1])
+    try:
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read()  # keep-alive: connection stays open
+        t0 = time.monotonic()
+        httpd.shutdown()
+        accept.join()
+        httpd.batcher.stop()
+        httpd.server_close()  # must join the idle handler within ~timeout
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        conn.close()

@@ -196,6 +196,82 @@ def test_feedback_records_prune_rate_when_metrics_enabled(store):
     assert 0.0 < prof.get("prune_rate", -1.0) <= 1.0
 
 
+def test_occupancy_hint_matches_build_relevant_fields(store):
+    """The slack sizing's store scan: only profiles whose signature could
+    describe this build (dim, bucket cap, backend, devices/rows) count,
+    and the MAX over matches wins."""
+    def put(q, d, n, k, b, nbp, devices, occ):
+        sig = make_signature(q, d, n, k, b, nbp, devices=devices,
+                             backend="cpu")
+        store.put(sig, {"tile": 64, "cmax": 32, "seeds": 8,
+                        "occupancy_p90": occ})
+
+    # matching: a per-shard profile (devices=8, shard-sized rows)
+    put(1024, 3, 1 << 17, 4, 128, 1024, 8, 96.0)
+    # matching: a mesh-free profile (devices=1, full rows), higher p90
+    put(1024, 3, 1 << 20, 4, 128, 8192, 1, 128.0)
+    # non-matching: wrong dim / wrong bucket cap / tiny problem
+    put(1024, 2, 1 << 20, 4, 128, 8192, 1, 128.0)
+    put(1024, 3, 1 << 20, 4, 256, 4096, 1, 128.0)
+    put(1024, 3, 64, 4, 128, 1, 1, 128.0)
+    got = tuning.occupancy_p90_hint(3, 1 << 20, 128, 8, backend="cpu",
+                                    store=store)
+    assert got == 128.0
+    assert tuning.occupancy_p90_hint(5, 1 << 20, 128, 8, backend="cpu",
+                                     store=store) is None
+
+
+def test_occupancy_sized_slack_guarded_and_explicit_wins(store):
+    """The PR 2 leftover closed: a warm occupancy_p90 at bucket capacity
+    doubles the exchange slack; a cold store keeps the static floor; an
+    explicit slack= is never second-guessed."""
+    from kdtree_tpu.parallel.global_morton import (
+        DEFAULT_SLACK,
+        _resolve_slack,
+    )
+
+    # explicit always wins, even below the floor
+    assert _resolve_slack(1.25, 3, 1 << 20, 128, 8) == 1.25
+    # cold store: the static heuristic floor
+    assert _resolve_slack(None, 3, 1 << 20, 128, 8) == DEFAULT_SLACK
+    # warm profile at full-bucket p90: slack scales up (2x at capacity)...
+    sig = make_signature(1024, 3, 1 << 20, 4, 128, 8192, devices=1,
+                         backend="cpu")
+    store.put(sig, {"tile": 64, "cmax": 32, "seeds": 8,
+                    "occupancy_p90": 128.0})
+    assert _resolve_slack(None, 3, 1 << 20, 128, 8) == 2.0 * DEFAULT_SLACK
+    # ...but a LOW p90 never drops below the floor
+    store.put(sig, {"tile": 64, "cmax": 32, "seeds": 8,
+                    "occupancy_p90": 16.0})
+    assert _resolve_slack(None, 3, 1 << 20, 128, 8) == DEFAULT_SLACK
+
+
+def test_occupancy_sized_build_answers_exactly(store, mesh8):
+    """e2e: a build whose slack came from a warm occupancy profile still
+    partitions exactly (oracle-identical answers)."""
+    from kdtree_tpu.parallel.global_morton import (
+        DEFAULT_SLACK,
+        build_global_morton,
+        global_morton_query,
+    )
+
+    seed, dim, n = 5, 3, 1 << 14
+    sig = make_signature(1024, dim, n, 4, 128, 32, devices=1, backend="cpu")
+    store.put(sig, {"tile": 64, "cmax": 32, "seeds": 8,
+                    "occupancy_p90": 128.0})
+    forest = build_global_morton(seed, dim, n, mesh=mesh8)
+    g = obs.get_registry().snapshot()["gauges"]
+    assert g.get("kdtree_exchange_slack") == 2.0 * DEFAULT_SLACK
+    qs, _ = generate_problem(seed=51, dim=dim, num_points=64, num_queries=1)
+    d2, ids = global_morton_query(forest, qs, k=4, mesh=mesh8)
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    oracle_d2, _ = bruteforce.knn_exact_d2(
+        generate_points_rowwise(seed, dim, n), qs, k=4
+    )
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(oracle_d2))
+
+
 def test_tuner_sweep_persists_winner(store):
     from kdtree_tpu.ops.generate import generate_queries
     from kdtree_tpu.tuning import tuner
