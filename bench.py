@@ -47,7 +47,17 @@ import numpy as np
 def _fail(msg: str, code: int = 1, hard: bool = False) -> None:
     """Emit the driver-facing FAILED metric line and exit. ``hard`` uses
     os._exit (needed when a wedged backend thread would block interpreter
-    shutdown)."""
+    shutdown). Dumps the flight-recorder ring first — a failed bench's
+    last-N-events timeline (which section, which spans, how far it got)
+    is the triage context the one-line FAILED metric lacks."""
+    try:
+        from kdtree_tpu.obs import flight
+
+        path = flight.auto_dump("bench-fail", force=True)
+        if path:
+            print(f"flight recorder dumped to {path}", file=sys.stderr)
+    except Exception:
+        pass  # the dump observes the failure; it must never mask it
     print(json.dumps({"metric": f"FAILED {msg}", "value": 0, "unit": "",
                       "vs_baseline": 0}))
     sys.stdout.flush()

@@ -65,6 +65,18 @@ R_NONDET = register(Rule(
     "engines-agree-bit-for-bit contract the oracle tests stand on",
 ))
 
+R_METRIC_NAME = register(Rule(
+    "KDT105", "dynamic-metric-name", CORRECTNESS,
+    "obs.span names and counter/gauge/histogram names and label values "
+    "must be static strings or values from a bounded enum — no f-strings, "
+    "string concatenation, or .format()",
+    "metric identity is (name, labels): one f-string span name per batch "
+    "or per request mints a new registry series each call — unbounded "
+    "registry growth in a long-lived serving process and a Prometheus "
+    "scrape that grows until the scraper chokes (the /metrics endpoint "
+    "serves EVERY series ever minted)",
+))
+
 R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
@@ -754,3 +766,78 @@ def check_dup_bits_rule(ctx) -> Iterator[Finding]:
             "ops.morton.default_bits so tree geometry and query planning "
             "can never disagree",
         )
+
+
+# --------------------------------------------------------------------------
+# KDT105 — dynamic-metric-name
+# --------------------------------------------------------------------------
+
+# method names whose FIRST argument is a metric/span name feeding registry
+# identity: obs.span / PhaseTimer.phase (a thin span wrapper), and the
+# three registry instrument constructors
+_SPAN_METHODS = {"span", "phase"}
+_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _dynamic_str_kind(node: ast.AST) -> Optional[str]:
+    """Why this expression mints unbounded strings, or None if it can't.
+
+    Deliberately syntactic (the file's contract): f-strings, %-/+-built
+    strings, and .format() calls are the leak signatures; a plain Name or
+    Attribute is ALLOWED — the reviewable idiom for a bounded enum is
+    binding the label value from a literal tuple (the batcher's
+    ``for phase in ("queue", "dispatch", "total")``), and flagging every
+    variable would bury that signal in noise."""
+    if isinstance(node, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        if any(
+            isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+            for sub in ast.walk(node)
+        ):
+            return "string concatenation/formatting"
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+    ):
+        return "a .format() call"
+    return None
+
+
+@checker(R_METRIC_NAME)
+def check_dynamic_metric_name(ctx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        method = call_name(node).split(".")[-1]
+        if method in _SPAN_METHODS or method in _INSTRUMENT_METHODS:
+            name_arg = node.args[0] if node.args else None
+            if name_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+            if name_arg is not None:
+                kind = _dynamic_str_kind(name_arg)
+                if kind:
+                    yield _mk(
+                        R_METRIC_NAME, ctx, name_arg,
+                        f"{method}() name built from {kind}: every distinct "
+                        "value mints a new metric series forever — use a "
+                        "static name and put the variable part in a "
+                        "bounded label",
+                    )
+        if method in _INSTRUMENT_METHODS:
+            for kw in node.keywords:
+                if kw.arg != "labels" or not isinstance(kw.value, ast.Dict):
+                    continue
+                for val in kw.value.values:
+                    kind = _dynamic_str_kind(val)
+                    if kind:
+                        yield _mk(
+                            R_METRIC_NAME, ctx, val,
+                            f"label value built from {kind}: label values "
+                            "are metric identity — unbounded values grow "
+                            "the registry (and every /metrics scrape) "
+                            "without limit; use a bounded enum",
+                        )

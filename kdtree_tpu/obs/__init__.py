@@ -11,8 +11,16 @@ One place for every "what did this run actually do" question:
 - :mod:`~kdtree_tpu.obs.jaxrt` — JAX runtime telemetry: backend-compile
   (recompile) counting via ``jax.monitoring``, device-init duration, the
   platform that actually ran, live device-memory gauges;
-- :mod:`~kdtree_tpu.obs.export` — JSONL event log, one-shot JSON report
-  (``kdtree-tpu stats`` renders it), Prometheus text exposition.
+- :mod:`~kdtree_tpu.obs.export` — JSONL event log (size-capped), one-shot
+  JSON report (``kdtree-tpu stats`` renders it), Prometheus text
+  exposition;
+- :mod:`~kdtree_tpu.obs.flight` — the always-on flight recorder: a
+  bounded ring of recent span completions and domain events, dumped
+  atomically on SIGUSR2 / serve incidents / CLI failure;
+- :mod:`~kdtree_tpu.obs.profile` — programmatic ``jax.profiler`` capture
+  windows (one at a time, process-wide);
+- :mod:`~kdtree_tpu.obs.timeline` — Chrome-trace analysis joining device
+  op slices back to host spans (``kdtree-tpu profile`` renders it).
 
 Cost model — two tiers, so production hot paths never pay for telemetry
 they didn't ask for:
@@ -119,10 +127,13 @@ def configure(
     jsonl: Optional[str] = None,
     install_jax_listeners: bool = True,
     enable: bool = True,
+    jsonl_max_bytes: Optional[int] = None,
 ) -> MetricsRegistry:
     """One-call setup for a telemetry-producing run: flips the
     device-side gate, installs the jax.monitoring listeners, and points
-    the JSONL event log somewhere. ``metrics_out`` is recorded for
+    the JSONL event log somewhere (size-capped — ``jsonl_max_bytes``
+    overrides the ``KDTREE_TPU_JSONL_MAX_BYTES`` budget; the log rotates
+    to ``.1`` at the budget). ``metrics_out`` is recorded for
     :func:`finalize` to write the report to."""
     global _metrics_out_path
     if enable:
@@ -134,7 +145,7 @@ def configure(
     if jsonl is not None:
         from kdtree_tpu.obs import export
 
-        export.configure_jsonl(jsonl)
+        export.configure_jsonl(jsonl, max_bytes=jsonl_max_bytes)
     if metrics_out is not None:
         _metrics_out_path = metrics_out
     return get_registry()

@@ -27,15 +27,47 @@ from kdtree_tpu.obs.registry import MetricsRegistry, get_registry
 
 REPORT_VERSION = 1
 
+# event-log byte budget: a long-lived serving process must not grow its
+# JSONL unboundedly. At the budget the log rotates ONCE (path -> path.1,
+# previous .1 replaced), so disk usage is bounded by ~2x the budget while
+# the newest events are always on disk.
+DEFAULT_JSONL_MAX_BYTES = 64 << 20
+
 _jsonl_lock = threading.Lock()
 _jsonl_path: Optional[str] = None
+_jsonl_max_bytes: int = DEFAULT_JSONL_MAX_BYTES
+_jsonl_written: int = 0
 
 
-def configure_jsonl(path: Optional[str]) -> None:
-    """Set (or clear, with None) the JSONL event-log destination."""
-    global _jsonl_path
+def _env_jsonl_budget() -> int:
+    try:
+        return int(os.environ.get("KDTREE_TPU_JSONL_MAX_BYTES",
+                                  str(DEFAULT_JSONL_MAX_BYTES)))
+    except ValueError:
+        return DEFAULT_JSONL_MAX_BYTES
+
+
+def configure_jsonl(
+    path: Optional[str], max_bytes: Optional[int] = None,
+) -> None:
+    """Set (or clear, with None) the JSONL event-log destination.
+
+    ``max_bytes`` caps the log size (default from
+    ``KDTREE_TPU_JSONL_MAX_BYTES``, 64 MiB; <= 0 disables the cap): at
+    the budget the current file rotates to ``path.1`` and the log starts
+    fresh, so a long-lived serving process cannot fill the disk. An
+    existing file's size counts against the budget from the start."""
+    global _jsonl_path, _jsonl_max_bytes, _jsonl_written
     with _jsonl_lock:
         _jsonl_path = path
+        _jsonl_max_bytes = _env_jsonl_budget() if max_bytes is None \
+            else int(max_bytes)
+        _jsonl_written = 0
+        if path is not None:
+            try:
+                _jsonl_written = os.path.getsize(path)
+            except OSError:
+                pass
 
 
 def jsonl_path() -> Optional[str]:
@@ -45,15 +77,46 @@ def jsonl_path() -> Optional[str]:
 def emit_event(event: Dict) -> None:
     """Append one event line to the configured JSONL log; no-op when no
     log is configured, and never raises into the instrumented caller —
-    telemetry failures must not fail the run they observe."""
+    telemetry failures must not fail the run they observe. Rotates at
+    the configured byte budget (see :func:`configure_jsonl`)."""
+    global _jsonl_written
     with _jsonl_lock:
         path = _jsonl_path
         if path is None:
             return
         try:
-            line = json.dumps({"ts": time.time(), **event})
+            line = json.dumps({"ts": time.time(), **event}) + "\n"
+            if _jsonl_max_bytes > 0 and \
+                    _jsonl_written + len(line) > _jsonl_max_bytes:
+                try:
+                    os.replace(path, path + ".1")
+                except OSError:
+                    # the log was rotated/removed under us (external
+                    # logrotate, operator cleanup) or .1 is unwritable:
+                    # re-sync the counter from the file's TRUE size so
+                    # logging self-heals instead of retrying a failing
+                    # rotation (and dropping every event) forever. If
+                    # the file genuinely is still over budget, drop this
+                    # event — the byte cap outranks completeness.
+                    try:
+                        _jsonl_written = os.path.getsize(path)
+                    except OSError:
+                        _jsonl_written = 0
+                    if _jsonl_written + len(line) > _jsonl_max_bytes:
+                        return
+                else:
+                    _jsonl_written = 0
+                    with open(path, "a") as f:
+                        rot = json.dumps({
+                            "ts": time.time(), "type": "rotated",
+                            "previous": path + ".1",
+                            "max_bytes": _jsonl_max_bytes,
+                        }) + "\n"
+                        f.write(rot)
+                        _jsonl_written += len(rot)
             with open(path, "a") as f:
-                f.write(line + "\n")
+                f.write(line)
+            _jsonl_written += len(line)
         except (OSError, TypeError, ValueError):
             pass
 
@@ -279,4 +342,95 @@ def render_report(rep: Dict) -> str:
                 prev = int(cum)
                 if in_bucket:
                     out.append(f"    <= {upper:>8}: {in_bucket}")
+    return "\n".join(out) + "\n"
+
+
+def _fmt_delta(old: float, new: float) -> str:
+    """'+12.3%' / '-4.0%' / '  =' — relative change, guarded for zero."""
+    if old == new:
+        return "="
+    if old == 0:
+        return "new" if new else "="
+    return f"{(new - old) / abs(old) * 100.0:+.1f}%"
+
+
+def render_report_diff(old: Dict, new: Dict) -> str:
+    """Side-by-side rendering of two telemetry reports (``kdtree-tpu
+    stats --diff OLD NEW``) — the bench-regression triage view: spans by
+    new total time with old totals and relative deltas, counter deltas
+    (compile counts included), and gauges that moved. Rows present in
+    only one report are marked rather than dropped — an appearing span
+    IS the regression signal half the time."""
+    out = []
+
+    def fact(rep, key, default="?"):
+        return rep.get(key, default)
+
+    out.append("== run ==")
+    out.append(f"{'':20s}  {'OLD':>14s}  {'NEW':>14s}")
+    for key in ("platform", "device_count", "degraded"):
+        ov, nv = fact(old, key), fact(new, key)
+        if ov == "?" and nv == "?":
+            continue
+        flag = "   <- differs" if ov != nv else ""
+        out.append(f"{key:20s}  {str(ov):>14s}  {str(nv):>14s}{flag}")
+    oc, nc = old.get("counters", {}), new.get("counters", {})
+    key = "jax_backend_compiles_total"
+    if key in oc or key in nc:
+        ov, nv = float(oc.get(key, 0)), float(nc.get(key, 0))
+        out.append(f"{'backend compiles':20s}  {ov:14g}  {nv:14g}  "
+                   f"{_fmt_delta(ov, nv)}")
+
+    ospans, nspans = old.get("spans", {}), new.get("spans", {})
+    if ospans or nspans:
+        out.append("")
+        out.append("== spans (by NEW total time) ==")
+        paths = sorted(
+            set(ospans) | set(nspans),
+            key=lambda p: -nspans.get(p, {}).get("total_seconds", -1.0),
+        )
+        width = max(len(p) for p in paths)
+        out.append(f"{'':{width}s}  {'OLD total':>12s}  {'NEW total':>12s}"
+                   f"  {'delta':>8s}  {'OLD mean':>10s}  {'NEW mean':>10s}")
+        for p in paths:
+            o, n = ospans.get(p), nspans.get(p)
+            ot = o["total_seconds"] if o else None
+            nt = n["total_seconds"] if n else None
+            om = f"{o['mean_seconds'] * 1e3:9.2f}ms" if o else "-"
+            nm = f"{n['mean_seconds'] * 1e3:9.2f}ms" if n else "-"
+            delta = (_fmt_delta(ot, nt) if o and n
+                     else ("gone" if o else "new"))
+            out.append(
+                f"{p:{width}s}  {ot if ot is not None else float('nan'):11.3f}s"
+                f"  {nt if nt is not None else float('nan'):11.3f}s"
+                f"  {delta:>8s}  {om:>10s}  {nm:>10s}"
+            )
+
+    changed = []
+    for key in sorted(set(oc) | set(nc)):
+        if key.startswith(("jax_events_total", "jax_event_seconds_total")):
+            continue
+        # show every counter, changed or not: a flat counter between two
+        # runs (e.g. zero overflow retries in both) is itself triage info
+        changed.append((key, float(oc.get(key, 0)), float(nc.get(key, 0))))
+    if changed:
+        out.append("")
+        out.append("== counters ==")
+        width = max(len(k) for k, _, _ in changed)
+        for key, ov, nv in changed:
+            out.append(f"{key:{width}s}  {ov:14g}  {nv:14g}  "
+                       f"{_fmt_delta(ov, nv)}")
+
+    og, ng = old.get("gauges", {}), new.get("gauges", {})
+    moved = [
+        (k, float(og.get(k, 0)), float(ng.get(k, 0)))
+        for k in sorted(set(og) | set(ng))
+        if og.get(k) != ng.get(k)
+    ]
+    if moved:
+        out.append("")
+        out.append("== gauges (changed) ==")
+        width = max(len(k) for k, _, _ in moved)
+        for key, ov, nv in moved:
+            out.append(f"{key:{width}s}  {ov:14g}  {nv:14g}")
     return "\n".join(out) + "\n"

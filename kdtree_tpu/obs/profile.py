@@ -1,0 +1,129 @@
+"""Programmatic ``jax.profiler`` capture windows.
+
+The obs spans measure host wall-clock; this module is how a run gets the
+other half — the device-side trace those spans' ``TraceAnnotation``
+names land in. A capture window wraps any region of driver code in
+``jax.profiler.start_trace`` / ``stop_trace``, then locates the emitted
+Chrome-trace artifact so :mod:`kdtree_tpu.obs.timeline` can join device
+op slices back to the host spans and quantify where the accelerator
+actually waited.
+
+One capture at a time, process-wide: the underlying profiler is a
+process singleton, and a second ``start_trace`` while one is live fails
+deep inside XLA with an unhelpful error. The lock here turns that into
+a crisp :class:`CaptureBusyError` — which the serving endpoint
+(``POST /debug/profile``) maps to HTTP 409.
+
+Capture is the one telemetry feature that is NOT host-cheap: tracing
+instruments every thread and the artifact is megabytes. It runs only
+inside these explicit windows; the always-on tier (spans, counters, the
+flight recorder) never pays for it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+from kdtree_tpu.obs.registry import get_registry
+
+
+class CaptureBusyError(RuntimeError):
+    """A capture window is already open in this process."""
+
+
+_capture_lock = threading.Lock()
+
+
+def capture_active() -> bool:
+    """Whether a capture window is currently open (lock held)."""
+    if _capture_lock.acquire(blocking=False):
+        _capture_lock.release()
+        return False
+    return True
+
+
+class CaptureResult:
+    """Handle yielded by :func:`capture`; the trace location fields are
+    filled in when the window closes."""
+
+    def __init__(self, log_dir: str) -> None:
+        self.log_dir = log_dir
+        self.trace_file: Optional[str] = None
+        self.begin_unix = time.time()
+        self.end_unix: Optional[float] = None
+
+    @property
+    def wall_seconds(self) -> float:
+        end = self.end_unix if self.end_unix is not None else time.time()
+        return end - self.begin_unix
+
+
+def latest_trace_file(log_dir: str) -> Optional[str]:
+    """Newest Chrome-trace artifact under a profiler log dir.
+
+    The profiler writes ``<log_dir>/plugins/profile/<run>/<host>.trace.
+    json.gz`` — one ``<run>`` directory per capture, named by timestamp;
+    globbing for the newest file makes this robust to hostname and to
+    multiple captures sharing a log dir."""
+    pattern = os.path.join(
+        log_dir, "plugins", "profile", "*", "*.trace.json.gz"
+    )
+    files = glob.glob(pattern)
+    if not files:
+        return None
+    return max(files, key=os.path.getmtime)
+
+
+@contextlib.contextmanager
+def capture(log_dir: str) -> Iterator[CaptureResult]:
+    """Open a profiler capture window writing under ``log_dir``.
+
+    Raises :class:`CaptureBusyError` (without touching the profiler) if
+    a window is already open in this process. On exit the trace is
+    stopped even if the profiled region raised, and the yielded
+    :class:`CaptureResult` carries the located ``.trace.json.gz`` (None
+    if the profiler produced nothing — e.g. a crash mid-capture)."""
+    import jax
+
+    from kdtree_tpu.obs import flight
+
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusyError(
+            "a profiler capture is already active in this process "
+            "(one capture at a time)"
+        )
+    result = CaptureResult(log_dir)
+    reg = get_registry()
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+        flight.record("profile.capture_start", log_dir=log_dir)
+        try:
+            yield result
+        finally:
+            jax.profiler.stop_trace()
+            result.end_unix = time.time()
+            result.trace_file = latest_trace_file(log_dir)
+            reg.counter("kdtree_profile_captures_total").inc()
+            flight.record(
+                "profile.capture_stop", log_dir=log_dir,
+                seconds=result.wall_seconds,
+                trace_file=result.trace_file or "",
+            )
+    finally:
+        _capture_lock.release()
+
+
+def capture_for(seconds: float, log_dir: str) -> CaptureResult:
+    """Open a capture window over whatever the process is doing for
+    ``seconds`` wall-clock (the serving endpoint's shape: the batch
+    worker keeps dispatching while this thread sleeps inside the
+    window). Returns the closed :class:`CaptureResult`."""
+    with capture(log_dir) as result:
+        time.sleep(max(float(seconds), 0.0))
+    return result

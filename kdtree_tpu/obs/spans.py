@@ -121,12 +121,17 @@ def span(
             "kdtree_span_seconds", buckets=SPAN_TIME_BUCKETS,
             labels={"span": path},
         ).observe(sp.duration)
-        from kdtree_tpu.obs import export
+        from kdtree_tpu.obs import export, flight
 
         export.emit_event({
             "type": "span", "span": path, "seconds": sp.duration,
             "synced": bool(sync), **attrs,
         })
+        # span completions also land in the always-on flight recorder
+        # (bounded ring, ~µs): an incident dump then carries the last N
+        # seconds of where time went, not just counter totals
+        flight.record("span", span=path, seconds=sp.duration,
+                      synced=bool(sync), **attrs)
 
 
 def current_span() -> Optional[Span]:

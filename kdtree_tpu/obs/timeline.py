@@ -1,0 +1,421 @@
+"""Device-timeline analysis: join a Chrome trace back to the obs spans.
+
+Input: the ``.trace.json.gz`` a :mod:`kdtree_tpu.obs.profile` capture
+window produced. Output: a JSON-ready timeline report that answers the
+question host spans cannot — *where did the accelerator actually wait?*
+
+Event taxonomy (verified against this container's jax CPU runtime and
+the TPU trace layout):
+
+- **Host span events** — our ``obs.span`` names, recorded into the trace
+  as ``jax.profiler.TraceAnnotation`` slices on the driver thread. They
+  follow the project naming convention (dotted lowercase:
+  ``query.tiled``, ``serve.batch``, ``bench.build``), which is how the
+  parser recognizes them without a manifest; an explicit ``span_names``
+  set overrides the heuristic.
+- **Device/executor op slices** — XLA op executions. On CPU they run on
+  the runtime's ``tf_XLA*`` threads and carry ``hlo_op``/``hlo_module``
+  args; on TPU/GPU they live in ``/device:*`` processes. Both markers
+  are used.
+- **Dispatch annotations** — ``tile.dispatch`` marks the driver handing
+  one async batch to the runtime (:func:`kdtree_tpu.ops.tile_query.
+  drive_batches`); the gap between a dispatch and the first op slice
+  that follows it is the dispatch-to-execution lag, and the op-busy
+  fraction of each dispatch-to-next-dispatch window is the per-dispatch
+  busy/idle breakdown.
+- **Compile slices** — ``backend_compile`` (the jax TraceMe around every
+  XLA backend compile); a capture window that contains one was not
+  measuring steady state, and the report says so.
+
+Correlation is by TIME OVERLAP within the capture: a sync'd span
+(``obs.span`` hard-syncs appended outputs before its clock stops) fully
+contains the device work it caused, so overlap is exact there; for
+``sync=False`` spans the overlapping slices are the work in flight
+during the span, which is precisely the async-dispatch picture the
+report exists to show.
+"""
+
+from __future__ import annotations
+
+import bisect
+import gzip
+import json
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+TIMELINE_VERSION = 1
+
+DISPATCH_ANNOTATION = "tile.dispatch"
+
+# project span naming convention: dotted lowercase tokens. hlo op names
+# like "reduce-window.1" would match too — exec slices are classified
+# (and excluded) FIRST by their hlo_op/device markers.
+_SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_+-]*(\.[a-z0-9_+-]+)+$")
+
+_COMPILE_NAMES = frozenset({"backend_compile"})
+_MAX_LISTED = 200  # cap per-instance listings so the artifact stays small
+
+
+def load_trace(path: str) -> dict:
+    """Load a Chrome trace (.json or .json.gz)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def _merge(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Union of [start, end) intervals — nested/overlapping op slices
+    (an hlo ``call`` containing its fusion children) must count once."""
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    out = [list(intervals[0])]
+    for s, e in intervals[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _overlap(
+    merged: Sequence[Tuple[float, float]],
+    merged_ends: Sequence[float],
+    s: float, e: float,
+) -> float:
+    """Total length of ``merged`` intersected with [s, e] — O(log n + k)
+    per call (bisect to the first interval ending after ``s``); a
+    60-second serve capture has 1e5+ op slices and one span event per
+    request, so the per-span cost must not be a full interval scan."""
+    total = 0.0
+    i = bisect.bisect_right(merged_ends, s)
+    while i < len(merged):
+        ms, me = merged[i]
+        if ms >= e:
+            break
+        total += min(me, e) - max(ms, s)
+        i += 1
+    return total
+
+
+def _pctl(values: List[float], frac: float) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(int(frac * (len(vs) - 1) + 0.5), len(vs) - 1)
+    return vs[idx]
+
+
+class _Classified:
+    """One pass over the trace events, sorted into the taxonomy."""
+
+    def __init__(self, trace: dict, span_names: Optional[Iterable[str]],
+                 dispatch_name: str) -> None:
+        events = trace.get("traceEvents", [])
+        proc_names: Dict[object, str] = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                proc_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        names = set(span_names) if span_names is not None else None
+
+        self.exec_slices: List[dict] = []
+        self.spans: List[dict] = []
+        self.dispatches: List[dict] = []
+        self.compiles: List[dict] = []
+        for e in events:
+            if e.get("ph") != "X" or "ts" not in e:
+                continue
+            name = e.get("name", "")
+            args = e.get("args") or {}
+            on_device = proc_names.get(e.get("pid"), "").startswith("/device:")
+            if "hlo_op" in args or on_device:
+                self.exec_slices.append(e)
+                continue
+            if name in _COMPILE_NAMES:
+                self.compiles.append(e)
+                continue
+            if name == dispatch_name:
+                self.dispatches.append(e)
+                continue
+            if (name in names) if names is not None \
+                    else _SPAN_NAME_RE.match(name):
+                self.spans.append(e)
+        self.dispatches.sort(key=lambda e: e["ts"])
+        self.spans.sort(key=lambda e: e["ts"])
+
+
+def parse_timeline(
+    trace: dict,
+    span_names: Optional[Iterable[str]] = None,
+    dispatch_name: str = DISPATCH_ANNOTATION,
+) -> dict:
+    """Analyze one Chrome trace into the timeline report dict.
+
+    ``span_names`` restricts host-span recognition to an explicit set
+    (default: the project's dotted-name convention). The report is
+    self-contained JSON — every duration in microseconds, fractions in
+    [0, 1] — rendered for humans by :func:`render_timeline`.
+    """
+    cls = _Classified(trace, span_names, dispatch_name)
+
+    interesting = cls.exec_slices + cls.spans + cls.dispatches + cls.compiles
+    if interesting:
+        begin = min(e["ts"] for e in interesting)
+        end = max(e["ts"] + float(e.get("dur", 0.0)) for e in interesting)
+    else:
+        begin = end = 0.0
+    wall = end - begin
+
+    exec_iv = [
+        (e["ts"], e["ts"] + float(e.get("dur", 0.0)))
+        for e in cls.exec_slices
+    ]
+    merged = _merge(exec_iv)
+    merged_ends = [e for _, e in merged]
+    busy = sum(e - s for s, e in merged)
+    # sorted starts/ends of the RAW slices: overlap counting by bisect
+    # (slices overlapping [s, e) = those starting before e minus those
+    # ending at/before s — disjoint sets for a nonempty window)
+    slice_starts = sorted(a for a, _ in exec_iv)
+    slice_ends = sorted(b for _, b in exec_iv)
+
+    # per-module busy (union per module — nested op slices count once)
+    by_module: Dict[str, List[Tuple[float, float]]] = {}
+    for e in cls.exec_slices:
+        mod = (e.get("args") or {}).get("hlo_module", "<device>")
+        by_module.setdefault(mod, []).append(
+            (e["ts"], e["ts"] + float(e.get("dur", 0.0)))
+        )
+    modules = sorted(
+        (
+            (mod, sum(e - s for s, e in _merge(iv)), len(iv))
+            for mod, iv in by_module.items()
+        ),
+        key=lambda kv: -kv[1],
+    )
+
+    # host spans: per-instance overlap, aggregated per name
+    span_agg: Dict[str, dict] = {}
+    instances: List[dict] = []
+    correlated_pairs = 0
+    for e in cls.spans:
+        s, dur = e["ts"], float(e.get("dur", 0.0))
+        end_e = s + dur
+        dev = _overlap(merged, merged_ends, s, end_e)
+        n_sl = max(
+            0,
+            bisect.bisect_left(slice_starts, end_e)
+            - bisect.bisect_right(slice_ends, s),
+        )
+        correlated_pairs += n_sl
+        agg = span_agg.setdefault(e["name"], {
+            "count": 0, "wall_us": 0.0, "device_busy_us": 0.0,
+            "device_idle_us": 0.0, "n_slices": 0,
+        })
+        agg["count"] += 1
+        agg["wall_us"] += dur
+        agg["device_busy_us"] += dev
+        agg["device_idle_us"] += max(dur - dev, 0.0)
+        agg["n_slices"] += n_sl
+        if len(instances) < _MAX_LISTED:
+            instances.append({
+                "name": e["name"], "ts_us": s, "dur_us": dur,
+                "device_busy_us": dev, "n_slices": n_sl,
+                "args": {k: str(v) for k, v in (e.get("args") or {}).items()},
+            })
+    for agg in span_agg.values():
+        agg["busy_frac"] = (
+            agg["device_busy_us"] / agg["wall_us"] if agg["wall_us"] else 0.0
+        )
+
+    # dispatch windows: [dispatch_i, dispatch_{i+1}) busy/idle + lag.
+    # busy_frac / lag aggregate over ALL dispatches; only the per-window
+    # listing is capped (_MAX_LISTED) — the aggregates and `count` must
+    # describe the same population.
+    windows: List[dict] = []
+    lags: List[float] = []
+    disp_wall = 0.0
+    disp_busy = 0.0
+    for i, e in enumerate(cls.dispatches):
+        s = e["ts"]
+        w_end = cls.dispatches[i + 1]["ts"] if i + 1 < len(cls.dispatches) \
+            else end
+        w_busy = _overlap(merged, merged_ends, s, w_end)
+        lag = None
+        lo = bisect.bisect_left(slice_starts, s)
+        if lo < len(slice_starts):
+            lag = slice_starts[lo] - s
+            lags.append(lag)
+        disp_wall += max(w_end - s, 0.0)
+        disp_busy += w_busy
+        if len(windows) < _MAX_LISTED:
+            windows.append({
+                "ts_us": s,
+                "window_us": max(w_end - s, 0.0),
+                "busy_us": w_busy,
+                "idle_us": max(w_end - s - w_busy, 0.0),
+                "lag_us": lag,
+                "args": {k: str(v) for k, v in (e.get("args") or {}).items()},
+            })
+
+    compiles = sorted(cls.compiles, key=lambda e: -float(e.get("dur", 0.0)))
+    compile_total = sum(float(e.get("dur", 0.0)) for e in cls.compiles)
+
+    # idle gaps between device work inside the capture — the report's
+    # headline: each gap is time the accelerator sat waiting
+    gaps: List[dict] = []
+    prev = begin
+    for s, e in merged:
+        if s > prev:
+            gaps.append({"ts_us": prev, "gap_us": s - prev})
+        prev = max(prev, e)
+    if end > prev and merged:
+        gaps.append({"ts_us": prev, "gap_us": end - prev})
+    gaps.sort(key=lambda g: -g["gap_us"])
+
+    return {
+        "timeline_version": TIMELINE_VERSION,
+        "capture": {"begin_us": begin, "end_us": end, "wall_us": wall},
+        "device": {
+            "busy_us": busy,
+            "idle_us": max(wall - busy, 0.0),
+            "busy_frac": (busy / wall) if wall else 0.0,
+            "n_slices": len(cls.exec_slices),
+            "modules": [
+                {"module": m, "busy_us": b, "n_slices": n}
+                for m, b, n in modules[:32]
+            ],
+            "largest_gaps": gaps[:10],
+        },
+        "spans": span_agg,
+        "span_instances": instances,
+        "dispatches": {
+            "count": len(cls.dispatches),
+            "busy_frac": (disp_busy / disp_wall) if disp_wall else None,
+            "lag_us": {
+                "n": len(lags),
+                "median": _pctl(lags, 0.5),
+                "p90": _pctl(lags, 0.9),
+                "max": max(lags) if lags else None,
+            },
+            "windows": windows,
+        },
+        "compile": {
+            "count": len(cls.compiles),
+            "total_us": compile_total,
+            "top": [
+                {"ts_us": e["ts"], "dur_us": float(e.get("dur", 0.0))}
+                for e in compiles[:10]
+            ],
+        },
+        "correlated_spans": sum(
+            1 for a in span_agg.values() if a["n_slices"] > 0
+        ),
+        "correlated_pairs": correlated_pairs,
+    }
+
+
+def analyze_trace_file(
+    path: str,
+    span_names: Optional[Iterable[str]] = None,
+    dispatch_name: str = DISPATCH_ANNOTATION,
+) -> dict:
+    """Load + parse; records the source path in the report."""
+    rep = parse_timeline(load_trace(path), span_names, dispatch_name)
+    rep["trace_file"] = path
+    return rep
+
+
+def _us(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1e6:
+        return f"{v / 1e6:.3f}s"
+    if v >= 1e3:
+        return f"{v / 1e3:.2f}ms"
+    return f"{v:.0f}us"
+
+
+def render_timeline(rep: dict) -> str:
+    """Human rendering of a timeline report (the ``profile`` subcommand's
+    stdout, style-matched to ``kdtree-tpu stats``). Leads with the facts
+    that decide whether the capture is even worth reading (wall, device
+    busy fraction, compiles-in-window), then spans, dispatches, gaps."""
+    out = []
+    cap = rep["capture"]
+    dev = rep["device"]
+    out.append("== capture ==")
+    out.append(f"wall:                {_us(cap['wall_us'])}")
+    out.append(
+        f"device busy:         {_us(dev['busy_us'])} "
+        f"({dev['busy_frac'] * 100.0:.1f}% of capture; "
+        f"{dev['n_slices']} op slices)"
+    )
+    out.append(f"device idle:         {_us(dev['idle_us'])}")
+    comp = rep["compile"]
+    if comp["count"]:
+        out.append(
+            f"compiles IN WINDOW:  {comp['count']} "
+            f"({_us(comp['total_us'])}) — not steady state"
+        )
+    else:
+        out.append("compiles in window:  0 (steady state)")
+
+    spans = rep.get("spans", {})
+    if spans:
+        out.append("")
+        out.append("== host spans vs device (by device busy) ==")
+        width = max(len(s) for s in spans)
+        for name, a in sorted(
+            spans.items(), key=lambda kv: -kv[1]["device_busy_us"]
+        ):
+            out.append(
+                f"{name:<{width}}  n={a['count']:<4d} "
+                f"wall={_us(a['wall_us']):>9s} "
+                f"busy={_us(a['device_busy_us']):>9s} "
+                f"({a['busy_frac'] * 100.0:5.1f}%) "
+                f"slices={a['n_slices']}"
+            )
+
+    disp = rep.get("dispatches", {})
+    if disp.get("count"):
+        lag = disp["lag_us"]
+        out.append("")
+        out.append("== batch dispatches ==")
+        out.append(f"dispatches:          {disp['count']}")
+        if disp.get("busy_frac") is not None:
+            out.append(
+                f"device busy between: {disp['busy_frac'] * 100.0:.1f}% "
+                "(idle gap = host/queue/transfer time)"
+            )
+        out.append(
+            f"dispatch->exec lag:  median={_us(lag['median'])} "
+            f"p90={_us(lag['p90'])} max={_us(lag['max'])}"
+        )
+
+    mods = dev.get("modules", [])
+    if mods:
+        out.append("")
+        out.append("== device modules (by busy time) ==")
+        width = max(len(m["module"]) for m in mods)
+        for m in mods[:10]:
+            out.append(
+                f"{m['module']:<{width}}  busy={_us(m['busy_us']):>9s} "
+                f"slices={m['n_slices']}"
+            )
+
+    gaps = dev.get("largest_gaps", [])
+    if gaps:
+        out.append("")
+        out.append("== largest device idle gaps ==")
+        for g in gaps[:5]:
+            out.append(
+                f"at +{_us(g['ts_us'] - cap['begin_us']):>9s}: "
+                f"{_us(g['gap_us'])}"
+            )
+    out.append("")
+    out.append(
+        f"correlated spans:    {rep.get('correlated_spans', 0)} "
+        f"({rep.get('correlated_pairs', 0)} span/slice pairs)"
+    )
+    return "\n".join(out) + "\n"

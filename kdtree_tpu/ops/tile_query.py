@@ -456,12 +456,26 @@ def drive_batches(
     at a smaller cap is still exact — overflow is the only incompleteness
     signal.
     """
+    from kdtree_tpu.obs import flight
+
     reg = obs.get_registry()
     retries = reg.counter("kdtree_tile_overflow_retries_total")
     nretries = 0
     bcmax = cmax
+
+    def dispatch(i: int, cap: int):
+        # the "tile.dispatch" TraceAnnotation is the device-timeline
+        # anchor (obs/timeline.py): in a profiler capture, the gap
+        # between this annotation and the first op slice that follows is
+        # the dispatch-to-execution lag, and each dispatch-to-next-
+        # dispatch window gets a device busy/idle breakdown. Outside a
+        # capture the annotation is a ~ns no-op.
+        with jax.profiler.TraceAnnotation("tile.dispatch", batch=i,
+                                          cap=cap):
+            return run_batch(offsets[i], cap)
+
     if settle_first:
-        first = run_batch(offsets[0], bcmax)
+        first = dispatch(0, bcmax)
         # kdt-lint: disable=KDT201 the deliberate cap-settling probe: one
         # synchronous flag fetch on the FIRST batch settles a systematic
         # undersize before ~150 async batches dispatch at the wrong cap
@@ -469,10 +483,11 @@ def drive_batches(
             bcmax = min(bcmax * 2, nbp)
             retries.inc()
             nretries += 1
-            first = run_batch(offsets[0], bcmax)
-        batches = [first] + [run_batch(b0, bcmax) for b0 in offsets[1:]]
+            first = dispatch(0, bcmax)
+        batches = [first] + [dispatch(i, bcmax)
+                             for i in range(1, len(offsets))]
     else:
-        batches = [run_batch(b0, bcmax) for b0 in offsets]
+        batches = [dispatch(i, bcmax) for i in range(len(offsets))]
     while bcmax < nbp:
         # kdt-lint: disable=KDT201 ONE stacked overflow-flag fetch AFTER
         # every batch dispatched async; overflow is the only exactness
@@ -482,10 +497,11 @@ def drive_batches(
         if bad.size == 0:
             break
         bcmax = min(bcmax * 2, nbp)
+        flight.record("tile.overflow_retry", cap=bcmax, batches=len(bad))
         for i in bad:
             retries.inc()
             nretries += 1
-            batches[i] = run_batch(offsets[i], bcmax)
+            batches[i] = dispatch(i, bcmax)
     reg.counter("kdtree_tile_batches_total").inc(len(offsets))
     if obs.enabled() and len(batches[0]) > 3:
         # stack the per-batch candidate counts on device (async) and DEFER
@@ -517,6 +533,11 @@ def drive_batches(
         # now (the retry loop fetched the flags); recording them closes the
         # auto-tune loop — the next same-shaped run starts here
         feedback.settled(cmax=bcmax, retries=nretries)
+    # one flight-recorder event per DRIVE (not per batch): an incident
+    # dump shows each tiled run's dispatch count, settled cap, and retry
+    # reality without per-batch ring pressure
+    flight.record("tile.drive", batches=len(offsets), cmax=bcmax,
+                  retries=nretries)
     parts_d = [b[0] for b in batches]
     parts_i = [b[1] for b in batches]
     d2 = jnp.concatenate(parts_d, axis=0) if len(parts_d) > 1 else parts_d[0]

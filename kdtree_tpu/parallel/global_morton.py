@@ -542,6 +542,13 @@ def build_global_morton(
             f"retry with slack > {slack}"
         )
     occ_max = int(jnp.max(occ))  # kdt-lint: disable=KDT201 one scalar fetch at build end; occ_max is a STATIC planning fact of the new forest
+    from kdtree_tpu.obs import flight
+
+    # scale builds are rare, load-bearing events — an incident dump that
+    # contains one shows the exchange reality (slack, peak bucket
+    # occupancy) behind every query that followed
+    flight.record("build.global-morton", n=num_points, devices=p,
+                  slack=round(float(slack), 4), occ_max=occ_max)
     return GlobalMortonForest(
         node_lo, node_hi, bucket_pts, bucket_gid,
         num_points=num_points, seed=seed, bucket_cap=bucket_cap, bits=bits,

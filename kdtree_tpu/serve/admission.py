@@ -26,6 +26,13 @@ from typing import Optional
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.obs import flight
+
+# a shed or two is normal backpressure; this many sheds inside one second
+# is an incident — the flight recorder dumps its ring once per burst so
+# the timeline that LED INTO the overload survives the overload
+SHED_BURST_THRESHOLD = 10
+SHED_BURST_WINDOW_S = 1.0
 
 
 class QueueFullError(Exception):
@@ -42,16 +49,22 @@ class PendingRequest:
 
     __slots__ = (
         "queries", "k", "deadline", "enqueued_at", "dispatched_at",
-        "event", "d2", "ids", "degraded", "error",
+        "event", "d2", "ids", "degraded", "error", "trace_id",
     )
 
     def __init__(
         self, queries: np.ndarray, k: int,
         deadline: Optional[float] = None,
+        trace_id: str = "",
     ) -> None:
         self.queries = queries  # f32[q, D], validated by the handler
         self.k = k
         self.deadline = deadline  # absolute time.monotonic(), or None
+        # per-request trace id (client X-Request-Id or server-generated):
+        # threads admission -> batcher -> dispatch, so one slow request's
+        # queue/coalesce/device decomposition can be pulled from the
+        # flight ring by id
+        self.trace_id = trace_id
         self.enqueued_at = time.monotonic()
         self.dispatched_at: Optional[float] = None
         self.event = threading.Event()
@@ -101,6 +114,20 @@ class AdmissionQueue:
         reg = obs.get_registry()
         self._depth = reg.gauge("kdtree_serve_queue_depth")
         self._shed = reg.counter("kdtree_serve_shed_total")
+        self._shed_burst = flight.BurstDetector(
+            SHED_BURST_THRESHOLD, SHED_BURST_WINDOW_S
+        )
+
+    def _count_shed(self, rows: int, depth: int, trace_id: str = "") -> None:
+        """Shed accounting shared by submit/reserve — called OUTSIDE the
+        queue lock (the burst dump does file I/O, which must never block
+        admissions): counter + flight event, and a rate-limited ring
+        dump when sheds burst."""
+        self._shed.inc()
+        flight.record("serve.shed", rows=rows, trace=trace_id,
+                      depth=depth, budget=self.max_rows)
+        if self._shed_burst.mark():
+            flight.auto_dump("serve-shed-burst")
 
     @property
     def rows(self) -> int:
@@ -110,18 +137,21 @@ class AdmissionQueue:
         with self._cond:
             if self._closed:
                 raise QueueClosedError("server is shutting down")
-            if self._rows + req.rows > self.max_rows:
-                self._shed.inc()
-                raise QueueFullError(
-                    f"admission queue at capacity ({self._rows}/"
-                    f"{self.max_rows} rows)"
-                )
-            self._items.append(req)
-            self._rows += req.rows
-            self._depth.set(self._rows)
-            self._cond.notify()
+            depth = self._rows
+            if depth + req.rows <= self.max_rows:
+                self._items.append(req)
+                self._rows += req.rows
+                self._depth.set(self._rows)
+                self._cond.notify()
+                flight.record("serve.admit", rows=req.rows,
+                              trace=req.trace_id, depth=self._rows)
+                return
+        self._count_shed(req.rows, depth, req.trace_id)
+        raise QueueFullError(
+            f"admission queue at capacity ({depth}/{self.max_rows} rows)"
+        )
 
-    def reserve(self, rows: int) -> int:
+    def reserve(self, rows: int, trace_id: str = "") -> int:
         """Charge ``rows`` against the admission budget WITHOUT enqueueing
         — the oversized degradation path runs outside the batch queue but
         must not escape shedding: unbounded concurrent brute-force scans
@@ -132,16 +162,16 @@ class AdmissionQueue:
         with self._cond:
             if self._closed:
                 raise QueueClosedError("server is shutting down")
+            depth = self._rows
             charge = min(int(rows), self.max_rows)
-            if self._rows + charge > self.max_rows:
-                self._shed.inc()
-                raise QueueFullError(
-                    f"admission queue at capacity ({self._rows}/"
-                    f"{self.max_rows} rows)"
-                )
-            self._rows += charge
-            self._depth.set(self._rows)
-            return charge
+            if depth + charge <= self.max_rows:
+                self._rows += charge
+                self._depth.set(self._rows)
+                return charge
+        self._count_shed(rows, depth, trace_id)
+        raise QueueFullError(
+            f"admission queue at capacity ({depth}/{self.max_rows} rows)"
+        )
 
     def release(self, charge: int) -> None:
         """Return a :meth:`reserve` charge to the budget."""

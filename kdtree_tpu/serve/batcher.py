@@ -30,6 +30,7 @@ from typing import List, Optional
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.obs import flight
 from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
 from kdtree_tpu.tuning.store import _pow2_ceil
 
@@ -185,6 +186,10 @@ class MicroBatcher:
             d2, ids, source = self.engine.knn_batch(q)
         except Exception as e:
             self._errors.inc()
+            flight.record("serve.batch_error", rows=rows,
+                          requests=len(live), error=repr(e)[:200],
+                          traces=[r.trace_id for r in live])
+            flight.auto_dump("serve-error")
             for r in live:
                 r.fail(f"batch dispatch failed: {e!r}")
             return
@@ -192,12 +197,27 @@ class MicroBatcher:
         self._batches["warm" if source == "warm" else "cold"].inc()
         self._batch_rows.observe(rows)
         self._batch_reqs.observe(len(live))
+        flight.record(
+            "serve.batch", rows=rows, bucket=bucket, requests=len(live),
+            plan=source, dispatch_ms=round((done - live[0].dispatched_at)
+                                           * 1e3, 3),
+            traces=[r.trace_id for r in live],
+        )
         off = 0
         for r in live:
             r.fulfill(d2[off:off + r.rows, :r.k], ids[off:off + r.rows, :r.k])
             off += r.rows
             self._lat["dispatch"].observe(done - r.dispatched_at)
             self._lat["total"].observe(done - r.enqueued_at)
+            # per-request decomposition, by trace id: queue (admit ->
+            # dispatch) vs device (dispatch -> done) — the flight ring's
+            # answer to "why was THIS request slow"
+            flight.record(
+                "serve.request", trace=r.trace_id, rows=r.rows,
+                queue_ms=round((r.dispatched_at - r.enqueued_at) * 1e3, 3),
+                device_ms=round((done - r.dispatched_at) * 1e3, 3),
+                total_ms=round((done - r.enqueued_at) * 1e3, 3),
+            )
 
     def _run_fallback(self, req: PendingRequest, reason: str) -> None:
         """Answer one straggler through the exact brute-force path."""
@@ -206,6 +226,9 @@ class MicroBatcher:
             d2, ids = self.engine.fallback_knn(req.queries, req.k)
         except Exception as e:
             self._errors.inc()
+            flight.record("serve.batch_error", rows=req.rows, requests=1,
+                          error=repr(e)[:200], traces=[req.trace_id])
+            flight.auto_dump("serve-error")
             req.fail(f"fallback dispatch failed: {e!r}")
             return
         done = time.monotonic()
@@ -213,3 +236,8 @@ class MicroBatcher:
         if req.dispatched_at is not None:
             self._lat["dispatch"].observe(done - req.dispatched_at)
         self._lat["total"].observe(done - req.enqueued_at)
+        flight.record(
+            "serve.request", trace=req.trace_id, rows=req.rows,
+            degraded=reason,
+            total_ms=round((done - req.enqueued_at) * 1e3, 3),
+        )

@@ -154,6 +154,10 @@ class ServeState:
         obs.get_registry().gauge("kdtree_serve_warmup_buckets").set(
             len(buckets)
         )
+        from kdtree_tpu.obs import flight
+
+        flight.record("serve.ready", buckets=len(buckets),
+                      n=self.engine.tree.n_real, k=self.engine.k)
         self._ready.set()
         self._ready_gauge.set(1)
 

@@ -10,6 +10,18 @@
 - ``GET /metrics`` — the Prometheus text exposition of the whole obs
   registry (deferred device fetches flushed first), closing the ROADMAP
   scrape-endpoint item.
+- ``GET /debug/flight`` — the always-on flight recorder's ring (recent
+  span completions + admissions/batches/sheds with trace ids) as JSON.
+- ``POST /debug/profile?seconds=N`` — open a profiler capture window
+  over the live process for N seconds, then return the analyzed device
+  timeline (``obs/timeline.py`` report JSON). One capture at a time
+  (409 while one is running); tracing is the one telemetry feature that
+  is not host-cheap, so it runs only on demand.
+
+Every ``/v1/knn`` request carries a trace id (client ``X-Request-Id``
+or server-generated, echoed as ``trace_id`` in the response): the same
+id threads admission → batcher → dispatch in the flight ring, so a slow
+request decomposes into queue / coalesce / device time after the fact.
 
 Handler threads are glue: validate, admit, block on the request future,
 serialize. All engine work happens in the batch worker — except the
@@ -20,13 +32,16 @@ than letting one huge request distort every micro-batch behind it.
 from __future__ import annotations
 
 import json
+import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.obs import flight
 from kdtree_tpu.serve.admission import (
     AdmissionQueue,
     PendingRequest,
@@ -40,6 +55,19 @@ from kdtree_tpu.serve.batcher import (
 from kdtree_tpu.serve.lifecycle import ServeState
 
 MAX_BODY_BYTES = 64 << 20  # a [max_batch, D] float batch is far smaller
+MAX_PROFILE_SECONDS = 60.0  # /debug/profile window cap
+DEFAULT_PROFILE_SECONDS = 3.0
+
+_TRACE_ID_BAD = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _trace_id(headers) -> str:
+    """The request's trace id: the client's ``X-Request-Id`` (sanitized,
+    capped — it flows into log lines and flight dumps verbatim) or a
+    fresh server-side id."""
+    raw = headers.get("X-Request-Id", "")
+    clean = _TRACE_ID_BAD.sub("-", raw)[:64]
+    return clean or uuid.uuid4().hex[:16]
 
 
 def _count_request(status: str) -> None:
@@ -86,8 +114,12 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
     def _send_json(
         self, code: int, obj: dict, extra_headers: Optional[dict] = None,
     ) -> None:
+        # default=str: flight-ring events carry arbitrary recorded fields
+        # (record() accepts anything by design); one unserializable value
+        # must not turn /debug/flight into a dropped connection when the
+        # SIGUSR2 dump of the same payload would have succeeded
         self._send_bytes(
-            code, (json.dumps(obj) + "\n").encode("utf-8"),
+            code, (json.dumps(obj, default=str) + "\n").encode("utf-8"),
             "application/json", extra_headers,
         )
 
@@ -118,15 +150,24 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
                 "text/plain; version=0.0.4; charset=utf-8",
             )
             return
+        if path == "/debug/flight":
+            # the live ring, no file involved — same payload shape as a
+            # SIGUSR2 dump so one reader handles both
+            self._send_json(200, flight.recorder().report("debug-endpoint"))
+            return
         self._send_json(404, {"error": f"no such path: {path}"})
 
     # -- POST ---------------------------------------------------------------
 
     def do_POST(self) -> None:
         path = self.path.split("?", 1)[0]
+        if path == "/debug/profile":
+            self._do_debug_profile()
+            return
         if path != "/v1/knn":
             self._send_json(404, {"error": f"no such path: {path}"})
             return
+        trace = _trace_id(self.headers)
         parsed = self._parse_knn_body()
         if parsed is None:
             return  # error response already sent
@@ -145,60 +186,76 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             # most expensive requests must be the FIRST the 429 gate can
             # refuse, not the only ones it cannot see.
             try:
-                charge = self.server.queue.reserve(queries.shape[0])
+                charge = self.server.queue.reserve(queries.shape[0],
+                                                   trace_id=trace)
             except QueueFullError:
                 _count_request("shed")
                 self._send_json(429, {"error": "overloaded: admission "
-                                               "queue at capacity"},
+                                               "queue at capacity",
+                                      "trace_id": trace},
                                 extra_headers={"Retry-After": "1"})
                 return
             except QueueClosedError:
                 _count_request("unready")
-                self._send_json(503, {"error": "server is shutting down"})
+                self._send_json(503, {"error": "server is shutting down",
+                                      "trace_id": trace})
                 return
             obs.get_registry().counter(
                 "kdtree_serve_degraded_total", labels={"reason": "oversized"}
             ).inc()
+            flight.record("serve.oversized", trace=trace,
+                          rows=int(queries.shape[0]))
             try:
                 d2, ids = state.engine.fallback_knn(queries, k)
             except Exception as e:
                 _count_request("error")
-                self._send_json(500, {"error": f"engine failure: {e!r}"})
+                flight.record("serve.error", trace=trace,
+                              error=repr(e)[:200])
+                flight.auto_dump("serve-error")
+                self._send_json(500, {"error": f"engine failure: {e!r}",
+                                      "trace_id": trace})
                 return
             finally:
                 self.server.queue.release(charge)
             _count_request("degraded")
             self._send_json(
-                200, self._result_json(d2, ids, k, degraded="oversized")
+                200, self._result_json(d2, ids, k, degraded="oversized",
+                                       trace_id=trace)
             )
             return
         import time as _time
 
         deadline = (_time.monotonic() + deadline_s) if deadline_s else None
-        req = PendingRequest(queries, k, deadline)
+        req = PendingRequest(queries, k, deadline, trace_id=trace)
         try:
             self.server.queue.submit(req)
         except QueueFullError:
             _count_request("shed")
             self._send_json(429, {"error": "overloaded: admission queue "
-                                           "at capacity"},
+                                           "at capacity",
+                                  "trace_id": trace},
                             extra_headers={"Retry-After": "1"})
             return
         except QueueClosedError:
             _count_request("unready")
-            self._send_json(503, {"error": "server is shutting down"})
+            self._send_json(503, {"error": "server is shutting down",
+                                  "trace_id": trace})
             return
         if not req.event.wait(timeout=state.request_timeout_s):
             _count_request("timeout")
-            self._send_json(504, {"error": "request timed out in service"})
+            flight.record("serve.timeout", trace=trace, rows=req.rows)
+            flight.auto_dump("serve-error")
+            self._send_json(504, {"error": "request timed out in service",
+                                  "trace_id": trace})
             return
         if req.error is not None:
             _count_request("error")
-            self._send_json(500, {"error": req.error})
+            self._send_json(500, {"error": req.error, "trace_id": trace})
             return
         _count_request("degraded" if req.degraded else "ok")
         self._send_json(
-            200, self._result_json(req.d2, req.ids, k, degraded=req.degraded)
+            200, self._result_json(req.d2, req.ids, k, degraded=req.degraded,
+                                   trace_id=trace)
         )
 
     def _parse_knn_body(
@@ -271,9 +328,57 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             deadline_s = float(deadline_ms) / 1e3
         return queries, k, deadline_s
 
+    def _do_debug_profile(self) -> None:
+        """``POST /debug/profile?seconds=N``: open a capture window over
+        the live process, then answer with the analyzed device-timeline
+        report. The single-capture lock maps to 409 — two concurrent
+        captures would corrupt each other's profiler state."""
+        from urllib.parse import parse_qs, urlparse
+
+        from kdtree_tpu.obs import profile as obs_profile
+        from kdtree_tpu.obs import timeline as obs_timeline
+
+        qs = parse_qs(urlparse(self.path).query)
+        raw = qs.get("seconds", [str(DEFAULT_PROFILE_SECONDS)])[0]
+        try:
+            seconds = float(raw)
+        except ValueError:
+            self._send_json(400, {"error": f"seconds must be a number, "
+                                           f"got {raw!r}"})
+            return
+        if not (0.0 < seconds <= MAX_PROFILE_SECONDS):
+            self._send_json(400, {"error": "seconds must be in "
+                                           f"(0, {MAX_PROFILE_SECONDS:g}]"})
+            return
+        import tempfile
+
+        log_dir = tempfile.mkdtemp(prefix="kdtree-serve-profile-")
+        try:
+            result = obs_profile.capture_for(seconds, log_dir)
+        except obs_profile.CaptureBusyError:
+            self._send_json(409, {"error": "a profiler capture is already "
+                                           "running (one at a time)"})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": f"capture failed: {e!r}"})
+            return
+        if result.trace_file is None:
+            self._send_json(500, {"error": "profiler produced no trace "
+                                           f"under {log_dir}"})
+            return
+        try:
+            rep = obs_timeline.analyze_trace_file(result.trace_file)
+        except (OSError, ValueError) as e:
+            self._send_json(500, {"error": f"cannot parse trace "
+                                           f"{result.trace_file}: {e!r}"})
+            return
+        rep["seconds_requested"] = seconds
+        self._send_json(200, rep)
+
     @staticmethod
     def _result_json(
         d2: np.ndarray, ids: np.ndarray, k: int, degraded: Optional[str],
+        trace_id: str = "",
     ) -> dict:
         dist = np.sqrt(d2[:, :k].astype(np.float64))
         return {
@@ -281,6 +386,7 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             "ids": ids[:, :k].tolist(),
             "distances": dist.tolist(),
             "degraded": degraded,
+            "trace_id": trace_id,
         }
 
 

@@ -900,6 +900,13 @@ def cmd_serve(args) -> None:
 
     signal.signal(signal.SIGINT, _on_signal)
     signal.signal(signal.SIGTERM, _on_signal)
+    # SIGUSR2 -> atomic flight-recorder dump (docs/OBSERVABILITY.md): the
+    # operator's "what is this process doing" button, no restart needed
+    from kdtree_tpu.obs import flight
+
+    if flight.install_signal_handler():
+        print("flight recorder armed: kill -USR2 this pid dumps the "
+              "recent-event ring", file=sys.stderr)
     print(f"kdtree-tpu serve: binding http://{host}:{port} "
           f"(n={state.engine.tree.n_real}, dim={state.engine.tree.dim}, "
           f"k<={state.engine.k}); warming up...", file=sys.stderr)
@@ -918,24 +925,116 @@ def cmd_serve(args) -> None:
     print("drained; bye", file=sys.stderr)
 
 
-def cmd_stats(args) -> None:
-    """Render a --metrics-out JSON telemetry report human-readably (the
-    registry snapshot is machine-first; this is the operator view)."""
-    from kdtree_tpu.obs import export
-
+def _load_report(path: str) -> dict:
+    """Load + validate one --metrics-out telemetry report (shared by
+    ``stats`` and ``stats --diff`` so both reject garbage identically)."""
     try:
-        with open(args.report) as f:
+        with open(path) as f:
             rep = json.load(f)
     except (OSError, ValueError) as e:
-        print(f"cannot read telemetry report {args.report}: {e}",
-              file=sys.stderr)
+        print(f"cannot read telemetry report {path}: {e}", file=sys.stderr)
         sys.exit(1)
     if not isinstance(rep, dict) or "counters" not in rep:
-        print(f"{args.report} is not a kdtree-tpu telemetry report "
+        print(f"{path} is not a kdtree-tpu telemetry report "
               "(missing 'counters'); was it written by --metrics-out?",
               file=sys.stderr)
         sys.exit(1)
-    sys.stdout.write(export.render_report(rep))
+    return rep
+
+
+def cmd_stats(args) -> None:
+    """Render a --metrics-out JSON telemetry report human-readably (the
+    registry snapshot is machine-first; this is the operator view).
+    ``--diff OLD NEW`` renders two reports side-by-side with deltas —
+    the bench-regression triage view."""
+    from kdtree_tpu.obs import export
+
+    if args.diff:
+        if len(args.report) != 2:
+            print("stats --diff needs exactly two reports: OLD NEW",
+                  file=sys.stderr)
+            sys.exit(1)
+        old, new = (_load_report(p) for p in args.report)
+        sys.stdout.write(export.render_report_diff(old, new))
+        return
+    if len(args.report) != 1:
+        print("stats renders one report (use --diff OLD NEW to compare "
+              "two)", file=sys.stderr)
+        sys.exit(1)
+    sys.stdout.write(export.render_report(_load_report(args.report[0])))
+
+
+def cmd_profile(args) -> None:
+    """Device-timeline profiling (docs/OBSERVABILITY.md "Profiling"):
+    run a representative tiled-query workload under a ``jax.profiler``
+    capture window, join the emitted device op slices back to the host
+    spans by time overlap, and report where the accelerator was busy vs
+    waiting — per batch dispatch, with dispatch-to-execution lag and any
+    compile slices that polluted the window. Writes the timeline report
+    JSON to --out and renders it human-readably."""
+    import os
+    import tempfile
+
+    from kdtree_tpu import obs
+    from kdtree_tpu.obs import profile as obs_profile
+    from kdtree_tpu.obs import timeline as obs_timeline
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+    from kdtree_tpu.ops.morton import build_morton
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    trace_dir = args.trace_dir or tempfile.mkdtemp(
+        prefix="kdtree-tpu-profile-"
+    )
+    print(f"profiling: n={args.n} dim={args.dim} q={args.q} k={args.k} "
+          f"(trace dir {trace_dir})", file=sys.stderr)
+    pts = generate_points_rowwise(args.seed, args.dim, args.n)
+    # a distinct seed for the query sample — profiling query==point
+    # geometry would overstate the prune rate (same idiom as tune)
+    queries = generate_queries(args.seed + 1, args.dim, args.q)
+    with obs.span("profile.build") as h:
+        tree = build_morton(pts)
+        h += [tree]
+    if not args.cold:
+        # warmup OUTSIDE the window: compiles would otherwise dominate
+        # the capture and the busy/idle numbers would describe XLA, not
+        # the steady state (--cold keeps them in, deliberately)
+        d2, ids = morton_knn_tiled(tree, queries, k=args.k)
+        obs.hard_sync([d2, ids])
+    with obs_profile.capture(trace_dir) as cap:
+        with obs.span("profile.query") as h:
+            d2, ids = morton_knn_tiled(tree, queries, k=args.k)
+            h += [d2, ids]
+    if cap.trace_file is None:
+        print(f"profiler produced no trace under {trace_dir}",
+              file=sys.stderr)
+        sys.exit(1)
+    try:
+        rep = obs_timeline.analyze_trace_file(cap.trace_file)
+    except (OSError, ValueError) as e:
+        print(f"cannot parse trace {cap.trace_file}: {e}", file=sys.stderr)
+        sys.exit(1)
+    rep["workload"] = {
+        "seed": args.seed, "dim": args.dim, "n": args.n, "q": args.q,
+        "k": args.k, "cold": bool(args.cold),
+    }
+    tmp = f"{args.out}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rep, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    if args.format == "json":
+        print(json.dumps({
+            "out": args.out,
+            "trace_file": cap.trace_file,
+            "correlated_spans": rep["correlated_spans"],
+            "device_busy_frac": rep["device"]["busy_frac"],
+            "dispatches": rep["dispatches"]["count"],
+            "compiles_in_window": rep["compile"]["count"],
+        }))
+    else:
+        sys.stdout.write(obs_timeline.render_timeline(rep))
+    print(f"timeline report written to {args.out}; raw trace: "
+          f"{cap.trace_file}", file=sys.stderr)
 
 
 def cmd_lint(args) -> None:
@@ -1061,6 +1160,20 @@ def cmd_tune(args) -> None:
     }))
 
 
+def _flight_dump_on_failure() -> None:
+    """Dump the flight ring on a failed CLI exit (KDTREE_TPU_FLIGHT_DIR
+    governs where; =none disables). The dump observes the failure — it
+    must never mask it, so every error is swallowed."""
+    try:
+        from kdtree_tpu.obs import flight
+
+        path = flight.auto_dump("cli-error", force=True)
+        if path:
+            print(f"flight recorder dumped to {path}", file=sys.stderr)
+    except Exception:
+        pass
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="kdtree-tpu", description=__doc__)
     p.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -1183,11 +1296,44 @@ def main(argv=None) -> None:
     sv.set_defaults(fn=cmd_serve)
 
     st = sub.add_parser(
-        "stats", help="render a --metrics-out telemetry report"
+        "stats", help="render a --metrics-out telemetry report "
+                      "(--diff OLD NEW compares two)"
     )
-    st.add_argument("report", metavar="REPORT.json",
-                    help="path a previous run's --metrics-out wrote")
+    st.add_argument("report", nargs="+", metavar="REPORT.json",
+                    help="path a previous run's --metrics-out wrote "
+                         "(two paths with --diff)")
+    st.add_argument("--diff", action="store_true",
+                    help="render two reports side-by-side with deltas "
+                         "(spans, counters, compile counts) — the "
+                         "bench-regression triage view")
     st.set_defaults(fn=cmd_stats)
+
+    pr = sub.add_parser(
+        "profile",
+        help="device-timeline profiling: capture a jax.profiler trace of "
+             "a tiled-query workload and report device busy/idle per "
+             "batch dispatch (docs/OBSERVABILITY.md)",
+    )
+    pr.add_argument("--seed", type=int, default=42)
+    pr.add_argument("--dim", type=int, default=3)
+    pr.add_argument("--n", type=int, default=1 << 16,
+                    help="point count of the seeded problem to profile")
+    pr.add_argument("--q", type=int, default=1 << 13,
+                    help="query-batch size (the dense tiled shape)")
+    pr.add_argument("--k", type=int, default=8)
+    pr.add_argument("--cold", action="store_true",
+                    help="skip the warmup run so the capture includes "
+                         "compile slices (default: profile steady state)")
+    pr.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="where the raw profiler trace lands (default: a "
+                         "temp dir, path printed on stderr); open it in "
+                         "Perfetto for the full picture")
+    pr.add_argument("--out", default="timeline.json", metavar="FILE",
+                    help="timeline report JSON artifact")
+    pr.add_argument("--format", choices=["human", "json"], default="human",
+                    help="stdout format (the JSON artifact is always "
+                         "written to --out)")
+    pr.set_defaults(fn=cmd_profile)
 
     tu = sub.add_parser(
         "tune",
@@ -1255,8 +1401,15 @@ def main(argv=None) -> None:
     except BuildCapacityError as e:
         # the HBM guard (ops/morton.py) protects every subcommand; surface
         # it with the crisp stderr + exit-code contract (C10), not a traceback
+        _flight_dump_on_failure()
         print(str(e), file=sys.stderr)
         sys.exit(1)
+    except Exception:
+        # unhandled crash: dump the flight ring BEFORE the traceback — the
+        # last N seconds of spans/events are the context the traceback
+        # lacks. (SystemExit is BaseException: validation exits don't dump.)
+        _flight_dump_on_failure()
+        raise
     finally:
         # write the report even on failed exits — a degraded run's
         # telemetry is exactly the part worth keeping; and a failed WRITE

@@ -24,6 +24,14 @@ if "KDTREE_TPU_PLAN_CACHE" not in os.environ:
         prefix="kdtree-tpu-plans-"
     )
 
+# Isolate flight-recorder incident dumps the same way: tests exercise the
+# CLI failure and serve error paths on purpose, and their auto-dumps must
+# land in a per-run tmp dir, not in the developer's working tree.
+if "KDTREE_TPU_FLIGHT_DIR" not in os.environ:
+    os.environ["KDTREE_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="kdtree-tpu-flight-"
+    )
+
 import pytest
 
 # Lane split (VERDICT r4 weak #7): the full suite needs xdist on a small

@@ -484,3 +484,59 @@ def test_repo_lints_clean_against_committed_baseline():
         "unbaselined lint findings:\n"
         + "\n".join(f"{f.location()}: {f.rule} {f.message}" for f in new)
     )
+
+
+# ---------------------------------------------------------------------------
+# KDT105 dynamic-metric-name
+# ---------------------------------------------------------------------------
+
+
+def test_kdt105_flags_fstring_span_name(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu import obs\n"
+        "def run(i):\n"
+        "    with obs.span(f'batch.{i}'):\n"
+        "        pass\n"
+    ))
+    assert rules_of(res) == ["KDT105"]
+    assert "f-string" in res.findings[0].message
+
+
+def test_kdt105_flags_dynamic_counter_name_and_label_value(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu import obs\n"
+        "def count(shard, reg):\n"
+        "    reg.counter('prefix_' + shard).inc()\n"
+        "    reg.counter('kdtree_x_total',\n"
+        "                labels={'shard': 'shard-%d' % shard}).inc()\n"
+        "    reg.gauge('kdtree_g', labels={'who': '{}'.format(shard)})\n"
+    ))
+    assert rules_of(res) == ["KDT105", "KDT105", "KDT105"]
+
+
+def test_kdt105_clean_for_static_names_and_enum_labels(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu import obs\n"
+        "def setup(reg, path):\n"
+        "    # bounded-enum idiom: label values bound from a literal tuple\n"
+        "    lat = {p: reg.histogram('kdtree_serve_request_seconds',\n"
+        "                            labels={'phase': p})\n"
+        "           for p in ('queue', 'dispatch', 'total')}\n"
+        "    with obs.span('query.tiled', q=7):\n"
+        "        pass\n"
+        "    reg.histogram('kdtree_span_seconds', labels={'span': path})\n"
+        "    return lat\n"
+    ))
+    assert rules_of(res) == []
+
+
+def test_kdt105_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu import obs\n"
+        "def run(i):\n"
+        "    with obs.span(f'x.{i}'):  "
+        "# kdt-lint: disable=KDT105 bounded by test fixture\n"
+        "        pass\n"
+    ))
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1

@@ -1,0 +1,253 @@
+"""Flight recorder (docs/OBSERVABILITY.md "Flight recorder"): the
+always-on ring must wrap correctly under concurrency, dump atomically
+and parseably (SIGUSR2 included), fire on the serve error path, and stay
+in the host-cheap telemetry tier."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kdtree_tpu.obs import flight
+
+
+def test_ring_wraps_and_reports_dropped():
+    rec = flight.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("e", i=i)
+    snap = rec.snapshot()
+    assert len(snap) == 8
+    # oldest events fell off the front; the newest 8 survive, in order
+    assert [e["i"] for e in snap] == list(range(12, 20))
+    st = rec.stats()
+    assert st["events"] == 8 and st["dropped"] == 12
+    rep = rec.report("unit")
+    assert rep["dropped"] == 12 and rep["reason"] == "unit"
+
+
+def test_ring_concurrent_writers_lose_nothing_within_capacity():
+    rec = flight.FlightRecorder(capacity=4096)
+    threads = [
+        threading.Thread(
+            target=lambda t=t: [rec.record("e", t=t, i=i)
+                                for i in range(256)]
+        )
+        for t in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    snap = rec.snapshot()
+    assert len(snap) == 8 * 256
+    # seq is the global order stamp: strictly increasing, gap-free
+    seqs = [e["seq"] for e in snap]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # every writer's own stream arrives complete and in its own order
+    for t in range(8):
+        mine = [e["i"] for e in snap if e["t"] == t]
+        assert mine == list(range(256))
+
+
+def test_record_never_raises_on_unserializable_fields(tmp_path):
+    rec = flight.FlightRecorder(capacity=4)
+    rec.record("weird", obj=object())  # not JSON-serializable
+    rec.record("ok", x=1)
+    # the dump must still produce a parseable file: the default=str
+    # fallback is deliberate — never lose the parseable ring to one field
+    path = rec.dump(str(tmp_path / "f.json"), reason="unit")
+    data = json.loads(open(path).read())
+    assert [e["type"] for e in data["events"]] == ["weird", "ok"]
+
+
+def test_dump_atomic_and_parseable(tmp_path):
+    rec = flight.FlightRecorder(capacity=16)
+    for i in range(5):
+        rec.record("evt", i=i)
+    path = str(tmp_path / "flight.json")
+    out = rec.dump(path, reason="test")
+    assert out == path
+    data = json.loads(open(path).read())
+    assert data["flight_version"] == flight.DUMP_VERSION
+    assert data["pid"] == os.getpid()
+    assert [e["i"] for e in data["events"]] == list(range(5))
+    # no tmp litter: the write is tmp + os.replace
+    assert [f for f in os.listdir(tmp_path) if "tmp" in f] == []
+
+
+def test_sigusr2_dump_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    assert flight.install_signal_handler()
+    flight.record("before-signal", marker=1234)
+    signal.raise_signal(signal.SIGUSR2)
+    path = tmp_path / "flight-sigusr2.json"
+    assert path.exists()
+    data = json.loads(path.read_text())
+    assert data["reason"] == "sigusr2"
+    assert any(e.get("marker") == 1234 for e in data["events"])
+    # concurrent writers + a second signal: the dump must stay parseable
+    stop = threading.Event()
+
+    def spam():
+        while not stop.is_set():
+            flight.record("spam")
+
+    th = threading.Thread(target=spam)
+    th.start()
+    try:
+        signal.raise_signal(signal.SIGUSR2)
+        data = json.loads(path.read_text())
+        assert data["flight_version"] == flight.DUMP_VERSION
+    finally:
+        stop.set()
+        th.join()
+
+
+def test_sigusr2_while_main_thread_holds_ring_lock(tmp_path, monkeypatch):
+    """Deadlock regression: the signal handler runs on the MAIN thread
+    between any two bytecodes — including inside record()'s critical
+    section. The ring lock is reentrant so the dump completes instead of
+    hanging the process on its own lock."""
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    assert flight.install_signal_handler()
+    flight.record("locked-section-marker")
+    with flight.recorder()._lock:  # the interrupted critical section
+        signal.raise_signal(signal.SIGUSR2)
+    path = tmp_path / "flight-sigusr2.json"
+    assert path.exists()
+    data = json.loads(path.read_text())
+    assert any(e["type"] == "locked-section-marker" for e in data["events"])
+
+
+def test_env_capacity_defaults_on_garbage(monkeypatch):
+    """A malformed KDTREE_TPU_FLIGHT_EVENTS must default, not crash the
+    import that every instrumented module performs."""
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_EVENTS", "abc")
+    assert flight._env_capacity() == flight.DEFAULT_CAPACITY
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_EVENTS", "0")
+    assert flight._env_capacity() == flight.DEFAULT_CAPACITY
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_EVENTS", "17")
+    assert flight._env_capacity() == 17
+    monkeypatch.delenv("KDTREE_TPU_FLIGHT_EVENTS")
+    assert flight._env_capacity() == flight.DEFAULT_CAPACITY
+
+
+def test_auto_dump_rate_limited_and_disableable(tmp_path, monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    rec = flight.FlightRecorder(capacity=4)
+    rec.record("x")
+    first = rec.auto_dump("unit-reason")
+    assert first and os.path.exists(first)
+    # within the rate-limit window the second dump is suppressed...
+    assert rec.auto_dump("unit-reason") is None
+    # ...unless forced (the operator's SIGUSR2 path)
+    assert rec.auto_dump("unit-reason", force=True) == first
+    # disabled dir -> no file, no error
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", "none")
+    assert rec.auto_dump("other-reason") is None
+
+
+def test_burst_detector_fires_on_burst_not_trickle():
+    det = flight.BurstDetector(threshold=5, window_s=10.0)
+    fired = [det.mark() for _ in range(5)]
+    assert fired == [False, False, False, False, True]
+    # after firing, the window restarts — the next mark alone cannot fire
+    assert det.mark() is False
+    # a trickle slower than the window never fires
+    slow = flight.BurstDetector(threshold=3, window_s=0.001)
+    fired = []
+    for _ in range(6):
+        fired.append(slow.mark())
+        time.sleep(0.005)
+    assert fired == [False] * 6
+
+
+def test_span_completions_land_in_ring():
+    from kdtree_tpu import obs
+
+    rec = flight.recorder()
+    before = rec.stats()["events"] + rec.stats()["dropped"]
+    with obs.span("flighttest.section", sync=False):
+        pass
+    snap = rec.snapshot()
+    mine = [e for e in snap if e.get("span") == "flighttest.section"]
+    assert mine and mine[-1]["type"] == "span"
+    assert mine[-1]["seconds"] >= 0.0
+    assert rec.stats()["events"] + rec.stats()["dropped"] > before
+
+
+def test_serve_error_path_triggers_ring_event_and_dump(tmp_path, monkeypatch):
+    """A batch-dispatch failure must leave a serve.batch_error event AND
+    an incident dump file (the tentpole's serve-error trigger)."""
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
+    from kdtree_tpu.serve.batcher import MicroBatcher
+
+    class BoomEngine:
+        def knn_batch(self, q):
+            raise RuntimeError("boom")
+
+        def fallback_knn(self, q, k):
+            raise RuntimeError("boom-fallback")
+
+    queue = AdmissionQueue(max_rows=64)
+    b = MicroBatcher(BoomEngine(), queue, max_batch=8, max_wait_ms=1.0)
+    req = PendingRequest(np.zeros((2, 3), np.float32), k=1,
+                         trace_id="trace-boom")
+    b.start()
+    try:
+        queue.submit(req)
+        assert req.event.wait(timeout=30.0)
+    finally:
+        b.stop()
+    assert req.error is not None and "boom" in req.error
+    events = flight.recorder().snapshot()
+    errs = [e for e in events if e["type"] == "serve.batch_error"]
+    assert errs and "trace-boom" in errs[-1]["traces"]
+    dump = tmp_path / "flight-serve-error.json"
+    assert dump.exists()
+    data = json.loads(dump.read_text())
+    assert data["reason"] == "serve-error"
+
+
+def test_shed_burst_triggers_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    from kdtree_tpu.serve.admission import (
+        SHED_BURST_THRESHOLD,
+        AdmissionQueue,
+        PendingRequest,
+        QueueFullError,
+    )
+
+    queue = AdmissionQueue(max_rows=1)
+    blocker = PendingRequest(np.zeros((1, 3), np.float32), k=1)
+    queue.submit(blocker)  # fills the budget; everything below sheds
+    for _ in range(SHED_BURST_THRESHOLD):
+        with pytest.raises(QueueFullError):
+            queue.submit(PendingRequest(np.zeros((1, 3), np.float32), k=1,
+                                        trace_id="shedder"))
+    dump = tmp_path / "flight-serve-shed-burst.json"
+    assert dump.exists()
+    data = json.loads(dump.read_text())
+    sheds = [e for e in data["events"] if e["type"] == "serve.shed"]
+    assert len(sheds) >= SHED_BURST_THRESHOLD
+
+
+def test_recorder_overhead_is_host_cheap():
+    """The always-on tier promise: recording is a dict build + locked
+    deque append. Budget is generous for CI-container noise but still
+    orders of magnitude below anything that could move a <2% bench
+    overhead bar (events are per span/batch/request, never per row)."""
+    rec = flight.FlightRecorder(capacity=1024)
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec.record("bench", i=i, rows=128, plan="warm")
+    per_event = (time.perf_counter() - t0) / n
+    assert per_event < 50e-6, f"record() cost {per_event * 1e6:.1f}us/event"

@@ -3,6 +3,7 @@ runtime listeners (recompile detection), exporters, domain-counter wiring
 through the engines, and the CLI --metrics-out / stats round trip."""
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -326,6 +327,131 @@ def test_jsonl_event_log(tmp_path):
     assert "span" in kinds and "marker" in kinds
     sp = next(ln for ln in lines if ln["type"] == "span")
     assert sp["span"] == "logged_span" and sp["seconds"] >= 0.0
+
+
+def test_jsonl_size_cap_rotates(tmp_path):
+    """The event log must not grow unboundedly in a long-lived serving
+    process: past the byte budget it rotates ONCE to .1 and keeps
+    logging, so disk usage stays bounded at ~2x the budget with the
+    newest events always on disk."""
+    path = str(tmp_path / "events.jsonl")
+    export.configure_jsonl(path, max_bytes=600)
+    try:
+        for i in range(40):
+            export.emit_event({"type": "marker", "i": i, "pad": "x" * 40})
+    finally:
+        export.configure_jsonl(None)
+    rotated = path + ".1"
+    assert os.path.exists(rotated), "no rotation happened"
+    assert os.path.getsize(path) <= 600 + 200  # fresh segment, bounded
+    assert os.path.getsize(rotated) <= 600 + 200
+    new_lines = [json.loads(ln) for ln in open(path)]
+    # the fresh segment announces the rotation and keeps the NEWEST events
+    assert new_lines[0]["type"] == "rotated"
+    assert new_lines[0]["previous"] == rotated
+    assert new_lines[-1]["i"] == 39
+    old_lines = [json.loads(ln) for ln in open(rotated)]
+    assert old_lines[-1]["i"] < new_lines[1]["i"]
+    # a second configure of the same path counts the existing size
+    export.configure_jsonl(path, max_bytes=600)
+    export.configure_jsonl(None)
+
+
+def test_jsonl_survives_external_log_removal(tmp_path):
+    """Self-heal regression: if the log is removed EXTERNALLY (logrotate,
+    operator cleanup) while the internal byte counter sits at the budget,
+    emit_event must re-sync from the file's true size and keep logging —
+    not retry a failing os.replace and silently drop every event
+    forever."""
+    path = str(tmp_path / "events.jsonl")
+    export.configure_jsonl(path, max_bytes=10_000)
+    export.emit_event({"type": "probe", "pad": "x" * 40})
+    one = os.path.getsize(path)
+    os.remove(path)
+    export.configure_jsonl(path, max_bytes=int(2.5 * one))
+    try:
+        export.emit_event({"type": "marker", "i": 0, "pad": "x" * 40})
+        export.emit_event({"type": "marker", "i": 1, "pad": "x" * 40})
+        os.remove(path)  # external cleanup at the worst possible moment
+        # this one crosses the budget -> rotation fails (no file) -> the
+        # counter re-syncs and the event still lands
+        export.emit_event({"type": "marker", "i": 2, "pad": "x" * 40})
+        export.emit_event({"type": "marker", "i": 3, "pad": "x" * 40})
+    finally:
+        export.configure_jsonl(None)
+    assert os.path.exists(path)
+    lines = [json.loads(ln) for ln in open(path)]
+    assert [ln["i"] for ln in lines if ln.get("type") == "marker"] == [2, 3]
+
+
+def test_jsonl_cap_disabled_with_nonpositive_budget(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    export.configure_jsonl(path, max_bytes=0)
+    try:
+        for i in range(50):
+            export.emit_event({"type": "marker", "i": i, "pad": "x" * 40})
+    finally:
+        export.configure_jsonl(None)
+    assert not os.path.exists(path + ".1")
+    assert len(open(path).readlines()) == 50
+
+
+def test_render_report_diff_spans_counters_deltas():
+    old = {
+        "platform": "cpu", "counters": {
+            "jax_backend_compiles_total": 10.0,
+            "kdtree_tile_overflow_retries_total": 2.0,
+        },
+        "gauges": {"kdtree_tile_prune_rate": 0.9},
+        "spans": {
+            "bench.build": {"count": 1, "total_seconds": 10.0,
+                            "mean_seconds": 10.0},
+            "gone.section": {"count": 1, "total_seconds": 1.0,
+                             "mean_seconds": 1.0},
+        },
+    }
+    new = {
+        "platform": "cpu", "counters": {
+            "jax_backend_compiles_total": 25.0,
+            "kdtree_tile_overflow_retries_total": 2.0,
+        },
+        "gauges": {"kdtree_tile_prune_rate": 0.5},
+        "spans": {
+            "bench.build": {"count": 1, "total_seconds": 12.0,
+                            "mean_seconds": 12.0},
+            "fresh.section": {"count": 3, "total_seconds": 0.3,
+                              "mean_seconds": 0.1},
+        },
+    }
+    text = export.render_report_diff(old, new)
+    assert "+20.0%" in text            # bench.build total 10 -> 12
+    assert "gone" in text and "new" in text  # one-sided spans marked
+    assert "backend compiles" in text and "+150.0%" in text
+    assert "kdtree_tile_prune_rate" in text  # gauge moved
+
+
+def test_cli_stats_diff_roundtrip(tmp_path, capsys):
+    """`kdtree-tpu stats --diff OLD NEW` over two real --metrics-out
+    reports, plus the arity validation."""
+    from kdtree_tpu.utils import cli
+
+    reg = MetricsRegistry()
+    reg.counter("kdtree_tile_batches_total").inc(3)
+    old_p = str(tmp_path / "old.json")
+    new_p = str(tmp_path / "new.json")
+    export.write_report(old_p, registry=reg)
+    reg.counter("kdtree_tile_batches_total").inc(5)
+    export.write_report(new_p, registry=reg)
+    cli.main(["stats", "--diff", old_p, new_p])
+    out = capsys.readouterr().out
+    assert "kdtree_tile_batches_total" in out
+    assert "+166.7%" in out  # 3 -> 8
+    with pytest.raises(SystemExit) as e:
+        cli.main(["stats", "--diff", old_p])
+    assert e.value.code == 1
+    with pytest.raises(SystemExit) as e:
+        cli.main(["stats", old_p, new_p])
+    assert e.value.code == 1
 
 
 # ---------------------------------------------------------------------------

@@ -478,3 +478,109 @@ def test_shutdown_not_wedged_by_idle_keepalive_connection(tree):
         assert time.monotonic() - t0 < 30.0
     finally:
         conn.close()
+
+
+def test_trace_id_echoed_sanitized_and_generated(server):
+    """Every /v1/knn answer carries a trace id: the client's
+    X-Request-Id (sanitized — it flows into flight dumps verbatim) or a
+    server-generated one; the same id must appear in the flight ring's
+    per-request decomposition."""
+    q = _queries(2).tolist()
+    req = urllib.request.Request(
+        _url(server, "/v1/knn"), data=json.dumps({"queries": q}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "my trace/1!"},
+    )
+    with urllib.request.urlopen(req, timeout=120.0) as resp:
+        body = json.loads(resp.read())
+    assert body["trace_id"] == "my-trace-1-"  # sanitized, not verbatim
+    from kdtree_tpu.obs import flight
+
+    events = flight.recorder().snapshot()
+    mine = [e for e in events if e.get("type") == "serve.request"
+            and e.get("trace") == "my-trace-1-"]
+    assert mine, "per-request decomposition missing from the flight ring"
+    assert mine[-1]["queue_ms"] >= 0.0
+    assert mine[-1]["device_ms"] >= 0.0
+    # no header -> server-generated id, still echoed
+    status, body = _post(server, {"queries": q})
+    assert status == 200 and len(body["trace_id"]) == 16
+
+
+def test_debug_flight_endpoint_returns_ring(server):
+    status, body = _get(server, "/debug/flight")
+    assert status == 200
+    data = json.loads(body)
+    assert data["reason"] == "debug-endpoint"
+    assert data["capacity"] >= 1
+    types = {e["type"] for e in data["events"]}
+    # the warmup span and the admissions above must be in recent history
+    assert "serve.admit" in types or "serve.request" in types
+
+
+def test_debug_flight_tolerates_unserializable_ring_fields(server):
+    """record() accepts arbitrary fields by design (it never raises into
+    the instrumented caller), so the endpoint must serialize the ring
+    with the same default=str fallback the SIGUSR2 dump uses — not drop
+    the connection on the first odd value."""
+    from kdtree_tpu.obs import flight
+
+    flight.recorder().record("weird-field", obj=object())
+    status, body = _get(server, "/debug/flight")
+    assert status == 200
+    data = json.loads(body)
+    assert any(e["type"] == "weird-field" for e in data["events"])
+
+
+def test_debug_profile_validation(server):
+    # bad seconds -> 400 (capture-free: the fast tier-1 lane must not
+    # pay the profiler backend's one-time ~14s init)
+    for qs in ("seconds=zap", "seconds=0", "seconds=1e9"):
+        req = urllib.request.Request(
+            _url(server, f"/debug/profile?{qs}"), data=b"", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30.0)
+        assert e.value.code == 400
+
+
+@pytest.mark.slow  # opens real capture windows (one-time ~14s profiler
+# init); CI's profile-smoke gates this e2e against a live server anyway
+def test_debug_profile_captures_live_traffic(server, tmp_path):
+    """POST /debug/profile over a live window that contains a dispatched
+    batch: the response is a parseable timeline whose device section saw
+    the batch's op slices. A capture held elsewhere in the process must
+    409 instead of corrupting it."""
+    from kdtree_tpu.obs import profile as obs_profile
+
+    with obs_profile.capture(str(tmp_path / "busy")):
+        req = urllib.request.Request(
+            _url(server, "/debug/profile?seconds=0.1"), data=b"",
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30.0)
+        assert e.value.code == 409
+    out = {}
+
+    def run_profile():
+        req = urllib.request.Request(
+            _url(server, "/debug/profile?seconds=0.8"), data=b"",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120.0) as resp:
+            out["rep"] = json.loads(resp.read())
+
+    prof = threading.Thread(target=run_profile)
+    prof.start()
+    time.sleep(0.25)  # let the capture open
+    status, _ = _post(server, {"queries": _queries(4).tolist()})
+    assert status == 200
+    prof.join()
+    rep = out["rep"]
+    assert rep["timeline_version"] == 1
+    assert rep["seconds_requested"] == 0.8
+    assert rep["device"]["n_slices"] >= 1, "no device work captured"
+    # the serve.batch span (sync=False, but it materializes the result
+    # inside the span) must correlate with the batch's device slices
+    assert rep["correlated_spans"] >= 1
