@@ -3,16 +3,32 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
 "platform": ..., "device_count": N, "device_init_seconds": N,
-"degraded": bool, "extra_metrics": [...]}. The platform/init/degraded keys
-are the bench-honesty contract (BENCH_r05 recorded a 600 s wedged init +
-silent CPU fallback that was indistinguishable from a healthy TPU run):
-"degraded": true marks a tunnel-wedge CPU fallback, so rounds can never
-compare a fallback run against TPU numbers unknowingly.
+"degraded": false | "<reason>", "extra_metrics": [...]}. The
+platform/init/degraded keys are the bench-honesty contract (BENCH_r05
+recorded a 600 s wedged init + silent CPU fallback that was
+indistinguishable from a healthy TPU run): "degraded" carries the
+fallback REASON string on a tunnel-wedge CPU fallback (false on a healthy
+run), so rounds can never compare a fallback run against TPU numbers
+unknowingly — nor wonder WHY a run fell back. The device-init window is
+configurable via KDTREE_TPU_DEVICE_INIT_TIMEOUT_S (default 600).
+
+`--pair` runs the timed sections TWICE back-to-back in one process and
+attaches the first pass's numbers under "pair_first": container CPU noise
+is +-40% run-to-run, so only paired same-process runs are comparable —
+compare pass 2 vs pass 2 across code versions, with pass 1 as the
+warm/cold delta. The telemetry sidecar of a --pair run aggregates spans
+and counters over BOTH passes (one obs registry per process) and says so
+via its "passes": 2 marker; `stats --diff` a pair sidecar only against
+another pair sidecar.
 
 A telemetry sidecar (full metrics/span report, docs/OBSERVABILITY.md) is
 written to $KDTREE_TPU_METRICS_OUT (default ./bench_telemetry.json;
 "none" disables telemetry entirely — the A/B partner for the <2%
-metrics-overhead acceptance check). Render it with `kdtree-tpu stats`.
+metrics-overhead acceptance check). The sidecar also carries a "profile"
+block (device busy_frac + per-dispatch busy/lag medians from a short
+in-bench jax.profiler capture of the tiled-query shape, docs/TUNING.md
+"Raw speed") so the >90% busy_frac target is a mechanical regression
+gate. Render it with `kdtree-tpu stats`.
 
 Headline (unchanged since r2, comparable across rounds): single-chip
 gen+build+10xNN points/sec over 16M x 3-D, vs the reference's 122.8 s on one
@@ -112,7 +128,10 @@ def _device_probe(timeout_s: float = 600.0) -> float:
               file=sys.stderr)
         sys.stderr.flush()
         os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["BENCH_TUNNEL_FALLBACK"] = "1"
+        # the value IS the reason: the re-exec'd process publishes it in
+        # the headline's "degraded" field, so a fallback run says WHY it
+        # fell back instead of a bare true (silent since r03 otherwise)
+        os.environ["BENCH_TUNNEL_FALLBACK"] = msg
         try:
             os.execv(sys.executable,
                      [sys.executable, os.path.abspath(__file__),
@@ -333,15 +352,79 @@ def bench_clustered(kt, n: int, dim: int, nq: int):
     return dt, ok
 
 
+def bench_profile(tree, Q: int, k: int, dim: int):
+    """Short jax.profiler capture of one warm tiled-query run at the
+    bench shape; returns the sidecar "profile" block (device busy_frac,
+    per-dispatch busy/lag medians) or None when capture is unavailable.
+    Runs AFTER the headline query section (the first start_trace pays a
+    ~14 s one-time init that must never land inside the sections already
+    timed) but BEFORE the accelerator-only sections — the nbig branch
+    frees the 16M tree this capture needs — and never raises: the
+    capture observes the bench, it must not fail it."""
+    import shutil
+    import tempfile
+
+    try:
+        from kdtree_tpu import obs
+        from kdtree_tpu.obs import profile as obs_profile
+        from kdtree_tpu.obs import timeline as obs_timeline
+        from kdtree_tpu.ops.generate import generate_queries
+        from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+        qs = generate_queries(101, dim, Q)
+        d2, _ = morton_knn_tiled(tree, qs, k=k)
+        obs.hard_sync(d2)  # warm: keep compiles out of the window
+        trace_dir = tempfile.mkdtemp(prefix="kdtree-bench-profile-")
+        try:
+            with obs_profile.capture(trace_dir) as cap:
+                d2, ids = morton_knn_tiled(tree, qs, k=k)
+                obs.hard_sync([d2, ids])
+            if cap.trace_file is None:
+                return None
+            rep = obs_timeline.analyze_trace_file(cap.trace_file)
+        finally:
+            # traces at this shape run tens of MB; repeated bench runs
+            # (paired A/B loops) must not accumulate them in tmp
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        disp = rep.get("dispatches", {})
+        return {
+            "q": Q,
+            "k": k,
+            "busy_frac": rep["device"]["busy_frac"],
+            "dispatch_busy_frac_median": disp.get("busy_frac_median"),
+            "dispatch_lag_us_median": (disp.get("lag_us") or {}).get(
+                "median"),
+            "dispatches": disp.get("count"),
+            "compiles_in_window": rep["compile"]["count"],
+        }
+    except Exception as e:
+        print(f"bench: profile capture skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pair", action="store_true",
+                    help="run the timed sections twice back-to-back and "
+                         "attach the first pass under pair_first "
+                         "(container noise is +-40%%; only paired runs "
+                         "are comparable)")
+    args = ap.parse_args()
+
     # restore env-var platform semantics: the axon sitecustomize overrides
     # JAX_PLATFORMS with a config update, so a JAX_PLATFORMS=cpu bench run
     # would still dial the tunnel first (and hang with it wedged)
     env_plat = os.environ.get("JAX_PLATFORMS", "")
     if env_plat and "axon" not in env_plat:
         jax.config.update("jax_platforms", env_plat)
+    raw_timeout = os.environ.get(
+        "KDTREE_TPU_DEVICE_INIT_TIMEOUT_S",
+        os.environ.get("BENCH_DEVICE_PROBE_S", "600"),
+    )
     try:
-        probe_s = float(os.environ.get("BENCH_DEVICE_PROBE_S", "600"))
+        probe_s = float(raw_timeout)
     except ValueError:
         probe_s = 600.0
     init_s = _device_probe(probe_s)
@@ -364,8 +447,10 @@ def main() -> None:
         jaxrt.record_device_init(init_s)
 
     # bench honesty (BENCH_r05 lesson): platform/device facts ride in the
-    # metric line itself so a CPU-fallback run can never pass as TPU
-    degraded = bool(os.environ.get("BENCH_TUNNEL_FALLBACK"))
+    # metric line itself so a CPU-fallback run can never pass as TPU —
+    # and since PR 6 the degraded field carries the fallback REASON (the
+    # legacy "1" value from an old re-exec still reads as degraded)
+    degraded = os.environ.get("BENCH_TUNNEL_FALLBACK") or False
     platform = jax.devices()[0].platform
     device_count = len(jax.devices())
     on_accel = platform not in ("cpu",)
@@ -383,126 +468,151 @@ def main() -> None:
         cn, cdim, cbase_s = 50_000, 128, None
     nq = 10
 
-    with obs.span("bench.build"):
-        best, (pts, qs, d2, tree) = bench_build(kt, n, 3, nq)
-        bf, _ = kt.bruteforce.knn(pts, qs, k=1)
-        if not np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0],
-                           rtol=1e-4):
-            _fail("oracle check (build)")
-    pts_per_s = n / best
     base_pts_per_s = n / base_s
+    profile_block = None
 
-    extra = []
+    def measure(capture: bool):
+        """One full pass over every timed section; returns
+        (pts_per_s, extra_metrics). ``capture`` additionally runs the
+        post-section profile capture (once, on the final pass — its ~14 s
+        profiler init must not sit between a pair's passes)."""
+        nonlocal profile_block
 
-    with obs.span("bench.queries"):
-        qdt, qok, plan_cache, recompiles = bench_queries(kt, pts, tree, Q, k)
-    if not qok:
-        _fail("oracle check (query)")
-    extra.append({
-        "metric": f"k-NN queries/sec (Q={Q}, k={k}, {cfg} tree, tiled"
-                  f"{'+pallas' if on_accel else ''}, {platform})",
-        "value": round(Q / qdt),
-        "unit": "q/s",
-        "vs_baseline": None,  # reference: 10 hardcoded 1-NN queries, no
-                              # separable timer -> no honest baseline
-        "plan_cache": plan_cache,
-        "recompiles": recompiles,
-    })
+        with obs.span("bench.build"):
+            best, (pts, qs, d2, tree) = bench_build(kt, n, 3, nq)
+            bf, _ = kt.bruteforce.knn(pts, qs, k=1)
+            if not np.allclose(np.asarray(d2)[:, 0], np.asarray(bf)[:, 0],
+                               rtol=1e-4):
+                _fail("oracle check (build)")
+        pts_per_s = n / best
 
-    if Qbig:
-        # north-star query shape (BASELINE.json: 10M k-NN, k=16) — the
-        # per-batch programs are those already compiled for Q above, so the
-        # extra warmup mostly pays for the 10M-row sort/unsort compiles
-        with obs.span("bench.queries-10M"):
-            qbdt, qbok, qbplan, qbrecomp = bench_queries(kt, pts, tree,
-                                                         Qbig, k)
-        if not qbok:
-            _fail("oracle check (query-10M)")
+        extra = []
+
+        with obs.span("bench.queries"):
+            qdt, qok, plan_cache, recompiles = bench_queries(kt, pts, tree,
+                                                             Q, k)
+        if not qok:
+            _fail("oracle check (query)")
         extra.append({
-            "metric": f"k-NN queries/sec (Q={Qbig}, k={k}, {cfg} tree, "
-                      f"north-star shape, {platform})",
-            "value": round(Qbig / qbdt),
+            "metric": f"k-NN queries/sec (Q={Q}, k={k}, {cfg} tree, tiled"
+                      f"{'+pallas' if on_accel else ''}, {platform})",
+            "value": round(Q / qdt),
             "unit": "q/s",
-            "vs_baseline": None,
-            "plan_cache": qbplan,
-            "recompiles": qbrecomp,
+            "vs_baseline": None,  # reference: 10 hardcoded 1-NN queries, no
+                                  # separable timer -> no honest baseline
+            "plan_cache": plan_cache,
+            "recompiles": recompiles,
         })
+        if capture and metrics_out:
+            profile_block = bench_profile(tree, Q, k, 3)
 
-    if on_accel:
-        # sparse 64k-query DFS measurement (r4 item 9): uses the 16M tree
-        # built above, before the big-build section frees it
-        Qs = 1 << 16
-        with obs.span("bench.sparse-dfs"):
-            sdt, sok = bench_sparse_dfs(kt, tree, pts, Qs, k)
-        if not sok:
-            _fail("oracle check (sparse-dfs-64k)")
-        extra.append({
-            "metric": f"sparse DFS k-NN queries/sec (Q={Qs}, k={k}, {cfg} "
-                      f"tree, async chunk loop, {platform})",
-            "value": round(Qs / sdt),
-            "unit": "q/s",
-            "vs_baseline": None,
-        })
+        if Qbig:
+            # north-star query shape (BASELINE.json: 10M k-NN, k=16) — the
+            # per-batch programs are those already compiled for Q above, so
+            # the extra warmup mostly pays for the 10M-row sort/unsort
+            # compiles
+            with obs.span("bench.queries-10M"):
+                qbdt, qbok, qbplan, qbrecomp = bench_queries(kt, pts, tree,
+                                                             Qbig, k)
+            if not qbok:
+                _fail("oracle check (query-10M)")
+            extra.append({
+                "metric": f"k-NN queries/sec (Q={Qbig}, k={k}, {cfg} tree, "
+                          f"north-star shape, {platform})",
+                "value": round(Qbig / qbdt),
+                "unit": "q/s",
+                "vs_baseline": None,
+                "plan_cache": qbplan,
+                "recompiles": qbrecomp,
+            })
 
-        # Pallas kernel under shard_map on the real chip (r4 item 3)
-        np_, qp = 1 << 22, 1 << 16  # dense: Q*64 >= N -> SPMD tiled route
-        with obs.span("bench.spmd-pallas"):
-            pdt, pused, pok = bench_spmd_pallas(kt, np_, 3, qp, k)
-        if not pok:
-            _fail("oracle check (pallas-spmd)")
-        extra.append({
-            "metric": f"SPMD tiled forest queries/sec (Q={qp}, k={k}, 4M "
-                      f"tree, 1-device mesh, use_pallas={pused}, "
-                      f"{platform})",
-            "value": round(qp / pdt),
-            "unit": "q/s",
-            "vs_baseline": None,
-        })
+        if on_accel:
+            # sparse 64k-query DFS measurement (r4 item 9): uses the 16M
+            # tree built above, before the big-build section frees it
+            Qs = 1 << 16
+            with obs.span("bench.sparse-dfs"):
+                sdt, sok = bench_sparse_dfs(kt, tree, pts, Qs, k)
+            if not sok:
+                _fail("oracle check (sparse-dfs-64k)")
+            extra.append({
+                "metric": f"sparse DFS k-NN queries/sec (Q={Qs}, k={k}, "
+                          f"{cfg} tree, async chunk loop, {platform})",
+                "value": round(Qs / sdt),
+                "unit": "q/s",
+                "vs_baseline": None,
+            })
 
-    if nbig:
-        # biggest single-chip build: the honest datapoint toward the 1B
-        # north star (beyond this, the global-morton mesh path takes over).
-        # Free the 16M bench context first — HBM headroom at 128M is thin.
-        del pts, qs, d2, tree
-        with obs.span("bench.build-128M"):
-            bdt, bok = bench_build_big(kt, nbig, 3, nq)
-        if not bok:
-            _fail("oracle check (build-128M)")
+            # Pallas kernel under shard_map on the real chip (r4 item 3)
+            np_, qp = 1 << 22, 1 << 16  # dense: Q*64 >= N -> SPMD tiled
+            with obs.span("bench.spmd-pallas"):
+                pdt, pused, pok = bench_spmd_pallas(kt, np_, 3, qp, k)
+            if not pok:
+                _fail("oracle check (pallas-spmd)")
+            extra.append({
+                "metric": f"SPMD tiled forest queries/sec (Q={qp}, k={k}, "
+                          f"4M tree, 1-device mesh, use_pallas={pused}, "
+                          f"{platform})",
+                "value": round(qp / pdt),
+                "unit": "q/s",
+                "vs_baseline": None,
+            })
+
+        if nbig:
+            # biggest single-chip build: the honest datapoint toward the 1B
+            # north star (beyond this, the global-morton mesh path takes
+            # over). Free the 16M bench context first — HBM headroom at
+            # 128M is thin.
+            del pts, qs, d2, tree
+            with obs.span("bench.build-128M"):
+                bdt, bok = bench_build_big(kt, nbig, 3, nq)
+            if not bok:
+                _fail("oracle check (build-128M)")
+            extra.append({
+                "metric": f"gen+build+10xNN points/sec (128M x 3D single "
+                          f"chip, {platform})",
+                "value": round(nbig / bdt),
+                "unit": "pts/s",
+                "vs_baseline": None,
+            })
+
+            # north-star per-device scale through the SCALE engine itself
+            # (driver-visible evidence for docs/SCALING.md item 1)
+            n26 = 1 << 26
+            with obs.span("bench.global-morton"):
+                gdt, gok = bench_global_morton(kt, n26, 3, nq)
+            if not gok:
+                _fail("oracle check (global-morton-2^26)")
+            extra.append({
+                "metric": f"global-morton build+10xNN points/sec (2^26 "
+                          f"rows/device, P=1 mesh, {platform})",
+                "value": round(n26 / gdt),
+                "unit": "pts/s",
+                "vs_baseline": None,
+            })
+
+        with obs.span("bench.clustered"):
+            cdt, cok = bench_clustered(kt, cn, cdim, nq)
+        if not cok:
+            _fail("oracle check (clustered)")
         extra.append({
-            "metric": f"gen+build+10xNN points/sec (128M x 3D single chip, "
-                      f"{platform})",
-            "value": round(nbig / bdt),
+            "metric": f"clustered Gaussian-mixture gen+solve pts/sec "
+                      f"({cn}x{cdim}D, {platform})",
+            "value": round(cn / cdt),
             "unit": "pts/s",
-            "vs_baseline": None,
+            "vs_baseline": (round((cn / cdt) / (cn / cbase_s), 2)
+                            if cbase_s else None),
         })
+        return pts_per_s, extra
 
-        # north-star per-device scale through the SCALE engine itself
-        # (driver-visible evidence for docs/SCALING.md item 1)
-        n26 = 1 << 26
-        with obs.span("bench.global-morton"):
-            gdt, gok = bench_global_morton(kt, n26, 3, nq)
-        if not gok:
-            _fail("oracle check (global-morton-2^26)")
-        extra.append({
-            "metric": f"global-morton build+10xNN points/sec (2^26 "
-                      f"rows/device, P=1 mesh, {platform})",
-            "value": round(n26 / gdt),
-            "unit": "pts/s",
-            "vs_baseline": None,
-        })
-
-    with obs.span("bench.clustered"):
-        cdt, cok = bench_clustered(kt, cn, cdim, nq)
-    if not cok:
-        _fail("oracle check (clustered)")
-    extra.append({
-        "metric": f"clustered Gaussian-mixture gen+solve pts/sec "
-                  f"({cn}x{cdim}D, {platform})",
-        "value": round(cn / cdt),
-        "unit": "pts/s",
-        "vs_baseline": (round((cn / cdt) / (cn / cbase_s), 2)
-                        if cbase_s else None),
-    })
+    pair_first = None
+    if args.pair:
+        first_pts_per_s, first_extra = measure(capture=False)
+        pair_first = {
+            "value": round(first_pts_per_s),
+            "vs_baseline": round(first_pts_per_s / base_pts_per_s, 2),
+            "extra_metrics": first_extra,
+        }
+    pts_per_s, extra = measure(capture=True)
 
     headline = {
         "metric": f"k-d tree gen+build+10xNN points/sec ({cfg}, {platform})",
@@ -518,12 +628,20 @@ def main() -> None:
         "degraded": degraded,
         "extra_metrics": extra,
     }
+    if pair_first is not None:
+        headline["pair_first"] = pair_first
     if metrics_out:
         if obs.finalize_guarded(extra={
             "platform": platform,
             "device_count": device_count,
             "device_init_seconds": init_s,
             "degraded": degraded,
+            "profile": profile_block,
+            # --pair sidecars aggregate spans/counters over BOTH passes
+            # (one registry per process); the marker keeps `stats --diff`
+            # from reading a 2-pass sidecar against a 1-pass one as a 2x
+            # regression — compare only at equal pass counts
+            "passes": 2 if args.pair else 1,
             "headline": {k: headline[k] for k in
                          ("metric", "value", "unit", "vs_baseline")},
         }) is not None:

@@ -53,6 +53,10 @@ DISPATCH_ANNOTATION = "tile.dispatch"
 _SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_+-]*(\.[a-z0-9_+-]+)+$")
 
 _COMPILE_NAMES = frozenset({"backend_compile"})
+# driver-stage annotations inside dispatch windows (drive_batches): the
+# blocking overflow-flag fetches whose per-window overlap decomposes host
+# time into prep / retire-wait / drain-wait
+_STAGE_SPANS = frozenset({"tile.retire", "tile.drain"})
 _MAX_LISTED = 200  # cap per-instance listings so the artifact stays small
 
 
@@ -229,13 +233,33 @@ def parse_timeline(
         )
 
     # dispatch windows: [dispatch_i, dispatch_{i+1}) busy/idle + lag.
-    # busy_frac / lag aggregate over ALL dispatches; only the per-window
-    # listing is capped (_MAX_LISTED) — the aggregates and `count` must
-    # describe the same population.
+    # busy_frac / lag / stage aggregates run over ALL dispatches; only the
+    # per-window listing is capped (_MAX_LISTED) — the aggregates and
+    # `count` must describe the same population. Each window's host time
+    # additionally decomposes by DRIVER STAGE: the pipelined driver wraps
+    # its blocking overflow-flag fetches in ``tile.retire`` / ``tile.drain``
+    # annotations (ops/tile_query.py drive_batches), so window time splits
+    # into retire-wait, drain-wait, and prep (everything else — gather/
+    # pack/dispatch of the NEXT batch, which is exactly the work
+    # pipelining exists to overlap with device execution).
+    stage_iv: Dict[str, List[Tuple[float, float]]] = {}
+    for e in cls.spans:
+        if e["name"] in _STAGE_SPANS:
+            stage_iv.setdefault(e["name"], []).append(
+                (e["ts"], e["ts"] + float(e.get("dur", 0.0)))
+            )
+    stage_merged = {
+        name: _merge(iv) for name, iv in stage_iv.items()
+    }
+    stage_ends = {
+        name: [b for _, b in iv] for name, iv in stage_merged.items()
+    }
     windows: List[dict] = []
     lags: List[float] = []
+    fracs: List[float] = []
     disp_wall = 0.0
     disp_busy = 0.0
+    stage_tot: Dict[str, float] = {name: 0.0 for name in stage_merged}
     for i, e in enumerate(cls.dispatches):
         s = e["ts"]
         w_end = cls.dispatches[i + 1]["ts"] if i + 1 < len(cls.dispatches) \
@@ -248,6 +272,19 @@ def parse_timeline(
             lags.append(lag)
         disp_wall += max(w_end - s, 0.0)
         disp_busy += w_busy
+        if w_end > s:
+            fracs.append(w_busy / (w_end - s))
+        # every window row carries all stage keys (0.0 when the capture
+        # contains no such annotation — e.g. a single-batch run never
+        # drains), so artifacts keep one schema across capture shapes
+        stages = {}
+        for name in sorted(_STAGE_SPANS):
+            dur = 0.0
+            if name in stage_merged:
+                dur = _overlap(stage_merged[name], stage_ends[name], s,
+                               w_end)
+                stage_tot[name] += dur
+            stages[name.split(".", 1)[-1] + "_us"] = dur
         if len(windows) < _MAX_LISTED:
             windows.append({
                 "ts_us": s,
@@ -255,8 +292,10 @@ def parse_timeline(
                 "busy_us": w_busy,
                 "idle_us": max(w_end - s - w_busy, 0.0),
                 "lag_us": lag,
+                **stages,
                 "args": {k: str(v) for k, v in (e.get("args") or {}).items()},
             })
+    stage_wait = sum(stage_tot.values())
 
     compiles = sorted(cls.compiles, key=lambda e: -float(e.get("dur", 0.0)))
     compile_total = sum(float(e.get("dur", 0.0)) for e in cls.compiles)
@@ -292,11 +331,21 @@ def parse_timeline(
         "dispatches": {
             "count": len(cls.dispatches),
             "busy_frac": (disp_busy / disp_wall) if disp_wall else None,
+            "busy_frac_median": _pctl(fracs, 0.5),
             "lag_us": {
                 "n": len(lags),
                 "median": _pctl(lags, 0.5),
                 "p90": _pctl(lags, 0.9),
                 "max": max(lags) if lags else None,
+            },
+            # per-stage host-time decomposition across every dispatch
+            # window: retire/drain = the driver's blocking flag fetches,
+            # prep = the remainder (next-batch host-side work overlapping
+            # device execution — the pipelining win)
+            "stages": {
+                "retire_us": stage_tot.get("tile.retire", 0.0),
+                "drain_us": stage_tot.get("tile.drain", 0.0),
+                "prep_us": max(disp_wall - stage_wait, 0.0),
             },
             "windows": windows,
         },
@@ -384,14 +433,24 @@ def render_timeline(rep: dict) -> str:
         out.append("== batch dispatches ==")
         out.append(f"dispatches:          {disp['count']}")
         if disp.get("busy_frac") is not None:
+            med = disp.get("busy_frac_median")
+            med_s = f" (median {med * 100.0:.1f}%)" if med is not None \
+                else ""
             out.append(
-                f"device busy between: {disp['busy_frac'] * 100.0:.1f}% "
-                "(idle gap = host/queue/transfer time)"
+                f"device busy between: {disp['busy_frac'] * 100.0:.1f}%"
+                f"{med_s} (idle gap = host/queue/transfer time)"
             )
         out.append(
             f"dispatch->exec lag:  median={_us(lag['median'])} "
             f"p90={_us(lag['p90'])} max={_us(lag['max'])}"
         )
+        st = disp.get("stages")
+        if st:
+            out.append(
+                f"host-stage split:    prep={_us(st['prep_us'])} "
+                f"retire={_us(st['retire_us'])} "
+                f"drain={_us(st['drain_us'])}"
+            )
 
     mods = dev.get("modules", [])
     if mods:

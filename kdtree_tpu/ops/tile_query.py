@@ -37,7 +37,9 @@ exact (oracle-tested) — same contract as ``morton_knn``, with ids.
 
 from __future__ import annotations
 
+import collections
 import functools
+import os
 from typing import Callable, NamedTuple, Sequence, Tuple
 
 import jax
@@ -52,13 +54,38 @@ from kdtree_tpu.ops.morton import MortonTree, default_bits
 DEFAULT_TILE = 256
 DEFAULT_CMAX = 128
 DEFAULT_SEEDS = 8
-_SCAN_V = 8  # buckets per dense-scan fold
-_SCAN_ROWS = 8192  # queries per scan block (bounds the [TB, TQ, V*B] block)
-_SCAN_TB = 32  # fallback tiles per scan block for explicit calls
+_SCAN_V = 8  # buckets per dense-scan fold (the "candidate pad" knob: the
+# candidate axis pads to a multiple of it; plan/tuner override via scan_v)
+_PALLAS_V = 1  # fused-kernel fold group (pallas/scan_knn.DEFAULT_V: DMA
+# latency dominates there, so grouping was measured throughput-neutral)
+_SCAN_ROWS = 8192  # queries per scan block on the WIDE fold path (bounds
+# the [TB, TQ, V*B] block)
+_SCAN_ELEMS = 1 << 16  # fold-op element target on the NARROW path: tb is
+# sized so each chunk op stays ~this many elements — small enough that the
+# per-block early exit has real granularity, big enough that XLA:CPU's
+# fixed per-op cost doesn't dominate (tb=2 at tile=128/B=256 measured 2.2x
+# over tb=64 on the profile shape; tb=1 was within noise of tb=2)
+_NARROW_TILE_MIN = 64  # heuristic: tiles this wide take the narrow path
+# (v=1 + early exit); smaller tiles keep the wide top_k fold — narrow
+# chunks at tiny TQ degenerate into op-overhead (measured 741 -> 572 q/s
+# at the 1M/tile=8 bench shape, vs 1.5-2x FASTER at tile>=64 shapes)
+_EXTRACT_K_MAX = 32  # largest k the unrolled argmin-extract fold compiles
+# for; beyond it every width falls back to the top_k formulation
+_EXTRACT_W_MAX = 640  # widest [carry | chunk] buffer the extract fold is
+# allowed: measured on this container's XLA:CPU, k unrolled argmin passes
+# beat nothing at W=2056 (525 ms vs top_k's 102 ms per [64,128,2048]
+# block) but are competitive at W<=~512 — and they are TRACED, so the
+# narrow path's busy_frac is honest where top_k's custom call reads as
+# device idle
 _BATCH_Q = 1 << 16  # queries per device program (watchdog + memory bound);
 # measured at the 10M-query north-star shape with async dispatch: 2^16 ->
 # 365k q/s, 2^17 -> 333k, 2^18 -> 291k — bigger programs don't amortize
 # anything further once dispatch is async, they just coarsen retries
+DEFAULT_LOOKAHEAD = 8  # batches the pipelined driver keeps in flight before
+# it blocks on the oldest batch's overflow flag: enough queue depth that the
+# device never drains between programs, small enough that in-flight output
+# buffers stay bounded at the 10M-query shape (~150 batches would otherwise
+# all be resident at once). KDTREE_TPU_TILE_LOOKAHEAD overrides.
 
 
 def _gathered_box_lb(tree, box_lo, box_hi, ids):
@@ -137,12 +164,80 @@ def _frontier(tree: MortonTree, box_lo, box_hi, bound, cap: int):
     return bucket, lb, overflow
 
 
-def _scan_tiles(tree: MortonTree, tq, cand, k: int, v: int, tb: int):
+def _fold_block(best_d, best_i, d2, gids, k: int):
+    """Merge a [..., W] candidate block into the ascending [..., k] best
+    buffers — exactly (the k smallest of carry ∪ block, ascending).
+
+    The formulation is chosen at trace time from (k, width) — same
+    selected set either way, measured on this container's XLA:CPU:
+
+    - **narrow** (k <= _EXTRACT_K_MAX and |carry|+W <= _EXTRACT_W_MAX):
+      k unrolled argmin/extract passes over the [..., k + W] work buffer
+      (the Pallas kernel's fold, in XLA). Entirely traced elementwise/
+      reduce fusions — ``lax.top_k`` on the CPU runtime is a custom call
+      that executes OUTSIDE the traced op slices (PR 6 profile: untraced
+      ~80 ms holes per chunk were the single largest device-idle source
+      at 50.7% busy), so the narrow path is what makes a >90% busy_frac
+      honest rather than unmeasurable.
+    - **wide**: one ``lax.top_k`` over the chunk, then a stable sort of
+      the [..., 2k] merge buffer (the pre-PR-6 fold). At W ~ v*B = 2048
+      the custom call is 5x FASTER than k traced extract passes — wide
+      chunks keep it for raw throughput; the (tile, cmax, v, tb) sweep
+      decides per shape which regime wins (docs/TUNING.md "Raw speed").
+
+    Ties at equal distance resolve to the lowest lane (argmin's
+    first-index rule; top_k and the stable sort preserve lane order);
+    the carry occupies the leading lanes, so an incumbent id always beats
+    an equal newcomer — deterministic regardless of chunk arrival order.
+    """
+    W = best_d.shape[-1] + d2.shape[-1]
+    if k <= _EXTRACT_K_MAX and W <= _EXTRACT_W_MAX:
+        all_d = jnp.concatenate([best_d, d2], axis=-1)
+        all_i = jnp.concatenate([best_i, gids], axis=-1)
+        lanes = lax.broadcasted_iota(jnp.int32, all_d.shape, all_d.ndim - 1)
+        out_d, out_i = [], []
+        for _ in range(k):
+            am = jnp.argmin(all_d, axis=-1, keepdims=True)
+            out_d.append(jnp.take_along_axis(all_d, am, axis=-1)[..., 0])
+            out_i.append(jnp.take_along_axis(all_i, am, axis=-1)[..., 0])
+            all_d = jnp.where(lanes == am, jnp.inf, all_d)
+        return jnp.stack(out_d, axis=-1), jnp.stack(out_i, axis=-1)
+    if d2.shape[-1] >= k:
+        # chunk-side top_k first: selection runs over W instead of W + k
+        neg, sel = lax.top_k(-d2, k)
+        d2, gids = -neg, jnp.take_along_axis(gids, sel, axis=-1)
+    all_d = jnp.concatenate([best_d, d2], axis=-1)
+    all_i = jnp.concatenate([best_i, gids], axis=-1)
+    # distance-only stable sort (num_keys=1): lane order breaks ties, so
+    # the leading carry lanes win — see the incumbent rule above (a
+    # 2-key sort would let a later equal-distance candidate with a lower
+    # gid displace a held incumbent, making ids depend on which chunks
+    # the early exit skipped)
+    all_d, all_i = lax.sort((all_d, all_i), num_keys=1, is_stable=True)
+    return all_d[..., :k], all_i[..., :k]
+
+
+def _scan_tiles(tree: MortonTree, tq, cand, cand_lb, k: int, v: int, tb: int):
     """Dense-scan each tile's candidate buckets into per-query k-buffers.
 
-    tq f32[T, TQ, D]; cand i32[T, C] (-1 pad). Returns (d2 f32[T, TQ, k],
-    gid i32[T, TQ, k]) ascending. Tiles stream through in blocks of ``tb``
-    and buckets in chunks of ``v`` so intermediates stay [tb, TQ, v*B].
+    tq f32[T, TQ, D]; cand i32[T, C] lb-ascending (-1 pad); cand_lb
+    f32[T, C] (+inf at pad). Returns (d2 f32[T, TQ, k], gid i32[T, TQ, k])
+    ascending. Tiles stream through in blocks of ``tb`` and buckets in
+    chunks of ``v`` so intermediates stay [tb, TQ, v*B].
+
+    Each chunk is gated by the Pallas kernel's early-exit rule, ported to
+    the portable path via a real ``lax.cond`` branch (the chunk scan is a
+    sequential ``lax.scan``, so the false branch genuinely skips the
+    distance block AND the fold): candidates are lb-ascending per tile, so
+    once chunk c's first lower bound can no longer beat any query's
+    current k-th in any of the block's tiles, neither can any later chunk
+    entry of those tiles. Exact — ``lb(bucket, tile box) <= d2(q, p)`` for
+    every q in the tile and p in the bucket, so a skipped chunk could
+    never have displaced a held neighbor (equal-distance ties keep the
+    incumbent, see ``_fold_block``). ``tb`` sets the exit granularity:
+    one straggler tile keeps its whole block's chunks alive, so smaller
+    blocks prune more but pay more per-iteration overhead — a measured
+    trade the tuner sweeps (docs/TUNING.md "Raw speed").
     """
     T, TQ, D = tq.shape
     C = cand.shape[1]
@@ -151,46 +246,61 @@ def _scan_tiles(tree: MortonTree, tq, cand, k: int, v: int, tb: int):
     cpad = (-C) % v
     if cpad:
         cand = jnp.concatenate([cand, jnp.full((T, cpad), -1, jnp.int32)], axis=1)
+        cand_lb = jnp.concatenate(
+            [cand_lb, jnp.full((T, cpad), jnp.inf, jnp.float32)], axis=1
+        )
         C += cpad
     tpad = (-T) % tb
     if tpad:
         tq = jnp.concatenate([tq, jnp.zeros((tpad, TQ, D), tq.dtype)], axis=0)
         cand = jnp.concatenate([cand, jnp.full((tpad, C), -1, jnp.int32)], axis=0)
+        cand_lb = jnp.concatenate(
+            [cand_lb, jnp.full((tpad, C), jnp.inf, jnp.float32)], axis=0
+        )
 
     tq_b = tq.reshape(-1, tb, TQ, D)
     cand_b = cand.reshape(-1, tb, C // v, v)
+    # chunk lower bound = its first candidate's (lb-ascending per tile);
+    # padded tiles/chunks carry +inf and therefore never fold
+    lb_b = cand_lb.reshape(-1, tb, C // v, v)[..., 0]
 
     def block_fn(args):
-        tqb, candb = args  # [tb, TQ, D], [tb, C//v, v]
+        tqb, candb, lbb = args  # [tb, TQ, D], [tb, C//v, v], [tb, C//v]
 
-        def chunk(carry, cb):  # cb i32[tb, v]
+        def chunk(carry, xs):
             best_d, best_i = carry
-            sel = jnp.maximum(cb, 0)
-            pts = tree.bucket_pts[sel].reshape(tb, 1, v * B, D)
-            gids = jnp.where((cb >= 0)[:, :, None], tree.bucket_gid[sel], -1)
-            gids = gids.reshape(tb, 1, v * B)
-            diff = tqb[:, :, None, :] - pts
-            d2 = jnp.sum(diff * diff, axis=-1)  # [tb, TQ, v*B]
-            # invalid buckets -> inf rows; padding rows inside real buckets
-            # are +inf coords and come out inf on their own
-            bad = jnp.repeat(cb < 0, B, axis=1)[:, None, :]
-            d2 = jnp.where(bad, jnp.inf, d2)
-            neg, sel2 = lax.top_k(-d2, k)
-            cd = -neg
-            ci = jnp.take_along_axis(jnp.broadcast_to(gids, d2.shape), sel2, axis=2)
-            all_d = jnp.concatenate([best_d, cd], axis=-1)
-            all_i = jnp.concatenate([best_i, ci], axis=-1)
-            all_d, all_i = lax.sort((all_d, all_i), num_keys=2, is_stable=True)
-            return (all_d[..., :k], all_i[..., :k]), None
+            cb, lb0 = xs  # i32[tb, v], f32[tb]
+
+            def fold(c):
+                bd, bi = c
+                sel = jnp.maximum(cb, 0)
+                pts = tree.bucket_pts[sel].reshape(tb, 1, v * B, D)
+                gids = jnp.where((cb >= 0)[:, :, None], tree.bucket_gid[sel], -1)
+                gids = gids.reshape(tb, 1, v * B)
+                diff = tqb[:, :, None, :] - pts
+                d2 = jnp.sum(diff * diff, axis=-1)  # [tb, TQ, v*B]
+                # invalid buckets -> inf rows; padding rows inside real
+                # buckets are +inf coords and come out inf on their own
+                bad = jnp.repeat(cb < 0, B, axis=1)[:, None, :]
+                d2 = jnp.where(bad, jnp.inf, d2)
+                gids = jnp.broadcast_to(gids, d2.shape)
+                return _fold_block(bd, bi, d2, gids, k)
+
+            alive = lb0 < jnp.max(best_d[..., k - 1], axis=1)  # [tb]
+            return lax.cond(jnp.any(alive), fold, lambda c: c,
+                            (best_d, best_i)), None
 
         init = (
             jnp.full((tb, TQ, k), jnp.inf, jnp.float32),
             jnp.full((tb, TQ, k), -1, jnp.int32),
         )
-        (bd, bi), _ = lax.scan(chunk, init, jnp.swapaxes(candb, 0, 1))
+        (bd, bi), _ = lax.scan(
+            chunk, init,
+            (jnp.swapaxes(candb, 0, 1), jnp.swapaxes(lbb, 0, 1)),
+        )
         return bd, bi
 
-    d2, gid = lax.map(block_fn, (tq_b, cand_b))
+    d2, gid = lax.map(block_fn, (tq_b, cand_b, lb_b))
     d2 = d2.reshape(-1, TQ, k)[:T]
     gid = gid.reshape(-1, TQ, k)[:T]
     return d2, gid
@@ -218,14 +328,13 @@ def _sort_queries(queries, bits: int, qpad: int):
     return queries[order], order
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "tile", "cmax", "seeds", "v", "use_pallas")
-)
-def _tiled_batch(
-    tree, sq, k: int, tile: int, cmax: int, seeds: int, v: int,
+def _tiled_batch_core(
+    tree, sq, k: int, tile: int, cmax: int, seeds: int, v: int, tb: int,
     use_pallas: bool = False,
 ):
-    """Seed + collect + scan for ONE batch of sorted queries.
+    """Seed + collect + scan for ONE batch of sorted queries (trace-level
+    body, shared by the jitted single-tree wrapper below and the SPMD
+    per-shard program in :mod:`kdtree_tpu.parallel.global_morton`).
 
     Kept deliberately bounded (caller slices the sorted order into batches):
     one giant fused program at 10M queries runs for minutes and trips the
@@ -237,28 +346,47 @@ def _tiled_batch(
     box_hi = jnp.max(tq, axis=1)
     T = tq.shape[0]
 
-    tb = max(1, _SCAN_ROWS // tile)  # tiles per block: bound block ROWS
     inf_bound = jnp.full(T, jnp.inf, jnp.float32)
     seed_cand, seed_lb, _ = _frontier(tree, box_lo, box_hi, inf_bound, seeds)
     if use_pallas:
         from kdtree_tpu.pallas.scan_knn import scan_tiles_fused
 
-        sd, _ = scan_tiles_fused(tree, tq, seed_cand, seed_lb, k)
+        sd, _ = scan_tiles_fused(tree, tq, seed_cand, seed_lb, k, V=v)
     else:
-        sd, _ = _scan_tiles(tree, tq, seed_cand, k, v, tb)
+        sd, _ = _scan_tiles(tree, tq, seed_cand, seed_lb, k, v, tb)
     tile_bound = jnp.max(sd[..., k - 1], axis=1)  # [T]
 
     cand, cand_lb, overflow = _frontier(tree, box_lo, box_hi, tile_bound, cmax)
     if use_pallas:
-        fd, fi = scan_tiles_fused(tree, tq, cand, cand_lb, k)
+        fd, fi = scan_tiles_fused(tree, tq, cand, cand_lb, k, V=v)
     else:
-        fd, fi = _scan_tiles(tree, tq, cand, k, v, tb)
+        fd, fi = _scan_tiles(tree, tq, cand, cand_lb, k, v, tb)
     q = tq.shape[0] * tile
     # collect-pass candidate-bucket count: a trivial [T, C] reduction the
     # compiler fuses; the driver fetches it (telemetry-gated) alongside the
     # overflow flags to report tile-query prune rate
     ncand = jnp.sum((cand >= 0).astype(jnp.int32))
     return fd.reshape(q, k), fi.reshape(q, k), jnp.any(overflow), ncand
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "qbatch", "tile", "cmax", "seeds", "v", "tb",
+                     "use_pallas"),
+)
+def _tiled_batch(
+    tree, sq, b0, k: int, qbatch: int, tile: int, cmax: int, seeds: int,
+    v: int, tb: int, use_pallas: bool = False,
+):
+    """One batch = ONE device program: the batch's query slice is a
+    ``dynamic_slice`` on the traced offset ``b0`` INSIDE the program, so
+    the driver's dispatch loop launches exactly one program per batch
+    (the old eager ``lax.slice_in_dim`` was a second per-batch program —
+    and, offsets being static, a fresh tiny compile per distinct offset
+    at the ~150-batch north-star shape)."""
+    sqb = lax.dynamic_slice_in_dim(sq, b0, qbatch, axis=0)
+    return _tiled_batch_core(tree, sqb, k, tile, cmax, seeds, v, tb,
+                             use_pallas)
 
 
 @functools.partial(jax.jit, static_argnames=("qreal",))
@@ -337,6 +465,7 @@ class TiledPlan(NamedTuple):
     cmax: int
     seeds: int
     v: int
+    tb: int
     bits: int
     qbatch: int
     use_pallas: bool
@@ -348,24 +477,37 @@ class TiledPlan(NamedTuple):
     sig: object = None
 
 
+def _opt_knob(x) -> int | None:
+    """Validate an optional block-shape knob read from a plan profile:
+    profiles are advisory, so anything but a positive int reads as
+    'not recorded' rather than an error."""
+    if isinstance(x, int) and not isinstance(x, bool) and x >= 1:
+        return x
+    return None
+
+
 def plan_tiled(
     Q: int, D: int, n_real: int, nbp: int, B: int, k: int,
     tile: int | None = None, cmax: int = DEFAULT_CMAX,
     seeds: int = DEFAULT_SEEDS, use_pallas: bool | None = None,
-    devices: int = 1,
+    devices: int = 1, scan_v: int | None = None, scan_tb: int | None = None,
 ) -> TiledPlan:
     """Resolve the static knobs of a tiled run from the problem shape.
 
     ``tile=None`` picks the launch configuration automatically: first from
     the persistent plan store (:mod:`kdtree_tpu.tuning` — a previous run's
-    settled tile/cmax/seeds for this quantized problem signature, in which
-    case the caller-supplied ``cmax``/``seeds`` starting hints are
-    superseded), then from the static density heuristic on a miss.
+    settled tile/cmax/seeds (and, when a sweep recorded them, the
+    block-shape knobs ``v``/``tb``) for this quantized problem signature,
+    in which case the caller-supplied ``cmax``/``seeds`` starting hints
+    are superseded), then from the static density heuristic on a miss.
     ``devices`` is the per-shard plan context (forest drivers pass their
     shard count so a P=8 shard plan never collides with a single-chip
     one). ``use_pallas=None`` enables the fused Mosaic kernel on TPU
     backends and the XLA scan elsewhere (tests force use_pallas=True,
-    which interprets off-TPU).
+    which interprets off-TPU). ``scan_v``/``scan_tb`` force the scan
+    block shape (buckets per fold chunk / tiles per scan block — the
+    fused-kernel fold group on the Pallas path) — explicit overrides,
+    used by the tuner sweep; exactness never depends on either.
     """
     forced_engine = use_pallas is not None
     if use_pallas is None:
@@ -373,13 +515,15 @@ def plan_tiled(
     source = "explicit"
     sig = None
     # the store is consulted/recorded only for FULLY auto plans: a caller
-    # hinting cmax or seeds or forcing the scan engine (even with tile
-    # unset) is a one-off override, and recording its settled knobs would
-    # lock the override into every future auto run of the shape (feedback
-    # never shrinks a cap, and a forced-engine profile would evict the
-    # default engine's warm plan under the shared signature key)
+    # hinting cmax or seeds or forcing the scan engine or block shape
+    # (even with tile unset) is a one-off override, and recording its
+    # settled knobs would lock the override into every future auto run of
+    # the shape (feedback never shrinks a cap, and a forced-engine profile
+    # would evict the default engine's warm plan under the shared key)
     auto = (tile is None and cmax == DEFAULT_CMAX
-            and seeds == DEFAULT_SEEDS and not forced_engine)
+            and seeds == DEFAULT_SEEDS and not forced_engine
+            and scan_v is None and scan_tb is None)
+    v, tb = scan_v, scan_tb
     if auto:
         from kdtree_tpu import tuning
 
@@ -389,6 +533,8 @@ def plan_tiled(
         if prof is not None:
             tile, cmax = int(prof["tile"]), int(prof["cmax"])
             seeds = int(prof.get("seeds", seeds))
+            v = _opt_knob(prof.get("v"))
+            tb = _opt_knob(prof.get("tb"))
             source = "warm"
         else:
             tile, cmax = _auto_tile(Q, n_real, k, D, nbp, B, cmax,
@@ -396,6 +542,13 @@ def plan_tiled(
             source = "heuristic"
     elif tile is None:
         tile, cmax = _auto_tile(Q, n_real, k, D, nbp, B, cmax, use_pallas)
+    if min(tile, max(Q, 1)) != tile and source == "warm":
+        # the clamp is about to change the tile a warm profile's block
+        # knobs were swept at — knobs measured at one tile width pinned
+        # onto another hard-code the wrong fold regime (same invariant
+        # the tuner's _prev_block_knobs enforces); fall back to the
+        # shape heuristic for them
+        v, tb = scan_v, scan_tb
     tile = min(tile, max(Q, 1))
     seeds = min(seeds, nbp)
     if k > (seeds * B) // 2:
@@ -404,16 +557,65 @@ def plan_tiled(
         cmax = nbp
     cmax = min(cmax, nbp)
     bits = default_bits(D)
-    # each scan chunk must expose at least k candidate slots to lax.top_k
-    v = max(_SCAN_V, -(-k // B))
+    # the fold selects from [carry | chunk], so any v >= 1 is exact (the
+    # old top_k-from-chunk-alone formulation needed v*B >= k; the carry-
+    # inclusive fold does not). Heuristic regime choice (docs/TUNING.md
+    # "Raw speed"): wide tiles take the NARROW scan (v=1 single-bucket
+    # chunks — per-bucket early exit, traced extract fold) because their
+    # per-op arrays stay large enough to amortize XLA:CPU's fixed op cost;
+    # small tiles keep the WIDE v chunks and the top_k fold, where the
+    # measured crossover flips (see _NARROW_TILE_MIN). The tuner sweep
+    # overrides both per shape via the plan store.
+    if v is None:
+        if use_pallas:
+            v = _PALLAS_V
+        elif tile >= _NARROW_TILE_MIN and k <= _EXTRACT_K_MAX \
+                and B + k <= _EXTRACT_W_MAX:
+            v = 1
+        else:
+            # the regime is decided by _fold_block's WIDTH gate, so a
+            # small bucket size could let _SCAN_V chunks slip under it
+            # and run the narrow extract at tiny tiles — the measured
+            # regression the branch exists to avoid. Widen v until the
+            # chunk is genuinely wide.
+            v = _SCAN_V
+            while v * B + k <= _EXTRACT_W_MAX:
+                v *= 2
+    v = max(int(v), 1)
     # batches bound each device program's runtime (watchdog) and memory;
     # the global Hilbert sort happens ONCE, so batch slices stay coherent.
     # Small Q must not pad up to the full batch quantum (Q=1024 padded to
     # 2^16 would scan 64x more rows than asked) — cap at Q tile-rounded
     qbatch = max(_BATCH_Q // tile, 1) * tile
     qbatch = min(qbatch, -(-max(Q, 1) // tile) * tile)
-    return TiledPlan(tile, cmax, seeds, v, bits, qbatch, use_pallas, source,
-                     sig)
+    if tb is None:
+        # same gate as _fold_block's narrow path: k must also fit the
+        # unrolled extract (k > _EXTRACT_K_MAX runs the WIDE fold even at
+        # narrow widths, where element-target-sized tiny blocks would
+        # just pay per-op overhead)
+        if k <= _EXTRACT_K_MAX and v * B + k <= _EXTRACT_W_MAX:
+            # narrow scan: size blocks to the fold-op element target so
+            # the early exit keeps per-block granularity without XLA:CPU
+            # op overhead dominating
+            tb = max(1, _SCAN_ELEMS // max(tile * (v * B + k), 1))
+        else:
+            tb = max(1, _SCAN_ROWS // tile)
+    # a block wider than the batch's tile count only pads dead tiles
+    tb = max(1, min(int(tb), -(-qbatch // tile)))
+    return TiledPlan(tile, cmax, seeds, v, tb, bits, qbatch, use_pallas,
+                     source, sig)
+
+
+def _resolve_lookahead(lookahead: int | None) -> int:
+    if lookahead is not None:
+        return max(int(lookahead), 1)
+    raw = os.environ.get("KDTREE_TPU_TILE_LOOKAHEAD")
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            pass
+    return DEFAULT_LOOKAHEAD
 
 
 def drive_batches(
@@ -424,11 +626,12 @@ def drive_batches(
     scan_units_per_batch: int | None = None,
     settle_first: bool = True,
     feedback=None,
+    lookahead: int | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Async batch dispatch with overflow-retry, shared by every tiled
-    driver. ``run_batch(offset, cap) -> (d2, gid, overflow[, ncand])``
-    must be a jitted program; the optional 4th output is the batch's
-    candidate-bucket count (an i32 scalar), which — together with
+    """Pipelined async batch dispatch with overflow-retry, shared by every
+    tiled driver. ``run_batch(offset, cap) -> (d2, gid, overflow[,
+    ncand])`` must be a jitted program; the optional 4th output is the
+    batch's candidate-bucket count (an i32 scalar), which — together with
     ``scan_units_per_batch`` = tiles-per-batch x shards, the number of
     (tile, local-tree) pairs whose frontier could have kept up to ``nbp``
     buckets each — lets the driver report the tile-query prune rate
@@ -442,19 +645,25 @@ def drive_batches(
     round here instead of a re-run of every batch. A WARM plan
     (``plan.source == "warm"`` — the cap already settled in a previous
     run and came back from the plan store) passes ``settle_first=False``
-    and skips the probe entirely: every batch dispatches async
-    immediately, and the stacked-flags retry rounds below still guard
-    exactness if the stored cap has gone stale. Then every remaining
-    batch is dispatched
-    before syncing anything: a per-batch ``bool(overflow)`` fetch would
-    block the host on each program in turn, inserting one tunnel round
-    trip between consecutive programs (measured at the 10M-query
-    north-star shape this serialization cost ~8x); async-dispatched, the
-    ~150 sub-batch programs run back-to-back on device and ONE stacked
-    fetch checks all overflow flags afterwards. Geometry-driven stragglers
-    retry in doubling rounds (rare once the cap is settled); a clean flag
-    at a smaller cap is still exact — overflow is the only incompleteness
-    signal.
+    and skips the probe entirely.
+
+    Dispatch then runs as a bounded pipeline: up to ``lookahead`` batches
+    stay in flight; once the window is full, the OLDEST batch is retired
+    (one scalar overflow-flag fetch — the device still has a full window
+    of programs queued behind it, so the host wait overlaps execution
+    instead of draining it, and the next batch's host-side prep overlaps
+    the in-flight batches' device time). A retired batch that flags
+    overflow retries immediately at the grown cap — invalidating ONLY
+    itself, never the in-flight lookahead (each re-dispatch counts once
+    in the retry counter; a younger in-flight batch dispatched at the
+    stale cap is checked — and, if needed, retried — when ITS turn
+    comes). The tail window (and any run short enough to fit entirely in
+    the window, which includes every pre-pipeline call site) drains with
+    ONE stacked flag fetch plus doubling rounds — exactly the old
+    all-async behavior, because a per-batch fetch with an EMPTY pipeline
+    behind it serializes host and device (measured ~8x at the 10M-query
+    north-star shape). A clean flag at a smaller cap is still exact —
+    overflow is the only incompleteness signal.
     """
     from kdtree_tpu.obs import flight
 
@@ -462,6 +671,10 @@ def drive_batches(
     retries = reg.counter("kdtree_tile_overflow_retries_total")
     nretries = 0
     bcmax = cmax
+    n = len(offsets)
+    window = _resolve_lookahead(lookahead)
+    batches: list = [None] * n
+    caps = [0] * n
 
     def dispatch(i: int, cap: int):
         # the "tile.dispatch" TraceAnnotation is the device-timeline
@@ -472,36 +685,94 @@ def drive_batches(
         # capture the annotation is a ~ns no-op.
         with jax.profiler.TraceAnnotation("tile.dispatch", batch=i,
                                           cap=cap):
-            return run_batch(offsets[i], cap)
+            batches[i] = run_batch(offsets[i], cap)
+            caps[i] = cap
 
+    def retire(i: int) -> None:
+        """Block on batch ``i``'s overflow flag; retry it (alone) at the
+        grown cap until clean or the cap ceiling."""
+        nonlocal bcmax, nretries
+        while True:
+            # the annotation wraps ONLY the blocking flag fetch — a retry
+            # re-dispatch runs outside it so the timeline's stage split
+            # books it as prep (its own tile.dispatch), keeping retire_us
+            # = flag-fetch wait exactly as documented
+            with jax.profiler.TraceAnnotation("tile.retire", batch=i):
+                # kdt-lint: disable=KDT201 pipelined retirement: one
+                # scalar flag fetch per batch, taken only while a full
+                # lookahead window of programs is queued behind it — the
+                # host wait overlaps device execution, it never drains it
+                done = not bool(np.asarray(batches[i][2])) \
+                    or caps[i] >= nbp
+            if done:
+                return
+            if caps[i] >= bcmax:
+                bcmax = min(bcmax * 2, nbp)
+            retries.inc()
+            nretries += 1
+            flight.record("tile.overflow_retry", cap=bcmax, batches=1)
+            dispatch(i, bcmax)
+
+    start = 0
+    inflight: collections.deque = collections.deque()
+    # offsets must be non-empty: every caller guards Q == 0 upstream, and
+    # the result assembly below indexes batches[0] unconditionally
     if settle_first:
-        first = dispatch(0, bcmax)
+        dispatch(0, bcmax)
         # kdt-lint: disable=KDT201 the deliberate cap-settling probe: one
         # synchronous flag fetch on the FIRST batch settles a systematic
         # undersize before ~150 async batches dispatch at the wrong cap
-        while bool(first[2]) and bcmax < nbp:
+        while bool(np.asarray(batches[0][2])) and bcmax < nbp:
             bcmax = min(bcmax * 2, nbp)
             retries.inc()
             nretries += 1
-            first = dispatch(0, bcmax)
-        batches = [first] + [dispatch(i, bcmax)
-                             for i in range(1, len(offsets))]
-    else:
-        batches = [dispatch(i, bcmax) for i in range(len(offsets))]
-    while bcmax < nbp:
-        # kdt-lint: disable=KDT201 ONE stacked overflow-flag fetch AFTER
-        # every batch dispatched async; overflow is the only exactness
-        # signal, so this sync is the contract (per-batch fetches cost 8x)
-        flags = np.asarray(jnp.stack([b[2] for b in batches]))
-        bad = np.nonzero(flags)[0]
-        if bad.size == 0:
+            dispatch(0, bcmax)
+        start = 1
+        # the settled batch still joins the pipeline: cold and warm runs
+        # must execute the SAME program set, and excluding batch 0 here
+        # made a cold run's drain stack one flag NARROWER than a warm
+        # run's — so the first warm run recompiled the drain fetch
+        # inside what should be a steady-state (capture-clean) window.
+        # Its re-checked flag is already resident and clean; the extra
+        # fetch is the price of program-set parity.
+        inflight.append(0)
+    for i in range(start, n):
+        if len(inflight) >= window:
+            retire(inflight.popleft())
+        dispatch(i, bcmax)
+        inflight.append(i)
+    # drain the tail window: one stacked fetch over the (<= lookahead)
+    # still-in-flight batches, then doubling rounds for stragglers
+    while inflight:
+        idx = list(inflight)
+        inflight.clear()
+        with jax.profiler.TraceAnnotation("tile.drain", batches=len(idx)):
+            # kdt-lint: disable=KDT201 ONE stacked overflow-flag fetch for
+            # the tail window after every batch dispatched async; overflow
+            # is the only exactness signal, so this sync is the contract
+            flags = np.asarray(jnp.stack([batches[i][2] for i in idx]))
+        # a batch whose LAST dispatch already ran at the nbp ceiling is
+        # final: overflow there is impossible by construction (every
+        # bucket fits), so a still-set flag is a bug upstream and
+        # retrying it would loop forever. The filter is per-batch caps,
+        # NOT bcmax — retiring an earlier straggler may have grown bcmax
+        # to the ceiling while tail batches were still in flight at a
+        # stale smaller cap, and those must retry or their overflowed
+        # (incomplete) results would be returned.
+        bad = [idx[j] for j in np.nonzero(flags)[0] if caps[idx[j]] < nbp]
+        if not bad:
             break
-        bcmax = min(bcmax * 2, nbp)
+        if max(caps[i] for i in bad) >= bcmax:
+            # a failure at the CURRENT cap starts a doubling round; a
+            # batch that failed at a stale smaller cap first retries at
+            # today's bcmax (same rule as retire())
+            bcmax = min(bcmax * 2, nbp)
         flight.record("tile.overflow_retry", cap=bcmax, batches=len(bad))
         for i in bad:
             retries.inc()
             nretries += 1
-            batches[i] = dispatch(i, bcmax)
+            dispatch(i, bcmax)
+            inflight.append(i)
     reg.counter("kdtree_tile_batches_total").inc(len(offsets))
     if obs.enabled() and len(batches[0]) > 3:
         # stack the per-batch candidate counts on device (async) and DEFER
@@ -554,6 +825,8 @@ def morton_knn_tiled(
     seeds: int = DEFAULT_SEEDS,
     use_pallas: bool | None = None,
     plan: TiledPlan | None = None,
+    scan_v: int | None = None,
+    scan_tb: int | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact batched k-NN via Hilbert-sorted query tiles and dense scans.
 
@@ -584,7 +857,7 @@ def morton_knn_tiled(
     if plan is None:
         plan = plan_tiled(
             Q, D, tree.n_real, tree.num_buckets, tree.bucket_size, k,
-            tile, cmax, seeds, use_pallas,
+            tile, cmax, seeds, use_pallas, scan_v=scan_v, scan_tb=scan_tb,
         )
     from kdtree_tpu import tuning
 
@@ -596,8 +869,8 @@ def morton_knn_tiled(
 
         def run_batch(b0: int, cap: int):
             return _tiled_batch(
-                tree, lax.slice_in_dim(sq, b0, b0 + plan.qbatch, axis=0), k,
-                plan.tile, cap, plan.seeds, plan.v, plan.use_pallas,
+                tree, sq, b0, k, plan.qbatch, plan.tile, cap, plan.seeds,
+                plan.v, plan.tb, plan.use_pallas,
             )
 
         offsets = list(range(0, Qp, plan.qbatch))

@@ -115,11 +115,18 @@ def _scan_kernel(
     start_group(0, 0)
     lanes = lax.broadcasted_iota(jnp.int32, (tqp, W), 1)
 
-    def cond(g):
-        worst = jnp.max(out_d_ref[0, :, k - 1])
-        return (g < G) & (lb_ref[0, 0, g * V] < worst)
+    # the early-exit decision is CARRIED, not read in the cond: jax 0.4.x
+    # cannot discharge ref effects in a while cond (loops.py
+    # _while_discharge_rule raises NotImplementedError), which kept this
+    # kernel un-runnable in CPU interpret mode. Each body iteration decides
+    # whether group g+1 can still beat the tile's worst k-th AFTER its own
+    # fold — the same iteration set the ref-reading cond produced.
+    def cond(carry):
+        g, stop = carry
+        return (g < G) & jnp.logical_not(stop)
 
-    def body(g):
+    def body(carry):
+        g, _ = carry
         slot = lax.rem(g, 2)
 
         @pl.when(g + 1 < G)
@@ -169,9 +176,15 @@ def _scan_kernel(
                 )
                 wd = jnp.where(onehot, jnp.inf, wd)
 
-        return g + 1
+        # can group g+1 still matter? Read the (possibly just-updated)
+        # worst k-th here — the index clamp keeps the final iteration's
+        # read in bounds (its stop value is dead: cond's g < G gates it)
+        worst = jnp.max(out_d_ref[0, :, k - 1])
+        nxt = jnp.minimum((g + 1) * V, Cp - 1)
+        return g + 1, jnp.logical_not(lb_ref[0, 0, nxt] < worst)
 
-    g_stop = lax.while_loop(cond, body, jnp.int32(0))
+    stop0 = jnp.logical_not(lb_ref[0, 0, 0] < jnp.inf)
+    g_stop, _ = lax.while_loop(cond, body, (jnp.int32(0), stop0))
 
     # the prologue (g=0) or the last body iteration's prefetch (g_stop) may
     # have left a DMA group in flight that no iteration waited on; a kernel

@@ -409,7 +409,7 @@ def _query_meshfree_jit(node_lo, node_hi, bucket_pts, bucket_gid, queries, k,
 
 
 def _tiled_query_local(node_lo, node_hi, bucket_pts, bucket_gid, sq, *,
-                       k, num_levels, n_shard, tile, cmax, seeds, v,
+                       k, num_levels, n_shard, tile, cmax, seeds, v, tb,
                        use_pallas, axis_name):
     """Per-device SPMD dense-batch query body: the tiled engine (Hilbert
     tiles + dense/Pallas scan) on the LOCAL tree, then the standard
@@ -424,14 +424,14 @@ def _tiled_query_local(node_lo, node_hi, bucket_pts, bucket_gid, sq, *,
     is ~100x slower than the tiled scan there (see ``dense_lowd``).
     """
     from kdtree_tpu.ops.morton import MortonTree
-    from kdtree_tpu.ops.tile_query import _tiled_batch
+    from kdtree_tpu.ops.tile_query import _tiled_batch_core
 
     tree = MortonTree(
         node_lo[0], node_hi[0], bucket_pts[0], bucket_gid[0],
         n_real=n_shard, num_levels=num_levels,
     )
-    fd, fi, ov, nc = _tiled_batch(tree, sq, k, tile, cmax, seeds, v,
-                                  use_pallas)
+    fd, fi, ov, nc = _tiled_batch_core(tree, sq, k, tile, cmax, seeds, v,
+                                       tb, use_pallas)
     all_d = lax.all_gather(fd, axis_name)  # [P, QB, k]
     all_i = lax.all_gather(fi, axis_name)
     md, mi = _merge_partials(all_d, all_i, k)
@@ -445,18 +445,22 @@ def _tiled_query_local(node_lo, node_hi, bucket_pts, bucket_gid, sq, *,
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "k", "num_levels", "n_shard", "tile", "cmax", "seeds", "v",
-        "use_pallas",
+        "mesh", "k", "num_levels", "n_shard", "qbatch", "tile", "cmax",
+        "seeds", "v", "tb", "use_pallas",
     ),
 )
 def _tiled_query_batch_jit(node_lo, node_hi, bucket_pts, bucket_gid, sq,
-                           mesh, k, num_levels, n_shard, tile, cmax, seeds,
-                           v, use_pallas):
+                           b0, mesh, k, num_levels, n_shard, qbatch, tile,
+                           cmax, seeds, v, tb, use_pallas):
+    # one dispatch per batch: the batch slice is a dynamic_slice on the
+    # traced offset INSIDE the program (same contract as _tiled_batch),
+    # replicated before the shard_map so every device slices identically
+    sqb = lax.dynamic_slice_in_dim(sq, b0, qbatch, axis=0)
     fn = shard_map(
         functools.partial(
             _tiled_query_local,
             k=k, num_levels=num_levels, n_shard=n_shard, tile=tile,
-            cmax=cmax, seeds=seeds, v=v, use_pallas=use_pallas,
+            cmax=cmax, seeds=seeds, v=v, tb=tb, use_pallas=use_pallas,
             axis_name=SHARD_AXIS,
         ),
         mesh=mesh,
@@ -467,7 +471,7 @@ def _tiled_query_batch_jit(node_lo, node_hi, bucket_pts, bucket_gid, sq,
         out_specs=(P(None, None), P(None, None), P(), P()),
         check_vma=False,
     )
-    return fn(node_lo, node_hi, bucket_pts, bucket_gid, sq)
+    return fn(node_lo, node_hi, bucket_pts, bucket_gid, sqb)
 
 
 # kdt-lint: disable=KDT102 exercised vs the oracle on legacy jax in tier-1
@@ -932,10 +936,9 @@ def _query_tiled_spmd(forest, queries, k: int, mesh):
     def run_batch(b0: int, cap: int):
         return _tiled_query_batch_jit(
             forest.node_lo, forest.node_hi, forest.bucket_pts,
-            forest.bucket_gid,
-            lax.slice_in_dim(sq, b0, b0 + plan.qbatch, axis=0),
-            mesh, k, forest.num_levels, n_shard, plan.tile, cap, plan.seeds,
-            plan.v, plan.use_pallas,
+            forest.bucket_gid, sq, b0,
+            mesh, k, forest.num_levels, n_shard, plan.qbatch, plan.tile,
+            cap, plan.seeds, plan.v, plan.tb, plan.use_pallas,
         )
 
     offsets = list(range(0, sq.shape[0], plan.qbatch))

@@ -66,7 +66,12 @@ class ServeEngine:
         Q, D = queries.shape
         plan = plan_tiled(Q, D, t.n_real, t.num_buckets, t.bucket_size,
                           self.k)
-        with obs.span("serve.batch", sync=False, q=Q, plan=plan.source):
+        # block shape rides in the span args: a serving-process capture
+        # (/debug/profile) then shows which scan regime each batch
+        # dispatched with — warm plans carry tuner-swept v/tb
+        # (docs/TUNING.md "Raw speed")
+        with obs.span("serve.batch", sync=False, q=Q, plan=plan.source,
+                      v=plan.v, tb=plan.tb):
             d2, gid = morton_knn_tiled(
                 t, jnp.asarray(queries), k=self.k, plan=plan
             )

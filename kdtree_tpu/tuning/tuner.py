@@ -1,15 +1,32 @@
-"""Explicit (tile, cmax) sweep: measure candidates on a query sample and
-persist the winner — the operator-driven way to seed the plan store
-(``kdtree-tpu tune``), complementing the passive per-run feedback loop.
+"""Explicit (tile, cmax) + block-shape sweep: measure candidates on a
+query sample and persist the winner — the operator-driven way to seed the
+plan store (``kdtree-tpu tune``), complementing the passive per-run
+feedback loop.
 
-The sweep is deliberately simple and honest: every candidate pair gets a
+The sweep is deliberately simple and honest: every candidate gets a
 warmup run (compile + cap settling excluded from timing, same discipline
 as bench.py) and one timed run synced by a host fetch; a candidate whose
 timed run still needed overflow-retry doubling is marked invalid (its cap
 does not hold for this geometry, so its time includes retry recompiles
-and its steady state would too). The winner is the fastest valid pair —
-persisted under the sample's signature, so serve-time ``plan_tiled``
-calls with the same shape start there directly.
+and its steady state would too). The winner is the fastest valid
+candidate — persisted under the sample's signature, so serve-time
+``plan_tiled`` calls with the same shape start there directly.
+
+Two phases (docs/TUNING.md "Raw speed"):
+
+1. **(tile, cmax)** — the launch grid, at the heuristic block shape.
+2. **block shape (v, tb)** — scan-kernel knobs swept AT the phase-1
+   winner: ``v`` (buckets per fold chunk — the candidate pad; the fused
+   Pallas kernel's DMA/fold group) picks the fold regime (narrow traced
+   extract vs wide ``top_k``), ``tb`` (tiles per scan block) sets the
+   early-exit granularity. A full 4-D cross product would square the
+   sweep cost for knobs that interact weakly with (tile, cmax); the
+   two-phase factorization keeps ``tune`` proportional to the grid sizes.
+
+The persisted profile carries ``v``/``tb`` only when phase 2 actually
+measured them — ``plan_tiled`` treats absent block knobs as "use the
+heuristic", so a phase-1-only profile keeps tracking heuristic
+improvements while a swept one is pinned to its measurement.
 """
 
 from __future__ import annotations
@@ -22,6 +39,49 @@ from kdtree_tpu.tuning.store import PlanStore, default_store, make_signature
 
 DEFAULT_TILES = (64, 128, 256, 512, 1024)
 DEFAULT_CMAXS = (32, 64, 128, 256)
+# block-shape defaults: v=None / tb=None rows mean "the plan heuristic's
+# choice" — always measured so the sweep can only ever confirm or beat it
+DEFAULT_VS = (1, 8)
+DEFAULT_TBS = (1, 4, 32)
+
+
+def _measure(tree, queries, k: int, retc, **knobs) -> dict:
+    """Warmup + one timed run of the tiled engine at ``knobs``; returns a
+    result row with seconds/qps/overflow_retries."""
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    Q = queries.shape[0]
+    d2, _ = morton_knn_tiled(tree, queries, k=k, **knobs)
+    obs.hard_sync(d2)  # warmup: compile + first cap settle
+    r0 = retc.value
+    t0 = time.perf_counter()
+    d2, _ = morton_knn_tiled(tree, queries, k=k, **knobs)
+    obs.hard_sync(d2)
+    dt = time.perf_counter() - t0
+    return {
+        "seconds": dt,
+        "qps": Q / dt if dt > 0 else None,
+        "overflow_retries": int(retc.value - r0),
+    }
+
+
+def _prev_block_knobs(store, sig, tile: int):
+    """The previously persisted tuner-swept block shape, or ``None`` —
+    only when the stored profile's TILE matches: block knobs measured at
+    one tile width pinned onto (or defended at) another would hard-code
+    the wrong fold regime for it. The match deliberately ignores cmax —
+    the feedback recorder rewrites it on cap drift while preserving
+    v/tb, and keying on a field that mutates after the sweep would
+    silently drop the swept knobs on the next re-tune."""
+    from kdtree_tpu.ops.tile_query import _opt_knob
+
+    prev = store.get(sig)
+    if prev is None or tile != _opt_knob(prev.get("tile")):
+        return None
+    pv, ptb = _opt_knob(prev.get("v")), _opt_knob(prev.get("tb"))
+    if pv is None or ptb is None:
+        return None
+    return pv, ptb
 
 
 def sweep(
@@ -30,19 +90,24 @@ def sweep(
     k: int,
     tiles: Optional[Sequence[int]] = None,
     cmaxs: Optional[Sequence[int]] = None,
+    vs: Optional[Sequence[int]] = None,
+    tbs: Optional[Sequence[int]] = None,
+    sweep_blocks: bool = True,
     store: Optional[PlanStore] = None,
     log=None,
 ) -> dict:
     """Time each (tile, cmax) candidate on ``queries`` against ``tree``,
-    persist the winner, and return the full result table.
+    sweep the scan block shape at the winner, persist the overall winner,
+    and return the full result table.
 
-    Returns ``{"results": [...], "winner": {...}, "persisted": bool,
-    "path": str | None}``; each result row carries tile, cmax, seconds,
-    qps, and the overflow-retry count its timed run incurred.
+    Returns ``{"results": [...], "block_results": [...], "winner": {...},
+    "persisted": bool, "path": str | None}``; each result row carries
+    tile, cmax, (v, tb for block rows), seconds, qps, and the
+    overflow-retry count its timed run incurred.
     """
     import jax
 
-    from kdtree_tpu.ops.tile_query import DEFAULT_SEEDS, morton_knn_tiled
+    from kdtree_tpu.ops.tile_query import DEFAULT_SEEDS
 
     use_pallas = jax.default_backend() == "tpu"
     Q = queries.shape[0]
@@ -56,20 +121,9 @@ def sweep(
     results = []
     for tile in tiles:
         for cmax in cmaxs:
-            d2, _ = morton_knn_tiled(tree, queries, k=k, tile=tile, cmax=cmax)
-            obs.hard_sync(d2)  # warmup: compile + first cap settle
-            r0 = retc.value
-            t0 = time.perf_counter()
-            d2, _ = morton_knn_tiled(tree, queries, k=k, tile=tile, cmax=cmax)
-            obs.hard_sync(d2)
-            dt = time.perf_counter() - t0
-            row = {
-                "tile": tile,
-                "cmax": cmax,
-                "seconds": dt,
-                "qps": Q / dt if dt > 0 else None,
-                "overflow_retries": int(retc.value - r0),
-            }
+            row = {"tile": tile, "cmax": cmax, "v": None, "tb": None}
+            row.update(_measure(tree, queries, k, retc, tile=tile,
+                                cmax=cmax))
             results.append(row)
             if log is not None:
                 log(row)
@@ -90,6 +144,7 @@ def sweep(
         winner = min(results, key=lambda r: r["seconds"])
         return {
             "results": results,
+            "block_results": [],
             "winner": winner,
             "persisted": False,
             "path": store.path_for(sig) if store.enabled else None,
@@ -97,7 +152,48 @@ def sweep(
                       "larger --cmax values",
         }
     winner = min(valid, key=lambda r: r["seconds"])
-    persisted = store.put(sig, {
+
+    block_results = []
+    if sweep_blocks:
+        # phase 2: block shape at the winning launch config. The winner's
+        # own (heuristic-block) time is already on the table, so a sweep
+        # that finds nothing faster changes nothing.
+        tbs_eff = list(tbs or DEFAULT_TBS)
+        if use_pallas:
+            # the fused Pallas kernel has no tb knob (scan_tiles_fused
+            # takes V only), so distinct tb candidates time IDENTICAL
+            # configurations — collapse the axis instead of multiplying
+            # the sweep cost by len(tbs) for nothing
+            tbs_eff = tbs_eff[:1]
+        pairs = [(int(v), int(tb)) for v in (vs or DEFAULT_VS)
+                 for tb in tbs_eff]
+        # a previously swept block shape at the SAME launch config joins
+        # the candidate grid: a routine re-tune whose default grid lacks
+        # it must not drop a proven-faster (v, tb) without RE-MEASURING
+        # it — it defends its store slot on the clock like everyone else
+        prev_knobs = _prev_block_knobs(store, sig, winner["tile"])
+        if prev_knobs is not None and use_pallas:
+            # tb is a no-op on the fused kernel: normalize the defended
+            # pair's tb to the collapsed axis so it can't re-time (and
+            # arbitrarily persist) a byte-identical configuration
+            prev_knobs = (prev_knobs[0], tbs_eff[0])
+        if prev_knobs is not None and prev_knobs not in pairs:
+            pairs.append(prev_knobs)
+        for v, tb in pairs:
+            row = {"tile": winner["tile"], "cmax": winner["cmax"],
+                   "v": v, "tb": tb}
+            row.update(_measure(
+                tree, queries, k, retc, tile=winner["tile"],
+                cmax=winner["cmax"], scan_v=v, scan_tb=tb,
+            ))
+            block_results.append(row)
+            if log is not None:
+                log(row)
+        block_valid = [r for r in block_results
+                       if r["overflow_retries"] == 0]
+        winner = min([winner, *block_valid], key=lambda r: r["seconds"])
+
+    profile = {
         "tile": int(winner["tile"]),
         "cmax": int(winner["cmax"]),
         "seeds": DEFAULT_SEEDS,
@@ -106,9 +202,24 @@ def sweep(
         "tune_qps": winner["qps"],
         "tune_seconds": winner["seconds"],
         "overflow_retries": 0,
-    })
+    }
+    if winner["v"] is not None:
+        profile["v"] = int(winner["v"])
+        profile["tb"] = int(winner["tb"])
+    elif not sweep_blocks:
+        # a --no-block-sweep refresh measured NOTHING about the block
+        # shape: preserve previously tuner-swept knobs (at a confirmed
+        # launch config) instead of silently erasing them — same
+        # contract as the feedback recorder's merge; only a sweep that
+        # actually measured block candidates and saw the heuristic win
+        # may clear them
+        prev_knobs = _prev_block_knobs(store, sig, profile["tile"])
+        if prev_knobs is not None:
+            profile["v"], profile["tb"] = prev_knobs
+    persisted = store.put(sig, profile)
     return {
         "results": results,
+        "block_results": block_results,
         "winner": winner,
         "persisted": persisted,
         "path": store.path_for(sig) if store.enabled else None,

@@ -1128,6 +1128,8 @@ def cmd_tune(args) -> None:
               file=sys.stderr)
     tiles = _parse_int_list(args.tiles, "tiles")
     cmaxs = _parse_int_list(args.cmax, "cmax")
+    vs = _parse_int_list(args.scan_v, "scan-v")
+    tbs = _parse_int_list(args.scan_tb, "scan-tb")
     pts = generate_points_rowwise(args.seed, args.dim, args.n)
     # a distinct seed for the sample: tuning on the points themselves
     # would overfit the plan to query==point geometry
@@ -1135,7 +1137,10 @@ def cmd_tune(args) -> None:
     tree = build_morton(pts)
 
     def log(row):
-        print(f"  tile={row['tile']:<5d} cmax={row['cmax']:<5d} "
+        block = ""
+        if row.get("v") is not None:
+            block = f" v={row['v']:<3d} tb={row['tb']:<5d}"
+        print(f"  tile={row['tile']:<5d} cmax={row['cmax']:<5d}{block} "
               f"{row['seconds']*1e3:9.1f} ms  "
               f"{row['qps']:>10.0f} q/s  retries={row['overflow_retries']}",
               file=sys.stderr)
@@ -1143,6 +1148,7 @@ def cmd_tune(args) -> None:
     print(f"sweeping tiled plans: n={args.n} dim={args.dim} q={args.q} "
           f"k={args.k}", file=sys.stderr)
     out = tuner.sweep(tree, queries, k=args.k, tiles=tiles, cmaxs=cmaxs,
+                      vs=vs, tbs=tbs, sweep_blocks=not args.no_block_sweep,
                       store=store, log=log)
     if out["persisted"]:
         print(f"persisted winner to {out['path']}", file=sys.stderr)
@@ -1156,7 +1162,8 @@ def cmd_tune(args) -> None:
         "winner": out["winner"],
         "persisted": out["persisted"],
         "path": out["path"],
-        "candidates": len(out["results"]),
+        "candidates": len(out["results"]) + len(out["block_results"]),
+        "block_candidates": len(out["block_results"]),
     }))
 
 
@@ -1353,6 +1360,15 @@ def main(argv=None) -> None:
     tu.add_argument("--cmax", default=None, metavar="C1,C2,...",
                     help="candidate candidate-bucket caps (default "
                          "32..256 pow2)")
+    tu.add_argument("--scan-v", default=None, metavar="V1,V2,...",
+                    help="candidate fold-chunk widths (buckets per scan "
+                         "chunk) for the block-shape phase (default 1,8)")
+    tu.add_argument("--scan-tb", default=None, metavar="T1,T2,...",
+                    help="candidate tiles-per-scan-block for the "
+                         "block-shape phase (default 1,4,32)")
+    tu.add_argument("--no-block-sweep", action="store_true",
+                    help="skip the block-shape phase (sweep only the "
+                         "(tile, cmax) launch grid)")
     tu.set_defaults(fn=cmd_tune)
 
     li = sub.add_parser(

@@ -146,8 +146,8 @@ def main():
         scan_args = (tree, tq3, seed_cand, seed_lb)
     else:
         scan = jax.jit(functools.partial(
-            _scan_tiles, k=k, v=plan.v, tb=max(1, tq._SCAN_ROWS // tile)))
-        scan_args = (tree, tq3, seed_cand)
+            _scan_tiles, k=k, v=plan.v, tb=plan.tb))
+        scan_args = (tree, tq3, seed_cand, seed_lb)
     # candidate-bound DMA traffic: every finite candidate bucket's coords+ids
     seed_bytes = int(np.asarray((seed_cand >= 0).sum())) * B * (D + 1) * 4
     timeit("query: seed scan", scan, *scan_args, nbytes=seed_bytes)
@@ -165,7 +165,7 @@ def main():
                tq3, cand, cand_lb, nbytes=cb)
     else:
         timeit("query: collect scan (candidate-bound bytes)", scan, tree,
-               tq3, cand, nbytes=cb)
+               tq3, cand, cand_lb, nbytes=cb)
     print(f"candidates/tile: seed={plan.seeds} collect "
           f"mean={float(np.asarray((cand >= 0).sum(axis=1).mean())):.1f} "
           f"max={int(np.asarray((cand >= 0).sum(axis=1).max()))} "

@@ -2,14 +2,14 @@
 tests/test_build_presort.py: same algorithm, two implementations) plus the
 brute-force oracle. Runs in interpreter mode on the CPU test mesh.
 
-The whole module gates on an interpret-path PROBE (not a version pin):
-older jax (e.g. the 0.4.37 line) raises NotImplementedError inside the
-CPU interpret machinery for this kernel's primitives while the kernel is
-fine on real TPU backends — a known-environment limitation, not a
-regression, so it must read as SKIPPED, not FAILED (ROADMAP "Pallas
-on-CPU interpret parity"; the kernel-port half of that item stays open).
-A probe beats a version gate because it keeps working when a future jax
-implements the missing discharge rules — the tests un-skip themselves.
+The whole module gates on an interpret-path PROBE (not a version pin).
+PR 6 ported the kernel to the jax 0.4.x interpret machinery (the
+early-exit decision is carried through the while_loop instead of read
+from refs in its cond — 0.4.x cannot discharge ref effects in a while
+cond), so these tests now RUN on this container's jax 0.4.37. The probe
+stays: an even older jax missing other discharge rules must read as
+SKIPPED, not FAILED — and the probe un-skips itself wherever the
+interpreter works, which is exactly how these 5 tests came back.
 """
 
 import functools
@@ -32,7 +32,7 @@ def _mk_tiles(pts, qs, tile, k, cmax, seeds=8):
     box_lo, box_hi = jnp.min(tiles, axis=1), jnp.max(tiles, axis=1)
     inf_b = jnp.full(T, jnp.inf, jnp.float32)
     seed_cand, seed_lb, _ = tq._frontier(tree, box_lo, box_hi, inf_b, seeds)
-    sd, _ = tq._scan_tiles(tree, tiles, seed_cand, k, 8, 8)
+    sd, _ = tq._scan_tiles(tree, tiles, seed_cand, seed_lb, k, 8, 8)
     bound = jnp.max(sd[..., k - 1], axis=1)
     cand, lb, _ = tq._frontier(tree, box_lo, box_hi, bound, cmax)
     return tree, tiles, cand, lb
@@ -66,7 +66,7 @@ def test_matches_xla_scan(n, d, k, tile):
     pts, _ = generate_problem(seed=1, dim=d, num_points=n, num_queries=1)
     qs, _ = generate_problem(seed=2, dim=d, num_points=128, num_queries=1)
     tree, tiles, cand, lb = _mk_tiles(pts, qs, tile, k, cmax=64)
-    xd, xi = tq._scan_tiles(tree, tiles, cand, k, 8, 8)
+    xd, xi = tq._scan_tiles(tree, tiles, cand, lb, k, 8, 8)
     pd, pi = scan_tiles_fused(tree, tiles, cand, lb, k, interpret=True)
     np.testing.assert_allclose(np.asarray(pd), np.asarray(xd), rtol=1e-6)
     # ids may differ on exact distance ties; they must reproduce distances

@@ -109,7 +109,7 @@ def test_multibatch_async_dispatch_and_retry(monkeypatch):
     real = tqm._tiled_batch
 
     def spy(*a, **kw):
-        calls.append(a[4])  # the cmax this batch ran at
+        calls.append(a[6])  # the cmax this batch ran at
         return real(*a, **kw)
 
     monkeypatch.setattr(tqm, "_tiled_batch", spy)
@@ -171,6 +171,141 @@ def test_drive_batches_cap_settle_and_straggler_retry():
     # batches 0/2 answered at cap 4, straggler at cap 8
     np.testing.assert_array_equal(
         np.asarray(d2).ravel(), [4.0, 4.0, 4.0, 4.0, 8.0, 8.0]
+    )
+
+
+def test_drive_batches_pipelined_retire_and_retry_counting():
+    """The bounded-lookahead pipeline: with more batches than the window,
+    the oldest in-flight batch is retired per new dispatch; a retired
+    batch that overflows retries ALONE (younger in-flight batches are
+    never re-dispatched for it), and the retry counter counts exactly the
+    re-dispatches — no double count for in-flight lookahead."""
+    from kdtree_tpu import obs
+    from kdtree_tpu.ops.tile_query import drive_batches
+
+    retc = obs.get_registry().counter("kdtree_tile_overflow_retries_total")
+    calls = []
+
+    def run_batch(b0, cap):
+        calls.append((b0, cap))
+        need = 8 if b0 == 4 else 2  # one straggler mid-stream
+        return (
+            jnp.full((2, 1), float(cap)),
+            jnp.full((2, 1), b0, jnp.int32),
+            jnp.asarray(cap < need),
+        )
+
+    r0 = retc.value
+    offsets = [0, 2, 4, 6, 8, 10]
+    d2, gi = drive_batches(run_batch, offsets, cmax=2, nbp=16, lookahead=2)
+    # settle (0@2 clean); fill window (2@2, 4@2); retire 2 (clean) ->
+    # dispatch 6@2; retire 4: overflow -> 4@4 -> 4@8 clean; dispatch 8@8;
+    # retire 6 (clean); dispatch 10@8; drain [8, 10] stacked, clean.
+    assert calls == [(0, 2), (2, 2), (4, 2), (6, 2), (4, 4), (4, 8),
+                     (8, 8), (10, 8)], calls
+    # retry counter == re-dispatches (2 for the straggler), NOT the
+    # in-flight batches that happened to be queued behind it
+    assert retc.value - r0 == 2
+    np.testing.assert_array_equal(
+        np.asarray(gi).ravel(), np.repeat(offsets, 2)
+    )
+    # every batch's answer comes from its LAST (clean) dispatch cap
+    np.testing.assert_array_equal(
+        np.asarray(d2).ravel(), [2, 2, 2, 2, 8, 8, 2, 2, 8, 8, 8, 8]
+    )
+
+
+def test_pipelined_undersized_cmax_byte_identical(monkeypatch):
+    """The issue-6 acceptance contract for pipelining x overflow-retry: a
+    forced-undersized cmax under a multi-batch pipelined drive (lookahead
+    > 1, exercised via the env knob) must settle to results BYTE-IDENTICAL
+    to a never-overflowing run, and the retry counter must count exactly
+    the extra dispatches (probe doubling + per-batch retries), never the
+    in-flight lookahead batches that retired clean."""
+    import kdtree_tpu.ops.tile_query as tqm
+    from kdtree_tpu import obs
+
+    monkeypatch.setattr(tqm, "_BATCH_Q", 256)
+    pts, _ = generate_problem(seed=11, dim=2, num_points=30000,
+                              num_queries=1)
+    qs, _ = generate_problem(seed=12, dim=2, num_points=1024, num_queries=1)
+    tree = build_morton(pts)
+    # oracle: cap = nbp can never overflow -> zero retries by construction
+    od2, ogi = tqm.morton_knn_tiled(tree, qs, k=4, tile=8,
+                                    cmax=tree.num_buckets)
+
+    calls = []
+    real = tqm._tiled_batch
+
+    def spy(*a, **kw):
+        calls.append(a[6])
+        return real(*a, **kw)
+
+    monkeypatch.setattr(tqm, "_tiled_batch", spy)
+    retc = obs.get_registry().counter("kdtree_tile_overflow_retries_total")
+    for lookahead in ("1", "2"):
+        monkeypatch.setenv("KDTREE_TPU_TILE_LOOKAHEAD", lookahead)
+        calls.clear()
+        r0 = retc.value
+        d2, gi = tqm.morton_knn_tiled(tree, qs, k=4, tile=8, cmax=2)
+        n_batches = 1024 // 256
+        assert len(calls) > n_batches, "no retry ran — weaken the setup"
+        # every call beyond one-per-batch is a retry; exact equality IS
+        # the no-double-count assertion
+        assert retc.value - r0 == len(calls) - n_batches
+        np.testing.assert_array_equal(np.asarray(d2), np.asarray(od2))
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(ogi))
+
+
+def test_plan_small_tile_forces_wide_fold_regardless_of_bucket_size():
+    """The small-tile heuristic branch must land in _fold_block's WIDE
+    regime even when the bucket size is small enough that _SCAN_V chunks
+    would slip under the narrow width gate (review finding: narrow
+    extract at tiny tiles is a measured throughput regression — the
+    branch exists to avoid it, so it must actually do so)."""
+    from kdtree_tpu.ops import tile_query as tq
+
+    for B in (32, 64, 256):
+        plan = tq.plan_tiled(1024, 3, 30000, 512, B, 4, tile=8)
+        assert plan.v * B + 4 > tq._EXTRACT_W_MAX, (B, plan.v)
+    # the wide-tile branch is untouched: single-bucket narrow chunks
+    plan = tq.plan_tiled(1024, 3, 30000, 512, 256, 4, tile=128)
+    assert plan.v == 1
+
+
+def test_drive_batches_drain_retries_stale_cap_batches():
+    """Exactness regression (PR 6 review): when retiring an earlier
+    straggler grows bcmax to the nbp CEILING while tail batches are still
+    in flight at a stale smaller cap, the drain must retry those batches —
+    the ceiling short-circuit applies per batch (its LAST dispatch ran at
+    nbp), never to bcmax, or an overflow-flagged (incomplete) result is
+    silently returned."""
+    from kdtree_tpu.ops.tile_query import drive_batches
+
+    calls = []
+
+    def run_batch(b0, cap):
+        calls.append((b0, cap))
+        need = 4 if b0 in (2, 4) else 2
+        return (
+            jnp.full((2, 1), float(cap)),
+            jnp.full((2, 1), b0, jnp.int32),
+            jnp.asarray(cap < need),
+        )
+
+    offsets = [0, 2, 4, 6]
+    d2, gi = drive_batches(run_batch, offsets, cmax=2, nbp=4, lookahead=2,
+                           settle_first=False)
+    # fill (0@2, 2@2); retire 0 clean; dispatch 4@2; retire 2: overflow ->
+    # bcmax grows to nbp=4 -> 2@4 clean; dispatch 6@4; drain [4, 6]:
+    # batch 4 overflowed at its STALE cap 2 and must redispatch at 4 even
+    # though bcmax == nbp already (the old break returned its bad result)
+    assert calls == [(0, 2), (2, 2), (4, 2), (2, 4), (6, 4), (4, 4)], calls
+    np.testing.assert_array_equal(
+        np.asarray(d2).ravel(), [2, 2, 4, 4, 4, 4, 4, 4]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(gi).ravel(), np.repeat(offsets, 2)
     )
 
 

@@ -118,6 +118,43 @@ def test_dispatch_windows_lag_and_compiles():
     assert rep["device"]["busy_us"] == pytest.approx(120.0)
 
 
+def test_dispatch_stage_decomposition_and_busy_frac_median():
+    """PR 6: each dispatch window's host time decomposes by driver stage
+    (prep / retire-wait / drain-wait, from the tile.retire / tile.drain
+    annotations), and per-dispatch busy_frac gets a median over ALL
+    windows — the >90% acceptance gate's mechanical form."""
+    rep = tl.parse_timeline(_trace(
+        M_proc(1, "/host:CPU"),
+        X("tile.dispatch", 0, 5, args={"batch": 0}),
+        X("op.1", 10, 80, tid=2, args={"hlo_op": "op.1",
+                                       "hlo_module": "jit_b"}),
+        # the driver blocked 20us on batch 0's overflow flag here
+        X("tile.retire", 60, 20, args={"batch": 0}),
+        X("tile.dispatch", 100, 5, args={"batch": 1}),
+        X("op.2", 110, 20, tid=2, args={"hlo_op": "op.2",
+                                        "hlo_module": "jit_b"}),
+        X("tile.drain", 150, 50, args={"batches": 1}),
+    ))
+    disp = rep["dispatches"]
+    assert disp["count"] == 2
+    st = disp["stages"]
+    assert st["retire_us"] == pytest.approx(20.0)
+    assert st["drain_us"] == pytest.approx(50.0)
+    # windows: [0, 100) + [100, 200) = 200 wall, minus 70 stage-wait
+    assert st["prep_us"] == pytest.approx(130.0)
+    # per-window fracs: 80/100 and 20/100 -> median (even n: upper mid)
+    assert disp["busy_frac_median"] == pytest.approx(0.8)
+    w0, w1 = disp["windows"]
+    assert w0["retire_us"] == pytest.approx(20.0)
+    assert w0["drain_us"] == pytest.approx(0.0)
+    assert w1["drain_us"] == pytest.approx(50.0)
+    # stage annotations are dotted names and still correlate as spans too
+    assert "tile.retire" in rep["spans"]
+    # the human rendering surfaces the split
+    text = tl.render_timeline(rep)
+    assert "host-stage split" in text and "retire=" in text
+
+
 def test_idle_gaps_reported_largest_first():
     rep = tl.parse_timeline(_trace(
         M_proc(1, "/host:CPU"),

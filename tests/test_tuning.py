@@ -282,8 +282,8 @@ def test_tuner_sweep_persists_winner(store):
     # nbp=32 here: caps must stay <= nbp, and cmax=32 (= nbp) can never
     # overflow so the sweep always has at least one valid candidate
     out = tuner.sweep(tree, qs, k=4, tiles=(64, 256), cmaxs=(16, 32),
-                      store=store)
-    assert len(out["results"]) == 4
+                      sweep_blocks=False, store=store)
+    assert len(out["results"]) == 4 and out["block_results"] == []
     assert out["persisted"] and os.path.exists(out["path"])
     prof = store.get(make_signature(1024, 3, 8000, 4, tree.bucket_size,
                                     tree.num_buckets))
@@ -294,6 +294,143 @@ def test_tuner_sweep_persists_winner(store):
 
     plan = plan_tiled(1024, 3, 8000, tree.num_buckets, tree.bucket_size, 4)
     assert plan.source == "warm" and plan.tile == out["winner"]["tile"]
+
+
+def test_tuner_block_sweep_roundtrips_through_store(store):
+    """Phase 2 (block-shape sweep) measures (v, tb) at the phase-1 winner
+    and, when a block candidate wins, persists v/tb — which the auto
+    planner then consumes as a warm plan (the PR 6 'tuner-swept kernel
+    block sizes' loop, docs/TUNING.md 'Raw speed')."""
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.ops.tile_query import plan_tiled
+    from kdtree_tpu.tuning import tuner
+
+    pts, _ = generate_problem(seed=11, dim=3, num_points=8000, num_queries=1)
+    qs = generate_queries(13, 3, 1024)
+    tree = build_morton(pts)
+    # one launch candidate (cmax = nbp can never overflow) and one block
+    # candidate: the sweep stays tiny but walks the whole phase-2 path
+    out = tuner.sweep(tree, qs, k=4, tiles=(128,),
+                      cmaxs=(tree.num_buckets,), vs=(1,), tbs=(2,),
+                      store=store)
+    assert len(out["block_results"]) == 1
+    br = out["block_results"][0]
+    assert (br["v"], br["tb"]) == (1, 2)
+    assert out["persisted"]
+    if out["winner"]["v"] is not None:
+        # the block candidate won: v/tb are pinned in the profile and the
+        # auto planner starts from them
+        prof = store.get(make_signature(1024, 3, 8000, 4, tree.bucket_size,
+                                        tree.num_buckets))
+        assert (prof["v"], prof["tb"]) == (1, 2)
+        plan = plan_tiled(1024, 3, 8000, tree.num_buckets,
+                          tree.bucket_size, 4)
+        assert plan.source == "warm"
+        assert (plan.v, plan.tb) == (1, 2)
+    else:
+        # the heuristic block shape won: the profile must NOT pin v/tb,
+        # so future heuristic improvements keep applying
+        prof = store.get(make_signature(1024, 3, 8000, 4, tree.bucket_size,
+                                        tree.num_buckets))
+        assert "v" not in prof and "tb" not in prof
+
+
+def test_tuner_no_block_sweep_preserves_swept_knobs(store):
+    """A --no-block-sweep re-tune refreshes (tile, cmax) but measures
+    NOTHING about the block shape — previously tuner-swept v/tb must
+    survive the rewrite (review finding: store.put replaces the whole
+    profile, so the phase-1-only path silently erased them)."""
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.tuning import tuner
+
+    pts, _ = generate_problem(seed=11, dim=3, num_points=8000, num_queries=1)
+    qs = generate_queries(13, 3, 1024)
+    tree = build_morton(pts)
+    sig = make_signature(1024, 3, 8000, 4, tree.bucket_size,
+                         tree.num_buckets)
+    # stored cmax deliberately differs from the refresh winner's: the
+    # feedback recorder rewrites cmax on cap drift while preserving
+    # v/tb, so the preserve match must key on TILE only
+    store.put(sig, {"tile": 128, "cmax": 16, "seeds": 8,
+                    "use_pallas": False, "v": 1, "tb": 2})
+    out = tuner.sweep(tree, qs, k=4, tiles=(128,),
+                      cmaxs=(tree.num_buckets,), sweep_blocks=False,
+                      store=store)
+    assert out["persisted"] and out["winner"]["v"] is None
+    prof = store.get(sig)
+    assert (prof["v"], prof["tb"]) == (1, 2)
+
+    # ... but only when the refresh confirmed the SAME launch config:
+    # block knobs measured at tile=128 pinned onto a different winning
+    # tile would hard-code the wrong fold regime for it
+    store.put(sig, {"tile": 64, "cmax": int(tree.num_buckets), "seeds": 8,
+                    "use_pallas": False, "v": 1, "tb": 2})
+    out = tuner.sweep(tree, qs, k=4, tiles=(128,),
+                      cmaxs=(tree.num_buckets,), sweep_blocks=False,
+                      store=store)
+    assert out["persisted"] and out["winner"]["tile"] == 128
+    prof = store.get(sig)
+    assert "v" not in prof and "tb" not in prof
+
+    # with the block sweep ON, a previously swept (v, tb) at the SAME
+    # launch config is RE-MEASURED (joins the candidate grid) rather
+    # than silently dropped when the default grid lacks it
+    store.put(sig, {"tile": 128, "cmax": int(tree.num_buckets), "seeds": 8,
+                    "use_pallas": False, "v": 4, "tb": 8})
+    out = tuner.sweep(tree, qs, k=4, tiles=(128,),
+                      cmaxs=(tree.num_buckets,), vs=(1,), tbs=(2,),
+                      store=store)
+    measured = {(r["v"], r["tb"]) for r in out["block_results"]}
+    assert measured == {(1, 2), (4, 8)}
+
+
+def test_warm_block_knobs_dropped_when_tile_clamped(store):
+    """When the Q clamp changes a warm plan's tile, the profile's swept
+    v/tb no longer describe the tile they were measured at — the plan
+    must fall back to the shape heuristic for them (same invariant the
+    tuner's _prev_block_knobs enforces), not pin the narrow fold onto a
+    tiny clamped tile."""
+    from kdtree_tpu.ops import tile_query as tq
+
+    sig = make_signature(64, 3, 16000, 4, 256, 64, backend="cpu")
+    store.put(sig, {"tile": 64, "cmax": 32, "seeds": 8,
+                    "use_pallas": False, "v": 1, "tb": 2})
+    plan = tq.plan_tiled(40, 3, 16000, 64, 256, 4)
+    assert plan.source == "warm" and plan.tile == 40
+    # heuristic wide regime for the clamped tiny tile, not the pinned v=1
+    assert plan.v * 256 + 4 > tq._EXTRACT_W_MAX
+    # unclamped, the same profile's v applies as stored (tb still rides
+    # the dead-tile clamp: one tile per batch at this shape caps tb=1)
+    plan = tq.plan_tiled(64, 3, 16000, 64, 256, 4)
+    assert (plan.tile, plan.v, plan.tb) == (64, 1, 1)
+
+
+def test_plan_consumes_stored_block_shape(store):
+    """A profile carrying v/tb hands them to the auto plan; malformed
+    block knobs in a (tampered/stale) profile read as 'not recorded', and
+    feedback's settled() write-back must not erase tuner-swept v/tb."""
+    from kdtree_tpu.ops.tile_query import plan_tiled
+
+    sig = make_signature(2048, 3, 16000, 4, 256, 64, backend="cpu")
+    base = {"tile": 128, "cmax": 32, "seeds": 8, "use_pallas": False}
+    store.put(sig, dict(base, v=1, tb=4))
+    plan = plan_tiled(2048, 3, 16000, 64, 256, 4)
+    assert plan.source == "warm" and (plan.v, plan.tb) == (1, 4)
+
+    store.put(sig, dict(base, v="wide", tb=0))  # unusable block knobs
+    plan = plan_tiled(2048, 3, 16000, 64, 256, 4)
+    assert plan.source == "warm"
+    assert plan.tb >= 1 and plan.v >= 1  # heuristic fallback, not garbage
+
+    # settled() merges: the launch facts update, block knobs survive
+    store.put(sig, dict(base, v=1, tb=4))
+    from kdtree_tpu import tuning
+
+    plan = plan_tiled(2048, 3, 16000, 64, 256, 4)
+    fb = tuning.feedback_for(plan, store=store)
+    fb.settled(cmax=48, retries=0)
+    prof = store.get(sig)
+    assert prof["cmax"] == 48 and (prof["v"], prof["tb"]) == (1, 4)
 
 
 def test_tuner_all_overflow_persists_nothing(store):
