@@ -642,8 +642,16 @@ def main() -> None:
             # from reading a 2-pass sidecar against a 1-pass one as a 2x
             # regression — compare only at equal pass counts
             "passes": 2 if args.pair else 1,
-            "headline": {k: headline[k] for k in
-                         ("metric", "value", "unit", "vs_baseline")},
+            # full headline incl. extra_metrics (+ pair_first when
+            # paired): the sidecar is a self-contained `kdtree-tpu trend`
+            # input — the trend gate reads per-metric values, recompile
+            # counts, and the pair spread its noise band is fitted from
+            "headline": {
+                **{k: headline[k] for k in
+                   ("metric", "value", "unit", "vs_baseline")},
+                "extra_metrics": extra,
+            },
+            "pair_first": pair_first,
         }) is not None:
             print(f"bench: telemetry sidecar written to {metrics_out}",
                   file=sys.stderr)

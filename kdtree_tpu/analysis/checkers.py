@@ -77,6 +77,19 @@ R_METRIC_NAME = register(Rule(
     "serves EVERY series ever minted)",
 ))
 
+R_SLO_NAME = register(Rule(
+    "KDT106", "dynamic-slo-name", CORRECTNESS,
+    "SLO spec names (SloSpec(...)) and metric-history series names "
+    "(MetricHistory.mark(...)) must be static strings from a bounded "
+    "set — no f-strings, concatenation, or .format()",
+    "the SLO engine (PR 8) labels every kdtree_slo_* gauge with its "
+    "spec name and the history ring keeps one mark series per name: a "
+    "spec or mark name minted per shard/request/batch grows the "
+    "registry (and every /metrics scrape) without bound — the same "
+    "cardinality leak KDT105 catches for plain metric names, one "
+    "constructor away",
+))
+
 R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
@@ -841,3 +854,47 @@ def check_dynamic_metric_name(ctx) -> Iterator[Finding]:
                             "the registry (and every /metrics scrape) "
                             "without limit; use a bounded enum",
                         )
+
+
+# --------------------------------------------------------------------------
+# KDT106 — dynamic-slo-name
+# --------------------------------------------------------------------------
+
+# SLO spec constructors whose name becomes a kdtree_slo_* gauge label,
+# and history methods whose first argument mints a per-name series.
+# Same syntactic contract as KDT105: f-strings / concat / .format() are
+# the leak signatures, a plain Name is the sanctioned bounded-enum idiom.
+_SLO_CTORS = {"SloSpec"}
+_HISTORY_SERIES_METHODS = {"mark"}
+
+
+@checker(R_SLO_NAME)
+def check_dynamic_slo_name(ctx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = call_name(node).split(".")[-1]
+        name_arg = None
+        what = None
+        if leaf in _SLO_CTORS:
+            what = f"{leaf}() spec name"
+            name_arg = node.args[0] if node.args else None
+            if name_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+        elif leaf in _HISTORY_SERIES_METHODS:
+            # BurstDetector.mark() takes no argument and is skipped by
+            # the name_arg check below; only name-minting marks qualify
+            what = "history mark() series name"
+            name_arg = node.args[0] if node.args else None
+        if name_arg is None:
+            continue
+        kind = _dynamic_str_kind(name_arg)
+        if kind:
+            yield _mk(
+                R_SLO_NAME, ctx, name_arg,
+                f"{what} built from {kind}: every distinct value mints a "
+                "new kdtree_slo_*/history series forever — use a static "
+                "name from a bounded set",
+            )

@@ -20,7 +20,15 @@ One place for every "what did this run actually do" question:
 - :mod:`~kdtree_tpu.obs.profile` — programmatic ``jax.profiler`` capture
   windows (one at a time, process-wide);
 - :mod:`~kdtree_tpu.obs.timeline` — Chrome-trace analysis joining device
-  op slices back to host spans (``kdtree-tpu profile`` renders it).
+  op slices back to host spans (``kdtree-tpu profile`` renders it);
+- :mod:`~kdtree_tpu.obs.history` — metric history: a bounded ring of
+  periodic registry snapshots with windowed delta/rate/quantile queries
+  (``GET /debug/history``; the SLO engine's substrate);
+- :mod:`~kdtree_tpu.obs.slo` — declarative SLOs with multi-window
+  burn-rate evaluation (``kdtree_slo_*`` gauges, ``/healthz`` verdict,
+  PAGE → incident dump);
+- :mod:`~kdtree_tpu.obs.trend` — bench-trend sentinel over a series of
+  bench artifacts (``kdtree-tpu trend``, the CI trend gate).
 
 Cost model — two tiers, so production hot paths never pay for telemetry
 they didn't ask for:

@@ -182,26 +182,89 @@ def write_report(
     return rep
 
 
-# Help strings for the metric families a scraper is most likely to alert
-# on (serving + plan cache + JAX runtime). Families not listed here simply
-# emit no # HELP line — an empty help is worse than none.
+# Help strings for EVERY metric family the package registers — the
+# catalog is test-enforced (tests/test_obs.py scans the package for
+# instrument registrations and fails on any family missing here), so it
+# can no longer drift by convention. Keep entries alphabetical-ish by
+# subsystem; a family with no entry emits no # HELP line and fails CI.
 METRIC_HELP = {
+    # serving
     "kdtree_serve_requests_total": "k-NN serving requests by outcome",
     "kdtree_serve_request_seconds":
         "per-request latency by phase (queue/dispatch/total)",
     "kdtree_serve_batch_rows": "coalesced rows per dispatched micro-batch",
     "kdtree_serve_batch_requests": "requests coalesced per micro-batch",
+    "kdtree_serve_batch_errors_total":
+        "micro-batch or fallback dispatches that raised",
     "kdtree_serve_queue_depth": "query rows waiting in the admission queue",
     "kdtree_serve_shed_total": "requests shed (429) at the admission gate",
+    "kdtree_serve_deadline_timeouts_total":
+        "requests whose deadline expired while queued",
     "kdtree_serve_degraded_total":
         "requests answered by the brute-force degradation path, by reason",
     "kdtree_serve_batches_total":
         "dispatched micro-batches by plan-cache temperature",
     "kdtree_serve_ready": "1 once the index is loaded and warmup compiled",
+    "kdtree_serve_warmup_buckets":
+        "pow2 row buckets compiled by the warmup ladder",
+    # SLOs + metric history (docs/OBSERVABILITY.md "SLOs & burn rates")
+    "kdtree_slo_state":
+        "SLO state by spec: 0 OK, 1 WARN, 2 PAGE (multi-window burn rate)",
+    "kdtree_slo_burn_rate":
+        "error-budget burn rate over the tier's long window, by SLO",
+    "kdtree_slo_transitions_total":
+        "SLO state transitions, by SLO and destination state",
+    "kdtree_history_samples_total": "metric-history ring samples taken",
+    "kdtree_device_busy_frac":
+        "device busy fraction of the last analyzed profiler capture",
+    # engines
+    "kdtree_builds_total": "index builds by engine",
+    "kdtree_build_points_total": "rows indexed by engine",
+    "kdtree_queries_total": "query calls by engine",
+    "kdtree_query_rows_total": "query rows by engine",
+    "kdtree_shard_queries_total":
+        "per-shard query rows absorbed by the forest engines",
+    "kdtree_tile_batches_total":
+        "tiled-engine sub-batch programs dispatched",
+    "kdtree_tile_overflow_retries_total":
+        "candidate-cap doubling re-runs (cap settling + stragglers)",
+    "kdtree_tile_candidates_total":
+        "collect-pass candidate buckets actually scanned",
+    "kdtree_tile_scan_units_total":
+        "(tile x local-tree) frontier descents",
+    "kdtree_tile_prune_rate":
+        "1 - candidates/(scan_units x buckets) of the last tiled run",
+    "kdtree_bucket_occupancy": "real points per bucket at build time",
+    "kdtree_span_seconds": "duration distribution per host span path",
+    "kdtree_forest_devices": "device count of the last forest build",
+    "kdtree_exchange_slack":
+        "sample-sort exchange capacity factor of the last scale build",
+    "kdtree_slack_occupancy_sized_total":
+        "scale builds whose exchange slack was sized from warm "
+        "occupancy profiles",
+    "kdtree_guard_nan_checks_total": "assert_no_nan invocations",
+    "kdtree_guard_nan_check_seconds_total":
+        "measured host-sync cost of the NaN guards",
+    "kdtree_profile_captures_total": "profiler capture windows opened",
+    # plan store (docs/TUNING.md)
     "kdtree_plan_cache_hits_total": "tiled-plan store lookups that hit",
     "kdtree_plan_cache_misses_total": "tiled-plan store lookups that missed",
+    "kdtree_plan_cache_writes_total":
+        "tiled-plan profiles written to the store",
+    # JAX runtime
     "jax_backend_compiles_total":
         "XLA backend compiles; growth after warmup means recompiles",
+    "jax_backend_compile_seconds_total":
+        "total XLA backend compile time in seconds",
+    "jax_events_total": "raw jax.monitoring event counts, by event",
+    "jax_event_seconds_total":
+        "raw jax.monitoring duration totals, by event",
+    "jax_event_seconds_last":
+        "last raw jax.monitoring duration observed, by event",
+    "jax_platform_info": "1 for the platform that actually ran",
+    "jax_device_init_seconds": "measured backend-init duration",
+    "jax_device_count": "visible devices",
+    "jax_device_memory_bytes": "live device memory_stats snapshot",
 }
 
 
@@ -365,6 +428,25 @@ def render_report_diff(old: Dict, new: Dict) -> str:
 
     def fact(rep, key, default="?"):
         return rep.get(key, default)
+
+    # pair-vs-single footgun: a --pair sidecar aggregates spans/counters
+    # over BOTH timed passes (one registry per process). Diffing it
+    # against a single-pass report reads as a silent ~2x regression —
+    # warn LOUDLY instead of rendering a wrong comparison quietly.
+    old_passes = int(old.get("passes", 1) or 1)
+    new_passes = int(new.get("passes", 1) or 1)
+    if old_passes != new_passes:
+        out.append(
+            "!! WARNING: pass-count mismatch — OLD aggregates "
+            f"{old_passes} timed pass(es), NEW {new_passes}."
+        )
+        out.append(
+            "!! A --pair sidecar sums spans and counters over both "
+            "passes; comparing it against a single-pass report "
+            "misreads as a ~2x regression. Compare only reports with "
+            "matching \"passes\"."
+        )
+        out.append("")
 
     out.append("== run ==")
     out.append(f"{'':20s}  {'OLD':>14s}  {'NEW':>14s}")

@@ -225,7 +225,20 @@ def record(kind: str, **fields) -> None:
 
 
 def auto_dump(reason: str, force: bool = False) -> Optional[str]:
-    return _recorder.auto_dump(reason, force=force)
+    path = _recorder.auto_dump(reason, force=force)
+    if path is not None:
+        # every incident that earned a flight dump gets the metric-
+        # history ring dumped alongside it (history-<reason>.json): the
+        # flight ring says what happened in order, the history ring says
+        # how the totals were trending into it. Piggybacks the flight
+        # rate limit — this only runs when a flight file was written.
+        try:
+            from kdtree_tpu.obs import history
+
+            history.auto_dump(reason)
+        except Exception:
+            pass
+    return path
 
 
 _handler_installed = False
@@ -246,7 +259,9 @@ def install_signal_handler() -> bool:
         return False
 
     def _on_sigusr2(signum, frame):
-        path = _recorder.auto_dump("sigusr2", force=True)
+        # the module-level auto_dump so the operator's button also drops
+        # the metric-history companion next to the flight ring
+        path = auto_dump("sigusr2", force=True)
         if path:
             import sys
 

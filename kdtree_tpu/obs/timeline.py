@@ -372,6 +372,15 @@ def analyze_trace_file(
     """Load + parse; records the source path in the report."""
     rep = parse_timeline(load_trace(path), span_names, dispatch_name)
     rep["trace_file"] = path
+    # publish the capture's headline as a live gauge: the device-busy
+    # SLO (obs/slo.py) keys on this, so a /debug/profile capture (or the
+    # bench's in-run capture) feeds the burn-rate engine without a new
+    # measurement path. Last capture wins — it is a gauge, not a series.
+    busy = rep.get("device", {}).get("busy_frac")
+    if busy is not None:
+        from kdtree_tpu.obs.registry import get_registry
+
+        get_registry().gauge("kdtree_device_busy_frac").set(float(busy))
     return rep
 
 

@@ -1,0 +1,343 @@
+"""Bench-trend sentinel: regression detection over a series of bench runs.
+
+The repository's own history motivates this module: the headline bench
+fell from 87.2M pts/s on TPU (round 2) to a 1.3M pts/s CPU fallback at
+round 3 and STAYED degraded for three rounds before a human noticed the
+fallback string in the JSON. ``kdtree-tpu trend`` reads a chronological
+series of bench artifacts and flags exactly that class of silent decay:
+
+- **platform-fallback**: an accelerator round followed by a CPU round
+  (or a run that newly carries the ``degraded`` reason);
+- **throughput-drop**: a rate metric (pts/s, q/s) falling beyond the
+  noise band between consecutive runs — the headline is compared across
+  rounds unconditionally (it is *defined* to be cross-round comparable,
+  bench.py's contract since r2), extra metrics only where their
+  platform-stripped names match;
+- **recompile-growth**: a timed section's ``recompiles`` count growing
+  (a warm steady state must hold it flat — growth means shape churn).
+
+The noise band is fitted from ``--pair`` runs when any input carries a
+``pair_first`` block (two same-process passes bound the run-to-run
+spread; band = clamp(3 × max relative spread, 0.2, 0.95)); without pair
+data it defaults to 0.5 — this container's measured CPU noise is ±40%
+(bench.py), so only paired runs support a tighter band.
+
+Findings are fingerprinted (rule|metric|from->to) and grandfathered by a
+committed baseline exactly like the linter (``lint_baseline.json``): CI
+fails only on NEW regressions, and ``--update-baseline`` burns known
+ones in. Accepted inputs per file: a driver ``BENCH_r*.json`` (the
+``parsed`` headline), a raw bench headline JSON line, or a bench
+telemetry sidecar (``bench_telemetry.json`` — the ``headline`` block
+plus top-level platform/degraded/pair_first facts).
+
+Stdlib-only (shares ``stats --diff``'s delta rendering); the CLI
+dispatches it before any jax-touching plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from kdtree_tpu.obs.export import _fmt_delta
+
+TREND_VERSION = 1
+TREND_BASELINE_VERSION = 1
+DEFAULT_BAND = 0.5  # container CPU noise is +-40% (bench.py --pair docs)
+_PLATFORM_TOKENS = {"cpu", "tpu", "gpu", "axon", "cuda", "rocm", "metal"}
+_RATE_UNITS = {"pts/s", "q/s"}
+HEADLINE_KEY = "headline"
+
+
+# --------------------------------------------------------------------------
+# loading
+# --------------------------------------------------------------------------
+
+
+def normalize_metric(name: str) -> str:
+    """Strip platform tokens from the parenthesized config so the same
+    measurement matches across platforms: ``"k-NN queries/sec (Q=16384,
+    k=16, 1M x 3D tree, tiled, cpu)"`` and its tpu twin share a key.
+    Config tokens (shape, Q, k) stay — a different shape is a different
+    measurement."""
+    head, sep, inner = name.partition(" (")
+    if not sep:
+        return name
+    inner = inner.rstrip(")")
+    toks = [t for t in inner.split(", ")
+            if t.strip().lower() not in _PLATFORM_TOKENS]
+    return f"{head} ({', '.join(toks)})" if toks else head
+
+
+def _platform_from_metric(name: str) -> Optional[str]:
+    head, sep, inner = name.partition(" (")
+    if not sep:
+        return None
+    for tok in reversed(inner.rstrip(")").split(", ")):
+        if tok.strip().lower() in _PLATFORM_TOKENS:
+            return tok.strip().lower()
+    return None
+
+
+def _pair_spread(headline: dict, pair_first: dict) -> Optional[float]:
+    """Max relative spread between a --pair run's two passes, over the
+    headline and every name-matched extra metric — the measured
+    same-process noise bound the band derives from."""
+    pairs = []
+    try:
+        pairs.append((float(headline["value"]), float(pair_first["value"])))
+    except (KeyError, TypeError, ValueError):
+        pass
+    second = {
+        normalize_metric(m.get("metric", "")): m.get("value")
+        for m in headline.get("extra_metrics") or []
+    }
+    for m in pair_first.get("extra_metrics") or []:
+        key = normalize_metric(m.get("metric", ""))
+        if key in second and second[key] is not None:
+            try:
+                pairs.append((float(second[key]), float(m["value"])))
+            except (KeyError, TypeError, ValueError):
+                pass
+    spreads = [
+        abs(a - b) / max((a + b) / 2.0, 1e-9) for a, b in pairs
+        if a > 0 or b > 0
+    ]
+    return max(spreads) if spreads else None
+
+
+def _from_headline(headline: dict, label: str, path: str) -> dict:
+    metric = str(headline.get("metric", ""))
+    platform = headline.get("platform") or _platform_from_metric(metric)
+    degraded = headline.get("degraded", False) or False
+    metrics: Dict[str, dict] = {
+        HEADLINE_KEY: {
+            "name": metric,
+            "value": float(headline.get("value", 0.0)),
+            "unit": str(headline.get("unit", "")),
+            "recompiles": None,
+            "plan_cache": None,
+        }
+    }
+    for em in headline.get("extra_metrics") or []:
+        if "metric" not in em or "value" not in em:
+            continue
+        key = normalize_metric(str(em["metric"]))
+        metrics[key] = {
+            "name": str(em["metric"]),
+            "value": float(em["value"]),
+            "unit": str(em.get("unit", "")),
+            "recompiles": em.get("recompiles"),
+            "plan_cache": em.get("plan_cache"),
+        }
+    run = {
+        "label": label,
+        "path": path,
+        "platform": (platform or "unknown").lower(),
+        "degraded": degraded,
+        "metrics": metrics,
+        "pair_spread": None,
+        "passes": 1,
+    }
+    pair = headline.get("pair_first")
+    if isinstance(pair, dict):
+        run["pair_spread"] = _pair_spread(headline, pair)
+        run["passes"] = 2
+    return run
+
+
+def load_run(path: str) -> dict:
+    """One bench artifact → run facts. Accepts a driver ``BENCH_r*.json``
+    wrapper, a raw headline JSON object, or a telemetry sidecar."""
+    with open(path) as f:
+        data = json.load(f)
+    label = os.path.splitext(os.path.basename(path))[0]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if isinstance(data.get("parsed"), dict):
+        # driver wrapper: {"n": round, "parsed": <headline>, ...}
+        if isinstance(data.get("n"), int):
+            label = f"r{data['n']:02d}"
+        return _from_headline(data["parsed"], label, path)
+    if "headline" in data and "counters" in data:
+        # telemetry sidecar: headline block + top-level run facts
+        head = dict(data["headline"])
+        head.setdefault("platform", data.get("platform"))
+        head.setdefault("degraded", data.get("degraded"))
+        if "pair_first" in data and "pair_first" not in head:
+            head["pair_first"] = data["pair_first"]
+        run = _from_headline(head, label, path)
+        run["passes"] = int(data.get("passes", run["passes"]) or 1)
+        return run
+    if "metric" in data and "value" in data:
+        return _from_headline(data, label, path)
+    raise ValueError(
+        f"{path}: not a bench headline, driver BENCH_r*.json, or bench "
+        "telemetry sidecar"
+    )
+
+
+# --------------------------------------------------------------------------
+# analysis
+# --------------------------------------------------------------------------
+
+
+def derive_band(runs: List[dict], explicit: Optional[float] = None) -> float:
+    """The relative-drop fraction treated as a regression. Explicit
+    wins; else fitted from --pair spreads (3× the worst same-process
+    spread, clamped to [0.2, 0.95]); else the container default 0.5."""
+    if explicit is not None:
+        return float(explicit)
+    spreads = [r["pair_spread"] for r in runs if r.get("pair_spread")]
+    if spreads:
+        return min(max(0.2, 3.0 * max(spreads)), 0.95)
+    return DEFAULT_BAND
+
+
+def fingerprint(f: dict) -> str:
+    return f"{f['rule']}|{f['metric']}|{f['from']}->{f['to']}"
+
+
+def _finding(rule: str, metric: str, prev: dict, cur: dict,
+             detail: str) -> dict:
+    f = {
+        "rule": rule,
+        "metric": metric,
+        "from": prev["label"],
+        "to": cur["label"],
+        "detail": detail,
+    }
+    f["fingerprint"] = fingerprint(f)
+    return f
+
+
+def analyze(runs: List[dict], band: Optional[float] = None):
+    """Consecutive-pair regression scan over a chronological series.
+    Returns ``(findings, band_used)``."""
+    used = derive_band(runs, band)
+    findings: List[dict] = []
+    for prev, cur in zip(runs, runs[1:]):
+        pp, cp = prev["platform"], cur["platform"]
+        if pp not in ("cpu", "unknown") and cp == "cpu":
+            reason = (f" ({cur['degraded']})"
+                      if isinstance(cur["degraded"], str) else "")
+            findings.append(_finding(
+                "platform-fallback", "platform", prev, cur,
+                f"{pp} -> {cp}{reason}: numbers are not comparable to "
+                "accelerator rounds",
+            ))
+        elif cur["degraded"] and not prev["degraded"]:
+            findings.append(_finding(
+                "degraded-run", "platform", prev, cur,
+                f"run newly degraded: {cur['degraded']}",
+            ))
+        for key in sorted(set(prev["metrics"]) & set(cur["metrics"])):
+            pm, cm = prev["metrics"][key], cur["metrics"][key]
+            if pm["unit"] in _RATE_UNITS and cm["unit"] in _RATE_UNITS:
+                pv, cv = pm["value"], cm["value"]
+                if pv > 0 and (pv - cv) / pv > used:
+                    findings.append(_finding(
+                        "throughput-drop", key, prev, cur,
+                        f"{pv:g} -> {cv:g} {_fmt_delta(pv, cv)} "
+                        f"(band {used:.0%})",
+                    ))
+            pr, cr = pm.get("recompiles"), cm.get("recompiles")
+            if pr is not None and cr is not None and cr > pr:
+                findings.append(_finding(
+                    "recompile-growth", key, prev, cur,
+                    f"recompiles in the timed section grew {pr:g} -> "
+                    f"{cr:g} (a warm steady state holds this flat)",
+                ))
+    return findings, used
+
+
+# --------------------------------------------------------------------------
+# baseline (grandfathering, linter-style)
+# --------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> set:
+    """Fingerprint set; a missing file is an empty baseline (a fresh
+    repo has nothing grandfathered). Corrupt files raise ValueError."""
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "grandfathered" not in data:
+        raise ValueError(f"{path} is not a trend baseline "
+                         "(missing 'grandfathered')")
+    return set(data["grandfathered"])
+
+
+def save_baseline(path: str, findings: List[dict]) -> int:
+    fps = sorted({f["fingerprint"] for f in findings})
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({
+            "trend_baseline_version": TREND_BASELINE_VERSION,
+            "grandfathered": fps,
+        }, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(fps)
+
+
+def partition(findings: List[dict], baseline: set) -> List[dict]:
+    """The findings NOT grandfathered — what fails the gate."""
+    return [f for f in findings if f["fingerprint"] not in baseline]
+
+
+# --------------------------------------------------------------------------
+# rendering
+# --------------------------------------------------------------------------
+
+
+def render_human(runs: List[dict], findings: List[dict],
+                 new: List[dict], band: float) -> str:
+    out = []
+    out.append("== runs ==")
+    width = max(len(r["label"]) for r in runs)
+    for r in runs:
+        head = r["metrics"][HEADLINE_KEY]
+        deg = (f"  DEGRADED: {r['degraded']}" if r["degraded"] else "")
+        pair = "  (pair)" if r.get("pair_spread") is not None else ""
+        out.append(
+            f"{r['label']:<{width}}  {r['platform']:<8}"
+            f"{head['value']:>14g} {head['unit']}{pair}{deg}"
+        )
+    out.append("")
+    new_fps = {f["fingerprint"] for f in new}
+    out.append(f"== findings ({len(findings)} total, {len(new)} new, "
+               f"band {band:.0%}) ==")
+    if not findings:
+        out.append("none — the trajectory is clean")
+    for f in findings:
+        tag = "[NEW] " if f["fingerprint"] in new_fps else "[base]"
+        out.append(f"{tag} {f['rule']:<18} {f['from']} -> {f['to']}  "
+                   f"{f['metric']}: {f['detail']}")
+    return "\n".join(out) + "\n"
+
+
+def render_json(runs: List[dict], findings: List[dict],
+                new: List[dict], band: float) -> str:
+    new_fps = {f["fingerprint"] for f in new}
+    return json.dumps({
+        "trend_version": TREND_VERSION,
+        "band": band,
+        "runs": [
+            {
+                "label": r["label"],
+                "platform": r["platform"],
+                "degraded": r["degraded"],
+                "headline_value": r["metrics"][HEADLINE_KEY]["value"],
+                "headline_unit": r["metrics"][HEADLINE_KEY]["unit"],
+                "passes": r["passes"],
+            }
+            for r in runs
+        ],
+        "findings": [
+            {**f, "new": f["fingerprint"] in new_fps} for f in findings
+        ],
+        "new_count": len(new),
+    }, indent=2, sort_keys=True) + "\n"

@@ -110,12 +110,21 @@ class ServeState:
         min_bucket: int,
         request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
         meta: Optional[dict] = None,
+        slo_engine=None,
+        history_period_s: Optional[float] = None,
     ) -> None:
         self.engine = engine
         self.max_batch = max_batch
         self.min_bucket = min_bucket
         self.request_timeout_s = request_timeout_s
         self.meta = dict(meta or {})
+        # SLO engine + history-sampler period (obs/slo.py, obs/history.py):
+        # the server starts a sampler at this period and evaluates the
+        # engine on every tick; /healthz reports its verdict in an "slo"
+        # block (readiness is NOT gated on it). None period = the
+        # KDTREE_TPU_HISTORY_PERIOD_S default.
+        self.slo_engine = slo_engine
+        self.history_period_s = history_period_s
         self._ready = threading.Event()
         self._ready_gauge = obs.get_registry().gauge("kdtree_serve_ready")
         self._ready_gauge.set(0)
@@ -196,6 +205,8 @@ def build_state(
     request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
     meta: Optional[dict] = None,
     install_listeners: bool = True,
+    slo_engine=None,
+    history_period_s: Optional[float] = None,
 ) -> ServeState:
     """Assemble a ready-to-warmup :class:`ServeState` from exactly one
     index source: a loaded ``tree``, a materialized ``points`` array, or
@@ -223,10 +234,18 @@ def build_state(
             points = generate_points_rowwise(seed, dim, n)
         tree = build_morton(jnp.asarray(points))
     engine = ServeEngine(tree, k)
+    if slo_engine is None:
+        # the process-default engine: default specs (request p99, error/
+        # shed/degraded rates, device busy) over the process history ring
+        from kdtree_tpu.obs import slo as obs_slo
+
+        slo_engine = obs_slo.get_engine()
     return ServeState(
         engine,
         max_batch=_pow2_ceil(max_batch),
         min_bucket=MIN_BUCKET if min_bucket is None else min_bucket,
         request_timeout_s=request_timeout_s,
         meta=meta,
+        slo_engine=slo_engine,
+        history_period_s=history_period_s,
     )

@@ -12,6 +12,9 @@
   scrape-endpoint item.
 - ``GET /debug/flight`` — the always-on flight recorder's ring (recent
   span completions + admissions/batches/sheds with trace ids) as JSON.
+- ``GET /debug/history`` — the metric-history ring (``obs/history.py``):
+  the periodic registry snapshots the SLO engine evaluates burn rates
+  against, newest last (``?limit=N`` keeps only the newest N samples).
 - ``POST /debug/profile?seconds=N`` — open a profiler capture window
   over the live process for N seconds, then return the analyzed device
   timeline (``obs/timeline.py`` report JSON). One capture at a time
@@ -130,13 +133,19 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
         if path == "/healthz":
             state: ServeState = self.server.state
             if state.ready:
-                self._send_json(200, {
+                body = {
                     "status": "ok",
                     "n": state.engine.tree.n_real,
                     "dim": state.engine.tree.dim,
                     "k_max": state.engine.k,
                     "max_batch": state.max_batch,
-                })
+                }
+                if state.slo_engine is not None:
+                    # SLO verdict rides along without gating readiness:
+                    # a burning p99 wants traffic drained elsewhere, not
+                    # the replica marked dead (docs/SERVING.md)
+                    body["slo"] = state.slo_engine.health_block()
+                self._send_json(200, body)
             else:
                 self._send_json(503, {"status": "warming"},
                                 extra_headers={"Retry-After": "1"})
@@ -154,6 +163,18 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             # the live ring, no file involved — same payload shape as a
             # SIGUSR2 dump so one reader handles both
             self._send_json(200, flight.recorder().report("debug-endpoint"))
+            return
+        if path == "/debug/history":
+            # the metric-history ring the SLO engine reads — same payload
+            # shape as an incident's history-<reason>.json dump
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                limit = int(qs.get("limit", ["0"])[0]) or None
+            except ValueError:
+                limit = None
+            self._send_json(200, self.server.history.report(limit=limit))
             return
         self._send_json(404, {"error": f"no such path: {path}"})
 
@@ -418,13 +439,37 @@ class KnnServer(ThreadingHTTPServer):
             max_wait_ms=max_wait_ms,
             min_bucket=state.min_bucket,
         )
+        # the history ring /debug/history serves and the sampler feeds:
+        # the SLO engine's own ring when one is wired, else the process
+        # default (they coincide for the default engine)
+        from kdtree_tpu.obs import history as obs_history
+
+        self.history = (
+            state.slo_engine.history if state.slo_engine is not None
+            else obs_history.get_history()
+        )
+        self._sampler: Optional[obs_history.Sampler] = None
         self._serve_thread: Optional[threading.Thread] = None
 
+    def _slo_tick(self) -> None:
+        eng = self.state.slo_engine
+        if eng is not None:
+            eng.evaluate()  # never raises (sampler-thread contract)
+
     def start(self, warmup: bool = True, warmup_buckets=None) -> None:
-        """Start the batch worker and the accept loop, then (by default)
-        run warmup synchronously — ``/healthz`` answers 503-warming while
-        compiles run, and flips to 200 the moment this returns."""
+        """Start the batch worker, the history sampler (+ SLO evaluation
+        per tick), and the accept loop, then (by default) run warmup
+        synchronously — ``/healthz`` answers 503-warming while compiles
+        run, and flips to 200 the moment this returns."""
+        from kdtree_tpu.obs import history as obs_history
+
         self.batcher.start()
+        self._sampler = obs_history.Sampler(
+            period_s=self.state.history_period_s,
+            history=self.history,
+            on_sample=self._slo_tick,
+        )
+        self._sampler.start()
         self._serve_thread = threading.Thread(
             target=self.serve_forever, name="kdtree-serve-accept"
         )
@@ -439,6 +484,9 @@ class KnnServer(ThreadingHTTPServer):
         if self._serve_thread is not None:
             self._serve_thread.join()
             self._serve_thread = None
+        if self._sampler is not None:
+            self._sampler.stop()
+            self._sampler = None
         self.batcher.stop()  # closes admission, drains, fulfills futures
         self.server_close()  # joins in-flight handler threads
         obs.flush()

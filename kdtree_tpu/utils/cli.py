@@ -907,6 +907,12 @@ def cmd_serve(args) -> None:
     if flight.install_signal_handler():
         print("flight recorder armed: kill -USR2 this pid dumps the "
               "recent-event ring", file=sys.stderr)
+    from kdtree_tpu.obs import history as obs_history
+
+    print(f"slo engine armed: {len(state.slo_engine.specs)} SLOs over a "
+          f"{obs_history.default_period():g}s-period metric-history ring "
+          "(GET /debug/history; burn-rate verdicts in /healthz and "
+          "kdtree_slo_* on /metrics)", file=sys.stderr)
     print(f"kdtree-tpu serve: binding http://{host}:{port} "
           f"(n={state.engine.tree.n_real}, dim={state.engine.tree.dim}, "
           f"k<={state.engine.k}); warming up...", file=sys.stderr)
@@ -1081,6 +1087,51 @@ def cmd_lint(args) -> None:
             f"{len(new)} new finding(s): fix them, suppress inline with a "
             "reason (# kdt-lint: disable=KDTxxx <why>), or grandfather "
             f"with --update-baseline (see docs/STATIC_ANALYSIS.md)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
+def cmd_trend(args) -> None:
+    """Bench-trend sentinel (docs/OBSERVABILITY.md "Trend"): scan a
+    chronological series of bench artifacts (driver BENCH_r*.json,
+    headline lines, telemetry sidecars) for platform fallbacks,
+    beyond-the-noise-band throughput drops, and recompile growth —
+    grandfathered by a committed baseline exactly like the linter, so
+    CI fails only on NEW regressions."""
+    from kdtree_tpu.obs import trend as tr
+
+    runs = []
+    for p in args.reports:
+        try:
+            runs.append(tr.load_run(p))
+        except (OSError, ValueError) as e:
+            print(f"cannot read bench report {p}: {e}", file=sys.stderr)
+            sys.exit(2)
+    if len(runs) < 2:
+        print("trend needs >= 2 reports in chronological order (oldest "
+              "first) — one run has no trend", file=sys.stderr)
+        sys.exit(2)
+    findings, band = tr.analyze(runs, band=args.band)
+    if args.update_baseline:
+        count = tr.save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) ({count} fingerprint(s)) "
+              f"to {args.baseline}")
+        return
+    try:
+        base = tr.load_baseline(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"cannot read trend baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        sys.exit(2)
+    new = tr.partition(findings, base)
+    render = tr.render_json if args.format == "json" else tr.render_human
+    sys.stdout.write(render(runs, findings, new, band))
+    if new:
+        print(
+            f"{len(new)} new trend regression(s): fix the regression, or "
+            "grandfather a known-degraded trajectory with "
+            "--update-baseline (see docs/OBSERVABILITY.md)",
             file=sys.stderr,
         )
         sys.exit(1)
@@ -1371,6 +1422,32 @@ def main(argv=None) -> None:
                          "(tile, cmax) launch grid)")
     tu.set_defaults(fn=cmd_tune)
 
+    tr = sub.add_parser(
+        "trend",
+        help="bench-trend sentinel: flag platform fallbacks, throughput "
+             "drops beyond the noise band, and recompile growth across "
+             "a series of bench artifacts (docs/OBSERVABILITY.md)",
+    )
+    tr.add_argument("reports", nargs="+", metavar="REPORT.json",
+                    help="bench artifacts in chronological order, oldest "
+                         "first: driver BENCH_r*.json, raw headline JSON, "
+                         "or bench telemetry sidecars")
+    tr.add_argument("--band", type=float, default=None, metavar="FRAC",
+                    help="relative drop treated as a regression (default: "
+                         "fitted from --pair sidecar spread when present, "
+                         "else 0.5 — container noise is +-40%%)")
+    tr.add_argument("--baseline", default="trend_baseline.json",
+                    metavar="PATH",
+                    help="committed grandfather file; only findings NOT "
+                         "in it fail the run")
+    tr.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(grandfather a known-degraded trajectory) and "
+                         "exit 0")
+    tr.add_argument("--format", choices=["human", "json"], default="human",
+                    help="json is the machine report CI uploads")
+    tr.set_defaults(fn=cmd_trend)
+
     li = sub.add_parser(
         "lint",
         help="project-invariant AST linter (docs/STATIC_ANALYSIS.md): "
@@ -1399,10 +1476,11 @@ def main(argv=None) -> None:
         # Usage parity with Utility.cpp:109-112
         print(f"Usage: {p.prog} harness SEED DIM_POINTS  NUM_POINTS", file=sys.stderr)
         sys.exit(1)
-    if args.cmd == "lint":
-        # pure-AST path: dispatch before the engine-error plumbing below.
-        # (The kdtree_tpu package import itself still pulls in jax — the
-        # ANALYSIS code is stdlib-only, the entry point is not.)
+    if args.cmd in ("lint", "trend"):
+        # pure-stdlib paths: dispatch before the engine-error plumbing
+        # below. (The kdtree_tpu package import itself still pulls in
+        # jax — the ANALYSIS/trend code is stdlib-only, the entry point
+        # is not.)
         args.fn(args)
         return
     metrics_out = getattr(args, "metrics_out", None)

@@ -540,3 +540,57 @@ def test_kdt105_suppressible_with_reason(tmp_path):
     ))
     assert rules_of(res) == []
     assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# KDT106 dynamic-slo-name
+# ---------------------------------------------------------------------------
+
+
+def test_kdt106_flags_fstring_slospec_name(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu.obs.slo import SloSpec\n"
+        "def per_shard(shard):\n"
+        "    return SloSpec(f'shard-{shard}-p99', objective='o',\n"
+        "                   target=0.99, kind='ratio')\n"
+    ))
+    assert rules_of(res) == ["KDT106"]
+    assert "spec name" in res.findings[0].message
+
+
+def test_kdt106_flags_concat_name_kwarg_and_history_mark(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu.obs.slo import SloSpec\n"
+        "def build(suffix, ring):\n"
+        "    s = SloSpec(name='slo-' + suffix, objective='o',\n"
+        "                target=0.9, kind='ratio')\n"
+        "    ring.mark('page-{}'.format(suffix))\n"
+        "    return s\n"
+    ))
+    assert rules_of(res) == ["KDT106", "KDT106"]
+    assert "mark() series name" in res.findings[1].message
+
+
+def test_kdt106_clean_for_static_and_enum_names(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu.obs.slo import SloSpec\n"
+        "def build(ring, detector):\n"
+        "    specs = [SloSpec(name=n, objective='o', target=0.99,\n"
+        "                     kind='ratio')\n"
+        "             for n in ('shed-rate', 'error-rate')]\n"
+        "    ring.mark('slo_page')\n"
+        "    detector.mark()  # BurstDetector.mark(): no name, no series\n"
+        "    return specs\n"
+    ))
+    assert rules_of(res) == []
+
+
+def test_kdt106_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "from kdtree_tpu.obs.slo import SloSpec\n"
+        "def mk(i):\n"
+        "    return SloSpec(f'fixture-{i}', objective='o', kind='ratio')  "
+        "# kdt-lint: disable=KDT106 bounded by the test parametrization\n"
+    ))
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1

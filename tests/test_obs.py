@@ -430,6 +430,62 @@ def test_render_report_diff_spans_counters_deltas():
     assert "kdtree_tile_prune_rate" in text  # gauge moved
 
 
+def test_render_report_diff_warns_on_pass_count_mismatch():
+    """The pair-vs-single footgun (bench.py --pair): a 2-pass sidecar's
+    spans/counters aggregate BOTH passes, so diffing it against a
+    single-pass report silently reads as a ~2x regression. The diff must
+    warn loudly instead of comparing quietly."""
+    single = {"platform": "cpu", "counters": {}, "spans": {}}
+    paired = {"platform": "cpu", "passes": 2, "counters": {}, "spans": {}}
+    text = export.render_report_diff(single, paired)
+    assert "WARNING" in text and "pass-count mismatch" in text
+    assert "1 timed pass(es), NEW 2" in text
+    # matching pass counts (both defaulting to 1, or both explicit) stay
+    # quiet — the warning is for the footgun, not for every diff
+    assert "WARNING" not in export.render_report_diff(single, dict(single))
+    assert "WARNING" not in export.render_report_diff(
+        dict(paired), dict(paired))
+
+
+def test_metric_help_covers_every_registered_family():
+    """Satellite gate (ISSUE 8): every metric family registered anywhere
+    in kdtree_tpu/ must have a METRIC_HELP entry — the catalog used to
+    drift by convention. Scans the package AST for literal name args to
+    counter()/gauge()/histogram() calls."""
+    import ast
+    import pathlib
+
+    import kdtree_tpu
+
+    root = pathlib.Path(kdtree_tpu.__file__).parent
+    registered = {}
+    for py in sorted(root.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            leaf = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else "")
+            if leaf not in ("counter", "gauge", "histogram"):
+                continue
+            name_arg = node.args[0] if node.args else None
+            if name_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+            if isinstance(name_arg, ast.Constant) and \
+                    isinstance(name_arg.value, str):
+                registered.setdefault(name_arg.value, f"{py}:{node.lineno}")
+    assert registered, "the scan found no registrations — scanner broken?"
+    missing = {n: at for n, at in registered.items()
+               if n not in export.METRIC_HELP}
+    assert not missing, (
+        f"metric families without a METRIC_HELP entry in obs/export.py: "
+        f"{missing}"
+    )
+
+
 def test_cli_stats_diff_roundtrip(tmp_path, capsys):
     """`kdtree-tpu stats --diff OLD NEW` over two real --metrics-out
     reports, plus the arity validation."""

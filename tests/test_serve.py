@@ -134,6 +134,23 @@ def test_healthz_reports_ready_and_shape(server):
     facts = json.loads(body)
     assert facts["status"] == "ok"
     assert facts["n"] == N and facts["dim"] == DIM and facts["k_max"] == K
+    # the SLO verdict block (docs/SERVING.md): present alongside (never
+    # instead of) readiness, default specs wired by build_state
+    assert facts["slo"]["state"] in ("OK", "WARN", "PAGE")
+    assert "shed-rate" in facts["slo"]["slos"]
+
+
+def test_debug_history_serves_sampled_ring(server):
+    # the sampler starts with KnnServer.start() and takes an immediate
+    # first sample, so the ring is non-empty as soon as serving is up
+    status, body = _get(server, "/debug/history")
+    assert status == 200
+    rep = json.loads(body)
+    assert rep["history_version"] == 1
+    assert rep["samples"] >= 1
+    assert rep["events"][-1]["counters"] is not None
+    status, body = _get(server, "/debug/history?limit=1")
+    assert len(json.loads(body)["events"]) == 1
 
 
 def test_unknown_paths_404(server):

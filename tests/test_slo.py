@@ -1,0 +1,339 @@
+"""SLO burn-rate engine (obs/slo.py): spec evaluation per kind,
+multi-window PAGE/WARN/OK logic, gauge export, transition side effects
+(flight + history dumps), the /healthz "slo" block — and the acceptance
+end-to-end: a live serve process under sustained latency/shed load pages
+itself, dumps a flight ring naming the burning SLO, and recovers."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kdtree_tpu.obs import history as hist
+from kdtree_tpu.obs import slo
+from kdtree_tpu.obs.registry import MetricsRegistry
+
+FAST = slo.BurnWindow(long_s=10.0, short_s=2.0, max_burn=2.0)
+SLOW = slo.BurnWindow(long_s=20.0, short_s=5.0, max_burn=1.0)
+
+
+def _ratio_spec(**kw):
+    base = dict(
+        name="shed-rate", objective="t", target=0.99, kind="ratio",
+        bad=('t_total{status="shed"}',), total="t_total",
+        fast=FAST, slow=SLOW,
+    )
+    base.update(kw)
+    return slo.SloSpec(**base)
+
+
+def _ring(reg, shed_points):
+    """A history ring where each (ts, ok, shed) point appends a sample
+    after advancing the counters to those totals."""
+    h = hist.MetricHistory(capacity=64)
+    ok_c = reg.counter("t_total", labels={"status": "ok"})
+    shed_c = reg.counter("t_total", labels={"status": "shed"})
+    for ts, ok_tot, shed_tot in shed_points:
+        ok_c.inc(ok_tot - ok_c.value)
+        shed_c.inc(shed_tot - shed_c.value)
+        h.record(reg.snapshot(), ts=ts)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# bad_fraction per kind
+# ---------------------------------------------------------------------------
+
+
+def test_ratio_bad_fraction_and_no_traffic_is_no_data():
+    reg = MetricsRegistry()
+    h = _ring(reg, [(100.0, 0, 0), (105.0, 80, 20)])
+    spec = _ratio_spec()
+    assert slo.bad_fraction(spec, h, 10, now=105.0) == pytest.approx(0.2)
+    # zero traffic in the window -> None (an idle server is not burning)
+    h2 = _ring(MetricsRegistry(), [(100.0, 5, 5), (105.0, 5, 5)])
+    assert slo.bad_fraction(_ratio_spec(), h2, 4, now=105.0) is None
+
+
+def test_latency_bad_fraction_from_histogram_window():
+    reg = MetricsRegistry()
+    lat = reg.histogram("lat_seconds", buckets=(0.1, 0.25, 0.5),
+                        labels={"phase": "total"})
+    h = hist.MetricHistory(capacity=8)
+    h.record(reg.snapshot(), ts=100.0)
+    for _ in range(95):
+        lat.observe(0.05)
+    for _ in range(5):
+        lat.observe(0.4)
+    h.record(reg.snapshot(), ts=101.0)
+    spec = slo.SloSpec(name="p99", objective="t", target=0.99,
+                       kind="latency", hist='lat_seconds{phase="total"}',
+                       threshold=0.25, fast=FAST, slow=SLOW)
+    assert slo.bad_fraction(spec, h, 10, now=101.0) == pytest.approx(0.05)
+
+
+def test_gauge_min_bad_fraction_and_absent_gauge():
+    reg = MetricsRegistry()
+    h = hist.MetricHistory(capacity=8)
+    g = reg.gauge("busy_frac")
+    for i, v in enumerate((0.9, 0.3, 0.2, 0.95)):
+        g.set(v)
+        h.record(reg.snapshot(), ts=100.0 + i)
+    spec = slo.SloSpec(name="device-busy", objective="t", target=0.9,
+                       kind="gauge_min", gauge="busy_frac", threshold=0.5,
+                       fast=FAST, slow=SLOW)
+    assert slo.bad_fraction(spec, h, 10, now=103.0) == pytest.approx(0.5)
+    absent = slo.SloSpec(name="device-busy", objective="t", target=0.9,
+                         kind="gauge_min", gauge="never_set", threshold=0.5)
+    assert slo.bad_fraction(absent, h, 10, now=103.0) is None
+
+
+# ---------------------------------------------------------------------------
+# multi-window state machine
+# ---------------------------------------------------------------------------
+
+
+def test_page_requires_both_fast_windows():
+    """A burn confined to history older than the short window must NOT
+    page — the short window is what makes the alert recover fast."""
+    reg = MetricsRegistry()
+    # heavy shedding up to t=104, clean traffic t=104..110
+    h = _ring(reg, [
+        (100.0, 0, 0), (102.0, 50, 50), (104.0, 100, 100),
+        (109.0, 600, 100), (110.0, 700, 100),
+    ])
+    spec = _ratio_spec()
+    eng = slo.SloEngine([spec], history=h, registry=reg)
+    out = eng.evaluate(now=110.0)
+    # long window (10 s) still sees the burn; short window (2 s) is clean
+    assert out["shed-rate"]["burn_fast"] > FAST.max_burn
+    assert out["shed-rate"]["state"] in ("OK", "WARN")
+
+
+def test_sustained_burn_pages_and_sets_gauges(tmp_path, monkeypatch):
+    from kdtree_tpu.obs import flight
+
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    # the process recorder rate-limits per reason across tests
+    flight.recorder()._last_dump.pop("slo-shed-rate", None)
+    reg = MetricsRegistry()
+    h = _ring(reg, [
+        (100.0, 0, 0), (104.0, 50, 50), (108.0, 100, 100),
+        (109.5, 110, 110), (110.0, 115, 115),
+    ])
+    spec = _ratio_spec()
+    eng = slo.SloEngine([spec], history=h, registry=reg)
+    out = eng.evaluate(now=110.0)
+    assert out["shed-rate"]["state"] == "PAGE"
+    g = reg.snapshot()["gauges"]
+    assert g['kdtree_slo_state{slo="shed-rate"}'] == 2.0
+    assert g['kdtree_slo_burn_rate{slo="shed-rate",window="fast"}'] > 2.0
+    c = reg.snapshot()["counters"]
+    assert c['kdtree_slo_transitions_total{slo="shed-rate",to="PAGE"}'] == 1.0
+    # the PAGE transition dumped a flight ring NAMING the burning SLO,
+    # with the history companion alongside it
+    assert (tmp_path / "flight-slo-shed-rate.json").exists()
+    dump = json.loads((tmp_path / "flight-slo-shed-rate.json").read_text())
+    assert dump["reason"] == "slo-shed-rate"
+    assert (tmp_path / "history-slo-shed-rate.json").exists()
+    # history carries the page mark
+    assert eng.history.report()["marks"]["slo_page"]["count"] >= 1.0
+
+
+def test_recovery_transitions_back_to_ok(tmp_path, monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    reg = MetricsRegistry()
+    h = _ring(reg, [
+        (100.0, 0, 0), (104.0, 50, 50), (108.0, 100, 100),
+        (109.5, 110, 110), (110.0, 115, 115),
+    ])
+    eng = slo.SloEngine([_ratio_spec()], history=h, registry=reg)
+    assert eng.evaluate(now=110.0)["shed-rate"]["state"] == "PAGE"
+    # 30 s later every window is empty of bad events -> OK, not sticky
+    ok_c = reg.counter("t_total", labels={"status": "ok"})
+    for ts in (138.0, 139.0, 140.0):
+        ok_c.inc(100)
+        h.record(reg.snapshot(), ts=ts)
+    out = eng.evaluate(now=140.0)
+    assert out["shed-rate"]["state"] == "OK"
+    assert reg.snapshot()["gauges"]['kdtree_slo_state{slo="shed-rate"}'] == 0.0
+
+
+def test_evaluate_never_raises_on_poisoned_history():
+    class Broken:
+        def __getattr__(self, name):
+            raise RuntimeError("poisoned")
+
+    eng = slo.SloEngine([_ratio_spec()], history=Broken(),
+                        registry=MetricsRegistry())
+    assert eng.evaluate(now=1.0) == {}  # swallowed, empty verdict
+
+
+def test_health_block_reports_worst_state():
+    reg = MetricsRegistry()
+    h = _ring(reg, [
+        (100.0, 0, 0), (104.0, 50, 50), (108.0, 100, 100),
+        (109.5, 110, 110), (110.0, 115, 115),
+    ])
+    quiet = slo.SloSpec(name="error-rate", objective="t", target=0.999,
+                        kind="ratio", bad=('t_total{status="error"}',),
+                        total="t_total", fast=FAST, slow=SLOW)
+    eng = slo.SloEngine([_ratio_spec(), quiet], history=h, registry=reg)
+    eng.evaluate(now=110.0)
+    block = eng.health_block()
+    assert block["state"] == "PAGE"
+    assert block["slos"]["shed-rate"]["state"] == "PAGE"
+    assert block["slos"]["error-rate"]["state"] == "OK"
+    assert block["slos"]["error-rate"]["data"] is True
+
+
+def test_default_specs_are_the_documented_five():
+    names = [s.name for s in slo.default_specs()]
+    assert names == ["request-p99-latency", "error-rate", "shed-rate",
+                     "degraded-answers", "device-busy"]
+    # every spec name is a valid metric-label value and every referenced
+    # family is a real registered family (METRIC_HELP is the catalog)
+    from kdtree_tpu.obs.export import METRIC_HELP
+
+    for s in slo.default_specs():
+        for prefix in list(s.bad) + [s.total, s.hist, s.gauge]:
+            if prefix:
+                assert prefix.split("{")[0] in METRIC_HELP, prefix
+
+
+# ---------------------------------------------------------------------------
+# the acceptance end-to-end (ISSUE 8): OK -> PAGE -> OK on a live server
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tree():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+    from kdtree_tpu.ops.morton import build_morton
+
+    return build_morton(generate_points_rowwise(7, 3, 4096))
+
+
+def _get(port, path, timeout=10.0):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def _metrics_gauge(port, line_prefix):
+    _, text = _get(port, "/metrics")
+    for ln in text.splitlines():
+        if ln.startswith(line_prefix):
+            return float(ln.rsplit(" ", 1)[1])
+    return None
+
+
+def test_slo_chain_end_to_end_page_and_recover(tree, tmp_path, monkeypatch):
+    """The full chain on a LIVE serve process: sustained latency+shed
+    load -> shed-rate SLO OK->PAGE visible in /metrics gauges, /healthz
+    "slo" block degrades (readiness stays 200), a flight dump naming the
+    burning SLO lands on disk — then recovery back to OK when the load
+    stops. Windows are test-scale (seconds); the math is identical at
+    the serving-scale defaults."""
+    from kdtree_tpu.obs import flight
+    from kdtree_tpu.serve import lifecycle, server as srv
+
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    # the process recorder rate-limits per reason; an earlier unit test's
+    # PAGE dump within 5 s would otherwise swallow this one
+    flight.recorder()._last_dump.pop("slo-shed-rate", None)
+    ring = hist.MetricHistory(capacity=256)
+    spec = slo.SloSpec(
+        name="shed-rate", objective="99% of requests admitted",
+        target=0.99, kind="ratio",
+        bad=('kdtree_serve_requests_total{status="shed"}',),
+        total="kdtree_serve_requests_total",
+        fast=slo.BurnWindow(long_s=2.0, short_s=0.5, max_burn=2.0),
+        slow=slo.BurnWindow(long_s=3.0, short_s=1.0, max_burn=1.0),
+    )
+    eng = slo.SloEngine([spec], history=ring)
+    state = lifecycle.build_state(tree=tree, k=4, max_batch=64,
+                                  slo_engine=eng, history_period_s=0.05)
+    # inject sustained latency: every batch dispatch takes ~25 ms, so a
+    # handful of concurrent clients overwhelm the tiny admission budget
+    orig = state.engine.knn_batch
+
+    def slow_batch(q):
+        time.sleep(0.025)
+        return orig(q)
+
+    state.engine.knn_batch = slow_batch
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0, queue_rows=8)
+    httpd.start(warmup_buckets=[8])
+    port = httpd.server_address[1]
+    stop_load = threading.Event()
+
+    def client():
+        body = json.dumps(
+            {"queries": np.full((4, 3), 1.0).tolist(), "k": 2}
+        ).encode()
+        while not stop_load.is_set():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/knn", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30).read()
+            except urllib.error.HTTPError as e:
+                e.read()  # 429s are the point
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        # --- OK -> PAGE under sustained shed load -----------------------
+        deadline = time.monotonic() + 20.0
+        paged = False
+        while time.monotonic() < deadline:
+            v = _metrics_gauge(port, 'kdtree_slo_state{slo="shed-rate"}')
+            if v == 2.0:
+                paged = True
+                break
+            time.sleep(0.1)
+        assert paged, "shed-rate SLO never paged under sustained load"
+        status, body = _get(port, "/healthz")
+        hz = json.loads(body)
+        assert status == 200  # readiness STAYS; the slo block degrades
+        assert hz["slo"]["state"] == "PAGE"
+        assert hz["slo"]["slos"]["shed-rate"]["state"] == "PAGE"
+        dump_path = tmp_path / "flight-slo-shed-rate.json"
+        assert dump_path.exists(), "no flight dump naming the burning SLO"
+        dump = json.loads(dump_path.read_text())
+        assert dump["reason"] == "slo-shed-rate"
+        assert (tmp_path / "history-slo-shed-rate.json").exists()
+    finally:
+        stop_load.set()
+        for t in threads:
+            t.join()
+    # --- recovery back to OK once the load stops ------------------------
+    deadline = time.monotonic() + 20.0
+    recovered = False
+    while time.monotonic() < deadline:
+        if _metrics_gauge(port, 'kdtree_slo_state{slo="shed-rate"}') == 0.0:
+            recovered = True
+            break
+        time.sleep(0.2)
+    try:
+        assert recovered, "shed-rate SLO never recovered after load stopped"
+        hz = json.loads(_get(port, "/healthz")[1])
+        assert hz["slo"]["slos"]["shed-rate"]["state"] == "OK"
+        # /debug/history served the ring the engine evaluated against
+        dh = json.loads(_get(port, "/debug/history")[1])
+        assert dh["history_version"] == 1 and dh["samples"] >= 1
+        assert dh["events"][-1]["counters"], "samples carry counter data"
+        limited = json.loads(_get(port, "/debug/history?limit=2")[1])
+        assert len(limited["events"]) <= 2
+    finally:
+        httpd.stop()

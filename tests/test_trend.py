@@ -1,0 +1,227 @@
+"""Bench-trend sentinel (obs/trend.py + `kdtree-tpu trend`): artifact
+parsing across all three input shapes, the regression rules, the
+pair-fitted noise band, baseline grandfathering, and the acceptance pin:
+the committed BENCH_r01–r05 series flags the r02→r03 platform fallback
+AND the throughput cliff — the regression this repo actually shipped."""
+
+import json
+import pathlib
+
+import pytest
+
+from kdtree_tpu.obs import trend as tr
+from kdtree_tpu.utils import cli
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BENCH_SERIES = [str(REPO / f"BENCH_r0{i}.json") for i in range(1, 6)]
+
+
+def _headline(value, platform="cpu", extra=None, **kw):
+    h = {
+        "metric": f"k-d tree gen+build+10xNN points/sec (1M x 3D, {platform})",
+        "value": value, "unit": "pts/s", "vs_baseline": 1.0,
+        "extra_metrics": extra or [],
+    }
+    h.update(kw)
+    return h
+
+
+def _qps(value, platform="cpu", **kw):
+    m = {
+        "metric": f"k-NN queries/sec (Q=16384, k=16, 1M x 3D tree, tiled, "
+                  f"{platform})",
+        "value": value, "unit": "q/s", "vs_baseline": None,
+    }
+    m.update(kw)
+    return m
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: the repo's own shipped regression
+# ---------------------------------------------------------------------------
+
+
+def test_committed_series_flags_r03_fallback_and_cliff():
+    runs = [tr.load_run(p) for p in BENCH_SERIES]
+    findings, band = tr.analyze(runs)
+    fps = sorted(f["fingerprint"] for f in findings)
+    assert fps == [
+        "platform-fallback|platform|r02->r03",
+        "throughput-drop|headline|r02->r03",
+    ], fps
+    # the r03..r05 CPU plateau (values mildly GROWING) is clean — the
+    # sentinel flags the cliff, not the noise
+    assert not any(f["to"] in ("r04", "r05") for f in findings)
+    assert band == tr.DEFAULT_BAND
+
+
+def test_committed_series_is_baseline_clean():
+    """The committed trend_baseline.json grandfathers exactly the known
+    regression — the CI gate passes on the committed history."""
+    runs = [tr.load_run(p) for p in BENCH_SERIES]
+    findings, _ = tr.analyze(runs)
+    base = tr.load_baseline(str(REPO / "trend_baseline.json"))
+    assert tr.partition(findings, base) == []
+
+
+# ---------------------------------------------------------------------------
+# parsing the three artifact shapes
+# ---------------------------------------------------------------------------
+
+
+def test_load_driver_wrapper_labels_by_round():
+    run = tr.load_run(BENCH_SERIES[2])
+    assert run["label"] == "r03"
+    assert run["platform"] == "cpu"
+    assert run["metrics"][tr.HEADLINE_KEY]["value"] == 1258883.0
+    key = "k-NN queries/sec (Q=16384, k=16, 1M x 3D tree, tiled)"
+    assert run["metrics"][key]["value"] == 1224.0
+
+
+def test_load_raw_headline_and_sidecar(tmp_path):
+    raw = _write(tmp_path, "raw.json", _headline(1000.0))
+    run = tr.load_run(raw)
+    assert run["label"] == "raw" and run["platform"] == "cpu"
+
+    sidecar = _write(tmp_path, "bench_telemetry.json", {
+        "report_version": 1, "counters": {}, "gauges": {},
+        "platform": "cpu", "degraded": False, "passes": 2,
+        "headline": _headline(900.0, extra=[_qps(1200.0)]),
+        "pair_first": _headline(1000.0, extra=[_qps(1300.0)]),
+    })
+    run = tr.load_run(sidecar)
+    assert run["passes"] == 2
+    assert run["pair_spread"] == pytest.approx(0.105, abs=0.01)
+    assert "k-NN queries/sec (Q=16384, k=16, 1M x 3D tree, tiled)" in \
+        run["metrics"]
+
+
+def test_load_rejects_non_bench_json(tmp_path):
+    p = _write(tmp_path, "nope.json", {"hello": 1})
+    with pytest.raises(ValueError):
+        tr.load_run(p)
+
+
+def test_normalize_strips_only_platform_tokens():
+    n = tr.normalize_metric
+    assert n("k-NN queries/sec (Q=16384, k=16, 1M x 3D tree, tiled, cpu)") \
+        == n("k-NN queries/sec (Q=16384, k=16, 1M x 3D tree, tiled, tpu)")
+    # shape tokens stay: a different measurement keeps a different key
+    assert n("q/s (Q=16384, cpu)") != n("q/s (Q=1048576, cpu)")
+    assert n("no parens") == "no parens"
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _runs(tmp_path, *headlines):
+    paths = [_write(tmp_path, f"run{i}.json", h)
+             for i, h in enumerate(headlines)]
+    return [tr.load_run(p) for p in paths]
+
+
+def test_throughput_drop_respects_band(tmp_path):
+    runs = _runs(tmp_path, _headline(1000.0), _headline(700.0))
+    assert tr.analyze(runs, band=0.5)[0] == []       # -30% inside band
+    findings, _ = tr.analyze(runs, band=0.2)          # -30% beyond band
+    assert [f["rule"] for f in findings] == ["throughput-drop"]
+
+
+def test_degraded_run_flagged_without_platform_change(tmp_path):
+    runs = _runs(tmp_path, _headline(1000.0),
+                 _headline(990.0, degraded="wedged tunnel"))
+    findings, _ = tr.analyze(runs)
+    assert [f["rule"] for f in findings] == ["degraded-run"]
+    assert "wedged tunnel" in findings[0]["detail"]
+
+
+def test_recompile_growth_flagged(tmp_path):
+    runs = _runs(
+        tmp_path,
+        _headline(1000.0, extra=[_qps(1200.0, recompiles=0)]),
+        _headline(1000.0, extra=[_qps(1190.0, recompiles=3)]),
+    )
+    findings, _ = tr.analyze(runs)
+    assert [f["rule"] for f in findings] == ["recompile-growth"]
+
+
+def test_band_fitted_from_pair_spread(tmp_path):
+    # a 5% same-process spread tightens the band to the 0.2 floor:
+    # a 30% drop is now a finding where the 0.5 default would shrug
+    sidecar = _write(tmp_path, "paired.json", {
+        "report_version": 1, "counters": {}, "platform": "cpu",
+        "passes": 2,
+        "headline": _headline(1000.0),
+        "pair_first": _headline(1050.0),
+    })
+    later = _write(tmp_path, "later.json", _headline(700.0))
+    runs = [tr.load_run(sidecar), tr.load_run(later)]
+    findings, band = tr.analyze(runs)
+    assert band == pytest.approx(0.2)
+    assert [f["rule"] for f in findings] == ["throughput-drop"]
+
+
+# ---------------------------------------------------------------------------
+# baseline lifecycle + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip(tmp_path):
+    runs = [tr.load_run(p) for p in BENCH_SERIES]
+    findings, _ = tr.analyze(runs)
+    path = str(tmp_path / "base.json")
+    assert tr.save_baseline(path, findings) == 2
+    base = tr.load_baseline(path)
+    assert tr.partition(findings, base) == []
+    assert tr.load_baseline(str(tmp_path / "missing.json")) == set()
+    (tmp_path / "corrupt.json").write_text('{"nope": 1}')
+    with pytest.raises(ValueError):
+        tr.load_baseline(str(tmp_path / "corrupt.json"))
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    # new findings, empty baseline -> exit 1, json report names them
+    empty = str(tmp_path / "empty_base.json")
+    with pytest.raises(SystemExit) as e:
+        cli.main(["trend", *BENCH_SERIES, "--baseline", empty,
+                  "--format", "json"])
+    assert e.value.code == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["new_count"] == 2
+    assert {f["rule"] for f in rep["findings"]} == \
+        {"platform-fallback", "throughput-drop"}
+    assert all(f["new"] for f in rep["findings"])
+
+    # grandfathered via the committed baseline -> exit 0 (clean return)
+    cli.main(["trend", *BENCH_SERIES,
+              "--baseline", str(REPO / "trend_baseline.json")])
+    out = capsys.readouterr().out
+    assert "[base]" in out and "[NEW]" not in out
+
+    # one report is not a trend -> usage error 2
+    with pytest.raises(SystemExit) as e:
+        cli.main(["trend", BENCH_SERIES[0]])
+    assert e.value.code == 2
+
+    # unreadable input -> 2
+    with pytest.raises(SystemExit) as e:
+        cli.main(["trend", BENCH_SERIES[0], str(tmp_path / "nothere.json")])
+    assert e.value.code == 2
+
+
+def test_cli_update_baseline_roundtrip(tmp_path, capsys):
+    path = str(tmp_path / "tb.json")
+    cli.main(["trend", *BENCH_SERIES, "--baseline", path,
+              "--update-baseline"])
+    assert "2 finding(s)" in capsys.readouterr().out
+    # with the fresh baseline the same series gates clean
+    cli.main(["trend", *BENCH_SERIES, "--baseline", path])
+    assert "0 new" in capsys.readouterr().out
