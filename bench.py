@@ -95,31 +95,75 @@ def _device_probe(timeout_s: float = 600.0) -> float:
     misconfiguration. Generous window: a healthy first init can
     legitimately take minutes.
 
+    Fast init ERRORS retry in-process: a transient tunnel hiccup
+    (connection refused while the proxy restarts) heals in seconds, and
+    retrying is free. ``KDTREE_TPU_DEVICE_INIT_RETRIES`` bounds the
+    extra attempts (default 1; backoff doubles from 0.5 s); every
+    attempt lands in the flight ring with its reason, so a flaky init
+    self-describes in the bench-fail dump. A WEDGE never retries
+    in-process — the hung probe thread holds the backend lock, so only
+    the existing CPU re-exec can make progress.
+
     Returns the measured device-init duration in seconds — the number
     whose absence made BENCH_r05's 600 s wedge + CPU fallback look like a
     healthy TPU run."""
-    result = {}
+    try:
+        retries = max(
+            int(os.environ.get("KDTREE_TPU_DEVICE_INIT_RETRIES", "1")), 0
+        )
+    except ValueError:
+        retries = 1
 
-    def probe():
-        t0 = time.perf_counter()
+    def record_attempt(attempt, outcome, reason):
         try:
-            devs = jax.devices()
-            # init_s FIRST: the main thread keys on "devices", so writing
-            # it last keeps a join() timeout landing between the two
-            # assignments from seeing devices without its duration
-            result["init_s"] = time.perf_counter() - t0
-            result["devices"] = devs
-        except Exception as e:  # init error ≠ hang, but equally fatal here
-            result["error"] = repr(e)
+            from kdtree_tpu.obs import flight
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" in result:
-        return result["init_s"]
+            flight.record("bench.device_init", attempt=attempt,
+                          outcome=outcome, reason=reason,
+                          retries_allowed=retries)
+        except Exception:
+            pass  # the ring observes the probe; it must not break it
+
+    result = {}
+    for attempt in range(retries + 1):
+        result = {}
+
+        def probe():
+            t0 = time.perf_counter()
+            try:
+                devs = jax.devices()
+                # init_s FIRST: the main thread keys on "devices", so
+                # writing it last keeps a join() timeout landing between
+                # the two assignments from seeing devices without its
+                # duration
+                result["init_s"] = time.perf_counter() - t0
+                result["devices"] = devs
+            except Exception as e:  # init error ≠ hang, equally fatal here
+                result["error"] = repr(e)
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if "devices" in result:
+            record_attempt(attempt, "ok", "")
+            return result["init_s"]
+        if "error" not in result:
+            # wedge: the hung thread holds the backend lock — no retry in
+            # THIS process can initialize any platform; break to fallback
+            record_attempt(attempt, "timeout",
+                           f"no init in {timeout_s:.0f}s")
+            break
+        record_attempt(attempt, "error", result["error"])
+        if attempt < retries:
+            backoff = 0.5 * (2 ** attempt)
+            print(f"bench: device init attempt {attempt + 1} failed "
+                  f"({result['error']}); retrying in {backoff:.1f}s",
+                  file=sys.stderr)
+            time.sleep(backoff)
     if "error" in result:
-        # a fast init ERROR (bad credentials, missing runtime) is a real
-        # misconfiguration — surface it crisply; CPU numbers would mask it
+        # a persistent init ERROR (bad credentials, missing runtime) is a
+        # real misconfiguration — surface it crisply; CPU numbers would
+        # mask it
         _fail(f"device init: {result['error']}", code=2, hard=True)
     msg = (f"device init did not complete in {timeout_s:.0f}s "
            "(wedged tunnel?)")

@@ -90,6 +90,17 @@ R_SLO_NAME = register(Rule(
     "constructor away",
 ))
 
+R_CLIENT_TIMEOUT = register(Rule(
+    "KDT107", "client-without-timeout", CORRECTNESS,
+    "HTTP/socket client calls (urlopen, http.client.HTTP(S)Connection, "
+    "socket.create_connection) must pass an explicit timeout — the "
+    "stdlib default is BLOCK FOREVER",
+    "the scatter/gather router (PR 9) fans every request across N shard "
+    "connections; one call site inheriting the blocking default turns "
+    "one wedged shard into a wedged router — the deadline/hedge/breaker "
+    "machinery all sits downstream of the socket actually timing out",
+))
+
 R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
@@ -492,6 +503,46 @@ def check_nondeterminism(ctx) -> Iterator[Finding]:
                     R_NONDET, ctx, node.value,
                     "time-derived seed argument: the run cannot be replayed",
                 )
+
+
+# --------------------------------------------------------------------------
+# KDT107 — client-without-timeout
+# --------------------------------------------------------------------------
+
+# leaf name -> the 1-based positional slot a timeout may legally occupy
+# (urlopen(url, data, timeout) / create_connection(addr, timeout) /
+# HTTP(S)Connection(host, port, timeout)); a call is clean when it passes
+# timeout= as a kwarg OR fills positionals through that slot
+_CLIENT_TIMEOUT_POS = {
+    "urlopen": 3,
+    "create_connection": 2,
+    "HTTPConnection": 3,
+    "HTTPSConnection": 3,
+}
+
+
+@checker(R_CLIENT_TIMEOUT)
+def check_client_without_timeout(ctx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = call_name(node).split(".")[-1]
+        slot = _CLIENT_TIMEOUT_POS.get(leaf)
+        if slot is None:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args) or \
+                any(kw.arg is None for kw in node.keywords):
+            continue  # *args/**kwargs may carry it; syntactic rule stays quiet
+        if len(node.args) >= slot:
+            continue  # timeout passed positionally
+        yield _mk(
+            R_CLIENT_TIMEOUT, ctx, node,
+            f"{leaf}() without an explicit timeout inherits the stdlib's "
+            "block-forever default; one unreachable peer then wedges this "
+            "thread (and anything joining it) — pass timeout=",
+        )
 
 
 # --------------------------------------------------------------------------

@@ -207,6 +207,28 @@ METRIC_HELP = {
     "kdtree_serve_ready": "1 once the index is loaded and warmup compiled",
     "kdtree_serve_warmup_buckets":
         "pow2 row buckets compiled by the warmup ladder",
+    # routing (docs/SERVING.md "Routing & fault tolerance")
+    "kdtree_router_requests_total":
+        "routed k-NN requests by outcome (ok/partial/unavailable/...)",
+    "kdtree_router_request_seconds":
+        "routed request latency (scatter to merged answer)",
+    "kdtree_router_partial_total":
+        "requests answered from a shard quorum with the partial flag",
+    "kdtree_router_shard_attempts_total":
+        "per-shard attempt outcomes (ok/http_error/shed/network/...)",
+    "kdtree_router_shard_seconds":
+        "per-shard successful-attempt latency (the hedge-delay source)",
+    "kdtree_router_retries_total": "per-shard backed-off retries",
+    "kdtree_router_hedges_total": "hedge attempts fired, by shard",
+    "kdtree_router_hedge_wins_total":
+        "hedge attempts that beat their primary, by shard",
+    "kdtree_router_breaker_state":
+        "per-shard circuit breaker: 0 closed, 1 open, 2 half-open",
+    "kdtree_router_breaker_transitions_total":
+        "circuit-breaker transitions, by shard and destination state",
+    "kdtree_router_shard_healthy":
+        "1 while the shard's /healthz answers 200 without SLO PAGE",
+    "kdtree_router_shards": "shards this router scatters to",
     # SLOs + metric history (docs/OBSERVABILITY.md "SLOs & burn rates")
     "kdtree_slo_state":
         "SLO state by spec: 0 OK, 1 WARN, 2 PAGE (multi-window burn rate)",

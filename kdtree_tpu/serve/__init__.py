@@ -23,7 +23,17 @@ package is that organ (see ``docs/SERVING.md``):
   JAX runtime listeners), the engine facade the batcher dispatches
   through, the brute-force degradation path, and graceful shutdown
   (stop accepting, drain in-flight batches, flush the telemetry
-  sidecar).
+  sidecar);
+- :mod:`~kdtree_tpu.serve.router` — fault-tolerant scatter/gather over
+  N per-shard serve processes (``kdtree-tpu route``): per-shard
+  deadlines, bounded retry with jittered backoff, p95-based hedging,
+  circuit breakers, health ejection, and exact partial-result
+  degradation — the reference's L1 MPI data-parallel layer re-expressed
+  at serving time;
+- :mod:`~kdtree_tpu.serve.faults` — deterministic fault injection
+  (``KDTREE_TPU_FAULTS`` / ``POST /debug/faults``): latency, error,
+  hang, and connection-drop faults at named sites, so every router
+  behavior above lands with a repeatable CPU test.
 
 Design rule inherited from the rest of the codebase: exactness is never
 load-dependent. Shedding and deadline degradation change *latency* and
@@ -40,18 +50,25 @@ from kdtree_tpu.serve.admission import (
     QueueFullError,
 )
 from kdtree_tpu.serve.batcher import MicroBatcher
+from kdtree_tpu.serve.faults import FaultSet, FaultSpecError
 from kdtree_tpu.serve.lifecycle import ServeEngine, ServeState, build_state
+from kdtree_tpu.serve.router import Router, RouterConfig, make_router
 from kdtree_tpu.serve.server import KnnServer, make_server
 
 __all__ = [
     "AdmissionQueue",
+    "FaultSet",
+    "FaultSpecError",
     "KnnServer",
     "MicroBatcher",
     "PendingRequest",
     "QueueClosedError",
     "QueueFullError",
+    "Router",
+    "RouterConfig",
     "ServeEngine",
     "ServeState",
     "build_state",
+    "make_router",
     "make_server",
 ]

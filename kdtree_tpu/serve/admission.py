@@ -34,6 +34,13 @@ from kdtree_tpu.obs import flight
 SHED_BURST_THRESHOLD = 10
 SHED_BURST_WINDOW_S = 1.0
 
+# Retry-After derivation (docs/SERVING.md): the drain-rate estimate
+# averages over this many recent worker pops, and the advised wait is
+# clamped so a stalled worker advises "a while", never "an hour"
+_DRAIN_SAMPLES = 64
+RETRY_AFTER_MIN_S = 1.0
+RETRY_AFTER_MAX_S = 30.0
+
 
 class QueueFullError(Exception):
     """Admission refused: queue depth at capacity (HTTP 429)."""
@@ -111,6 +118,9 @@ class AdmissionQueue:
         self._rows = 0
         self._cond = threading.Condition()
         self._closed = False
+        # recent worker pops as (monotonic time, rows): the measured
+        # drain rate behind the 429 Retry-After header
+        self._pops: deque = deque(maxlen=_DRAIN_SAMPLES)
         reg = obs.get_registry()
         self._depth = reg.gauge("kdtree_serve_queue_depth")
         self._shed = reg.counter("kdtree_serve_shed_total")
@@ -180,6 +190,42 @@ class AdmissionQueue:
             self._depth.set(self._rows)
             self._cond.notify_all()
 
+    def _note_pop(self, rows: int, now: Optional[float] = None) -> None:
+        """Record one worker pop for the drain-rate estimate (caller
+        holds the lock)."""
+        self._pops.append(
+            (now if now is not None else time.monotonic(), rows)
+        )
+
+    def drain_rate(self, now: Optional[float] = None) -> float:
+        """Measured drain rate in rows/second over the recent pops;
+        0.0 when there is not enough history to estimate."""
+        with self._cond:
+            pops = list(self._pops)
+        if len(pops) < 2:
+            return 0.0
+        now = now if now is not None else time.monotonic()
+        span = now - pops[0][0]
+        if span <= 0:
+            return 0.0
+        return sum(r for _, r in pops) / span
+
+    def retry_after_s(self, rows: int, now: Optional[float] = None) -> float:
+        """How long a just-shed ``rows``-row request should wait before
+        retrying: the time the measured drain rate needs to free enough
+        budget, clamped to [RETRY_AFTER_MIN_S, RETRY_AFTER_MAX_S]. With
+        no drain history (cold start, stalled worker) the floor applies —
+        an honest "soon, probably" beats a made-up number."""
+        with self._cond:
+            depth = self._rows
+        excess = depth + min(int(rows), self.max_rows) - self.max_rows
+        if excess <= 0:
+            return RETRY_AFTER_MIN_S
+        rate = self.drain_rate(now)
+        if rate <= 0:
+            return RETRY_AFTER_MIN_S
+        return min(max(excess / rate, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
+
     def pop(self) -> Optional[PendingRequest]:
         """Immediately pop the oldest request, or None when empty."""
         with self._cond:
@@ -188,6 +234,7 @@ class AdmissionQueue:
             req = self._items.popleft()
             self._rows -= req.rows
             self._depth.set(self._rows)
+            self._note_pop(req.rows)
             return req
 
     def pop_wait(self, timeout: float) -> Optional[PendingRequest]:
@@ -203,6 +250,7 @@ class AdmissionQueue:
             req = self._items.popleft()
             self._rows -= req.rows
             self._depth.set(self._rows)
+            self._note_pop(req.rows)
             return req
 
     def push_front(self, req: PendingRequest) -> None:

@@ -112,12 +112,19 @@ class ServeState:
         meta: Optional[dict] = None,
         slo_engine=None,
         history_period_s: Optional[float] = None,
+        id_offset: int = 0,
     ) -> None:
         self.engine = engine
         self.max_batch = max_batch
         self.min_bucket = min_bucket
         self.request_timeout_s = request_timeout_s
         self.meta = dict(meta or {})
+        # sharded serving (docs/SERVING.md "Routing"): this process holds
+        # rows [id_offset, id_offset + n) of a larger partitioned point
+        # set, and answers GLOBAL ids — the offset is added at the
+        # response boundary (padding ids stay -1), so a router's merged
+        # answer is byte-identical to the single-index oracle
+        self.id_offset = int(id_offset)
         # SLO engine + history-sampler period (obs/slo.py, obs/history.py):
         # the server starts a sampler at this period and evaluates the
         # engine on every tick; /healthz reports its verdict in an "slo"
@@ -207,6 +214,7 @@ def build_state(
     install_listeners: bool = True,
     slo_engine=None,
     history_period_s: Optional[float] = None,
+    id_offset: int = 0,
 ) -> ServeState:
     """Assemble a ready-to-warmup :class:`ServeState` from exactly one
     index source: a loaded ``tree``, a materialized ``points`` array, or
@@ -248,4 +256,5 @@ def build_state(
         meta=meta,
         slo_engine=slo_engine,
         history_period_s=history_period_s,
+        id_offset=id_offset,
     )

@@ -1,0 +1,975 @@
+"""Fault-tolerant scatter/gather routing over per-shard serve processes.
+
+The L1 layer of the reference is MPI data parallelism: every rank holds
+a shard of the point set, every rank answers every query over its shard,
+and the per-rank top-k buffers merge by distance. This module is that
+layer re-expressed at serving time (ROADMAP direction 1): N independent
+``kdtree-tpu serve`` processes — each micro-batched, warm-planned, and
+SLO-instrumented — behind one thin router that fans each ``POST
+/v1/knn`` out and merges the per-shard top-k with the *same*
+(distance, id) tie-break the SPMD forest query uses on-device
+(``parallel/global_morton._merge_partials``). With every shard healthy
+the routed answer is byte-identical to the single-index oracle; the
+router adds horizontal scale, never approximation.
+
+A fan-out service is only as available as its flakiest shard, so the
+router is mostly a fault-tolerance kit (docs/SERVING.md "Routing &
+fault tolerance"):
+
+- **deadlines**: every scatter has an absolute budget; a shard that
+  cannot answer inside it is *missing*, not *blocking*;
+- **bounded retry** with jittered exponential backoff (deterministically
+  seeded per (trace, shard) — a retry storm must be replayable);
+- **hedging**: if a shard's attempt outlives its own p95, a second
+  identical attempt fires and the first answer wins (the loser's
+  connection is closed) — the tail-latency trade from the hedged-request
+  literature, bounded to one hedge per attempt;
+- **circuit breakers** per shard: closed → open after consecutive
+  failures → half-open single probe after a cooldown → closed on
+  success. An open breaker converts a known-bad shard's cost from
+  "timeout per request" to "skip";
+- **health ejection**: a background loop polls each shard's ``/healthz``
+  and ejects shards that are unreachable, warming, or PAGE-burning their
+  SLOs (a burning replica asked for traffic to be routed away);
+- **partial results**: when at least ``quorum`` shards answered, the
+  merged (still exact *per answered shard*) result returns 200 with
+  ``degraded: "partial:k/N"`` and the missing shard indices — a k-NN
+  answer over most of the index beats a 5xx for nearly every caller.
+  Below quorum the router answers a crisp 503. Never a silent wrong
+  answer: anything less than all-shards carries the degraded flag.
+
+The router holds no index, no jax, and no queue — shards shed (429 +
+``Retry-After``, which the backoff honors) and the router propagates
+pressure instead of buffering it.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import List, Optional, Tuple
+from urllib.parse import urlparse
+
+from kdtree_tpu import obs
+from kdtree_tpu.obs import flight
+from kdtree_tpu.serve.server import (
+    GracefulHTTPServer,
+    JsonRequestHandler,
+    _trace_id,
+)
+
+DEFAULT_DEADLINE_S = 2.0
+DEFAULT_RETRIES = 2          # attempts per shard = retries + 1
+DEFAULT_BACKOFF_BASE_S = 0.025
+DEFAULT_BACKOFF_MAX_S = 0.5
+DEFAULT_HEDGE_MIN_S = 0.05   # hedge-delay floor (and cold-start default)
+DEFAULT_BREAKER_FAILURES = 3
+DEFAULT_BREAKER_RESET_S = 2.0
+DEFAULT_HEALTH_PERIOD_S = 1.0
+MAX_BODY_BYTES = 64 << 20
+_LAT_SAMPLES = 64            # per-shard latency window for the p95 hedge
+
+_ROUTER_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+# breaker states, exported as the kdtree_router_breaker_state gauge
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+BREAKER_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half-open"}
+
+
+class ShardError(Exception):
+    """One failed shard attempt; ``retryable`` decides whether the retry
+    loop may try again (4xx validation errors must not be retried — the
+    request itself is wrong)."""
+
+    def __init__(self, message: str, outcome: str, retryable: bool = True,
+                 status: Optional[int] = None, body: Optional[dict] = None,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(message)
+        self.outcome = outcome  # bounded enum: see _OUTCOMES
+        self.retryable = retryable
+        self.status = status
+        self.body = body
+        self.retry_after_s = retry_after_s
+
+
+_OUTCOMES = ("ok", "http_error", "shed", "network", "timeout",
+             "breaker_open", "client_error")
+
+
+class CircuitBreaker:
+    """Per-shard closed → open → half-open machine.
+
+    Counts *consecutive* failures (a hedge pair counts once): at
+    ``failures`` the breaker opens and every ``allow()`` is refused for
+    ``reset_s``; then exactly one probe request passes (half-open) — its
+    success closes the breaker, its failure re-opens it for another
+    cooldown. Thread-safe; transitions are reported through
+    ``on_transition(old, new)`` so the router can export gauges and
+    flight events without the breaker knowing about either.
+    """
+
+    def __init__(self, failures: int = DEFAULT_BREAKER_FAILURES,
+                 reset_s: float = DEFAULT_BREAKER_RESET_S,
+                 on_transition=None) -> None:
+        if failures < 1:
+            raise ValueError(f"breaker failures must be >= 1, got {failures}")
+        self.failures = int(failures)
+        self.reset_s = float(reset_s)
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def _transition(self, new: int) -> Optional[Tuple[int, int]]:
+        """State change under the lock; returns the (old, new) pair for
+        the caller to REPORT AFTER RELEASING the lock — the reporter
+        writes gauges and (on open) dumps the flight ring to disk, and
+        a file write inside this lock would stall every concurrent
+        allow() for its duration."""
+        old, self._state = self._state, new
+        return (old, new) if old != new else None
+
+    def _report(self, pair: Optional[Tuple[int, int]]) -> None:
+        if pair is not None and self._on_transition is not None:
+            try:
+                self._on_transition(*pair)
+            except Exception:
+                pass  # telemetry must not fail the breaker
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        """May a request be sent to this shard right now? In half-open,
+        only the single probe passes."""
+        now = now if now is not None else time.monotonic()
+        pair = None
+        try:
+            with self._lock:
+                if self._state == CLOSED:
+                    return True
+                if self._state == OPEN:
+                    if now - self._opened_at < self.reset_s:
+                        return False
+                    pair = self._transition(HALF_OPEN)
+                    self._probing = True
+                    return True
+                # HALF_OPEN: one probe in flight at a time
+                if self._probing:
+                    return False
+                self._probing = True
+                return True
+        finally:
+            self._report(pair)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probing = False
+            pair = (self._transition(CLOSED)
+                    if self._state != CLOSED else None)
+        self._report(pair)
+
+    def record_failure(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.monotonic()
+        pair = None
+        with self._lock:
+            self._consecutive += 1
+            self._probing = False
+            if self._state == HALF_OPEN or (
+                self._state == CLOSED and self._consecutive >= self.failures
+            ):
+                self._opened_at = now
+                pair = self._transition(OPEN)
+        self._report(pair)
+
+
+class ShardState:
+    """One downstream serve process: address, breaker, latency window
+    (the hedge-delay source), health verdict, and shed backoff."""
+
+    def __init__(self, index: int, url: str, breaker: CircuitBreaker,
+                 hedge_min_s: float = DEFAULT_HEDGE_MIN_S) -> None:
+        parsed = urlparse(url if "//" in url else f"http://{url}")
+        if parsed.scheme != "http" or not parsed.hostname:
+            raise ValueError(
+                f"shard url {url!r} must be http://host:port"
+            )
+        self.index = index
+        self.url = url
+        self.host = parsed.hostname
+        self.port = parsed.port or 80
+        self.breaker = breaker
+        self.hedge_min_s = float(hedge_min_s)
+        self._lock = threading.Lock()
+        self._lat: List[float] = []
+        self.healthy = True          # optimistic until the first probe
+        self.health_detail: dict = {}
+        self.retry_after_until = 0.0  # monotonic; set from 429 Retry-After
+
+    # -- latency / hedging ---------------------------------------------------
+
+    def note_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(float(seconds))
+            if len(self._lat) > _LAT_SAMPLES:
+                del self._lat[0]
+
+    def hedge_delay(self) -> float:
+        """When to fire the hedge: this shard's observed p95, floored at
+        ``hedge_min_s`` (which is also the cold-start default — hedging
+        off a single sample would hedge everything)."""
+        with self._lock:
+            lat = sorted(self._lat)
+        if len(lat) < 4:
+            return self.hedge_min_s
+        p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+        return max(p95, self.hedge_min_s)
+
+    # -- shed backoff --------------------------------------------------------
+
+    def note_retry_after(self, seconds: float,
+                         now: Optional[float] = None) -> None:
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            self.retry_after_until = max(
+                self.retry_after_until, now + float(seconds)
+            )
+
+    def retry_after_remaining(self, now: Optional[float] = None) -> float:
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            return max(0.0, self.retry_after_until - now)
+
+    def label(self) -> dict:
+        return {"shard": str(self.index)}
+
+
+class RouterConfig:
+    """The routing knobs (CLI flags map 1:1; docs/SERVING.md)."""
+
+    def __init__(
+        self,
+        deadline_s: float = DEFAULT_DEADLINE_S,
+        retries: int = DEFAULT_RETRIES,
+        backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
+        backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+        hedge_min_s: float = DEFAULT_HEDGE_MIN_S,
+        quorum: Optional[int] = None,
+        breaker_failures: int = DEFAULT_BREAKER_FAILURES,
+        breaker_reset_s: float = DEFAULT_BREAKER_RESET_S,
+        health_period_s: float = DEFAULT_HEALTH_PERIOD_S,
+    ) -> None:
+        self.deadline_s = float(deadline_s)
+        self.retries = max(int(retries), 0)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.hedge_min_s = float(hedge_min_s)
+        self.quorum = quorum  # None = majority, resolved per shard count
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self.health_period_s = float(health_period_s)
+
+    def resolve_quorum(self, n_shards: int) -> int:
+        if self.quorum is not None:
+            q = int(self.quorum)
+            if not (1 <= q <= n_shards):
+                raise ValueError(
+                    f"quorum {q} must be in [1, {n_shards}] shards"
+                )
+            return q
+        return n_shards // 2 + 1  # majority
+
+
+def merge_topk(
+    payloads: List[dict], k: Optional[int],
+) -> Tuple[List[List[float]], List[List[int]], int]:
+    """Merge per-shard ``/v1/knn`` payloads into global (distances, ids).
+
+    Exactly the SPMD forest merge (``_merge_partials``): per query,
+    concatenate every shard's (distance, id) candidates, order by
+    (distance, id) — the stable two-key sort that makes ties break
+    identically on every code path — and keep the k best. The global
+    top-k is a subset of the union of per-shard top-ks, so the merge is
+    exact, and distances pass through the JSON float round-trip
+    unchanged (repr round-trips float64), so an all-shards merge is
+    byte-identical to the single-index oracle."""
+    if not payloads:
+        raise ValueError("merge_topk needs at least one shard payload")
+    kk = min(p["k"] for p in payloads) if k is None else int(k)
+    nq = len(payloads[0]["ids"])
+    out_d: List[List[float]] = []
+    out_i: List[List[int]] = []
+    for qi in range(nq):
+        cands: List[Tuple[float, int]] = []
+        for p in payloads:
+            cands.extend(zip(p["distances"][qi], p["ids"][qi]))
+        cands.sort()
+        top = cands[:kk]
+        out_d.append([d for d, _ in top])
+        out_i.append([i for _, i in top])
+    return out_d, out_i, kk
+
+
+class RouterHandler(JsonRequestHandler):
+    """Scatter/gather glue; pure host code (no jax anywhere in the
+    router process's request path). Serialization + keep-alive timeout
+    are the shared :class:`JsonRequestHandler` contract."""
+
+    server_version = "kdtree-tpu-route/1.0"
+
+    # -- GET ----------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._send_health()
+            return
+        if path == "/metrics":
+            self._send_metrics()
+            return
+        if path == "/debug/flight":
+            self._send_flight()
+            return
+        if path == "/debug/shards":
+            self._send_json(200, {"shards": self.server.shard_report()})
+            return
+        self._send_json(404, {"error": f"no such path: {path}"})
+
+    def _send_health(self) -> None:
+        """Aggregated readiness: the router is as ready as its quorum.
+        200 while >= quorum shards are routable (healthy + breaker not
+        open), 503 below — with the full per-shard breakdown either
+        way, so one scrape names the failing shard."""
+        rt: Router = self.server
+        shards = rt.shard_report()
+        available = sum(1 for s in shards if s["routable"])
+        body = {
+            "status": "ok" if available >= rt.quorum else "unavailable",
+            "shards": shards,
+            "available": available,
+            "quorum": rt.quorum,
+            "total": len(shards),
+        }
+        if rt.slo_engine is not None:
+            body["slo"] = rt.slo_engine.health_block()
+        self._send_json(200 if available >= rt.quorum else 503, body)
+
+    # -- POST ---------------------------------------------------------------
+
+    def do_POST(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path != "/v1/knn":
+            self._send_json(404, {"error": f"no such path: {path}"})
+            return
+        trace = _trace_id(self.headers)
+        try:
+            length = int(self.headers.get("Content-Length", ""))
+        except ValueError:
+            self._send_json(411, {"error": "Content-Length required"})
+            return
+        if not (0 <= length <= MAX_BODY_BYTES):
+            self._send_json(400, {"error": "bad Content-Length"})
+            return
+        body = self.rfile.read(length)
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._send_json(400, {"error": "body is not valid JSON"})
+            return
+        if not isinstance(payload, dict) or "queries" not in payload:
+            self._send_json(400, {"error": 'body must be a JSON object '
+                                           'with "queries"'})
+            return
+        k = payload.get("k")
+        if k is not None and (not isinstance(k, int) or isinstance(k, bool)
+                              or k < 1):
+            self._send_json(400, {"error": "k must be a positive int"})
+            return
+        code, out, headers = self.server.route_knn(body, k, trace)
+        self._send_json(code, out, extra_headers=headers)
+
+
+class Router(GracefulHTTPServer):
+    """The routing process object: accept loop + shard table + health
+    loop + (optional) SLO sampler, with the same graceful-stop contract
+    as the shard server — in-flight scatters drain, shard connections
+    are closed in the attempt that opened them, nothing is orphaned."""
+
+    client_gone_event = "route.client_gone"
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        shard_urls: List[str],
+        config: Optional[RouterConfig] = None,
+        slo_engine=None,
+    ) -> None:
+        # validate BEFORE binding: a ValueError after super().__init__
+        # would leak the bound socket (a corrected retry on the same
+        # fixed port then flakes with EADDRINUSE until GC)
+        if not shard_urls:
+            raise ValueError("router needs at least one shard url")
+        self.config = config or RouterConfig()
+        self.quorum = self.config.resolve_quorum(len(shard_urls))
+        parsed_shards = [
+            ShardState(i, url,
+                       CircuitBreaker(
+                           failures=self.config.breaker_failures,
+                           reset_s=self.config.breaker_reset_s,
+                           on_transition=self._breaker_reporter(i),
+                       ),
+                       hedge_min_s=self.config.hedge_min_s)
+            for i, url in enumerate(shard_urls)
+        ]
+        super().__init__(address, RouterHandler)
+        reg = obs.get_registry()
+        self.shards: List[ShardState] = parsed_shards
+        for shard in self.shards:
+            reg.gauge("kdtree_router_breaker_state",
+                      labels=shard.label()).set(CLOSED)
+            reg.gauge("kdtree_router_shard_healthy",
+                      labels=shard.label()).set(1)
+        reg.gauge("kdtree_router_shards").set(len(self.shards))
+        self._req_lat = reg.histogram(
+            "kdtree_router_request_seconds",
+            buckets=_ROUTER_LATENCY_BUCKETS,
+        )
+        self._partial = reg.counter("kdtree_router_partial_total")
+        self.slo_engine = slo_engine
+        self._serve_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._sampler = None
+        self._stopping = threading.Event()
+
+    # -- telemetry plumbing --------------------------------------------------
+
+    def _breaker_reporter(self, index: int):
+        labels = {"shard": str(index)}
+
+        def report(old: int, new: int) -> None:
+            reg = obs.get_registry()
+            reg.gauge("kdtree_router_breaker_state", labels=labels).set(new)
+            reg.counter(
+                "kdtree_router_breaker_transitions_total",
+                labels={"shard": str(index), "to": BREAKER_NAMES[new]},
+            ).inc()
+            flight.record("route.breaker", shard=index,
+                          previous=BREAKER_NAMES[old], to=BREAKER_NAMES[new])
+            if new == OPEN:
+                # breaker-open IS an incident: dump the ring (rate-
+                # limited) with the failing shard named in its events
+                flight.auto_dump("route-breaker-open")
+
+        return report
+
+    def _count_request(self, status: str) -> None:
+        obs.get_registry().counter(
+            "kdtree_router_requests_total", labels={"status": status}
+        ).inc()
+
+    def _count_attempt(self, shard: ShardState, outcome: str) -> None:
+        obs.get_registry().counter(
+            "kdtree_router_shard_attempts_total",
+            labels={"shard": str(shard.index), "outcome": outcome},
+        ).inc()
+
+    # -- shard I/O -----------------------------------------------------------
+
+    def _call_shard(
+        self, shard: ShardState, body: bytes, timeout_s: float, trace: str,
+        conn_box: Optional[dict] = None, tag: str = "primary",
+        abort_check=None,
+    ) -> dict:
+        """One HTTP attempt against one shard; returns the parsed
+        payload or raises :class:`ShardError`. The connection is stored
+        in ``conn_box`` (so a hedging race can abort the loser) and
+        always closed here — the router never pools, so shutdown can
+        never orphan a shard connection. ``abort_check`` (checked after
+        registering the connection) lets a hedge loser that registered
+        AFTER the winner's close sweep abort itself instead of running
+        a redundant full request."""
+        import http.client
+
+        t0 = time.monotonic()
+        conn = http.client.HTTPConnection(
+            shard.host, shard.port, timeout=max(timeout_s, 0.001)
+        )
+        if conn_box is not None:
+            conn_box[tag] = conn
+        if abort_check is not None and abort_check():
+            conn.close()
+            raise ShardError(f"shard {shard.index}: hedge twin already won",
+                             outcome="network")
+        try:
+            try:
+                conn.request(
+                    "POST", "/v1/knn", body=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": trace},
+                )
+                resp = conn.getresponse()
+                raw = resp.read()
+                status = resp.status
+            except (TimeoutError, OSError) as e:
+                # covers socket.timeout (= TimeoutError), refused
+                # connections, resets, AND injected drops (the server
+                # closing without a status line surfaces as
+                # BadStatusLine below or a bare OSError here)
+                outcome = ("timeout"
+                           if isinstance(e, TimeoutError) else "network")
+                raise ShardError(f"shard {shard.index}: {e!r}",
+                                 outcome=outcome) from None
+            except (http.client.HTTPException, ValueError) as e:
+                # ValueError: a hedge winner closing this twin's
+                # connection mid-read surfaces as "I/O operation on
+                # closed file" — a cancellation, not a crash
+                raise ShardError(f"shard {shard.index}: {e!r}",
+                                 outcome="network") from None
+        finally:
+            conn.close()
+        if status == 429:
+            retry_after = None
+            try:
+                retry_after = float(resp.headers.get("Retry-After", ""))
+            except (TypeError, ValueError):
+                pass
+            raise ShardError(f"shard {shard.index} shed (429)",
+                             outcome="shed", status=429,
+                             retry_after_s=retry_after)
+        if 400 <= status < 500:
+            # the REQUEST is wrong (bad k, wrong dim): every shard will
+            # agree, so propagate instead of retrying the inevitable
+            try:
+                err_body = json.loads(raw.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                err_body = {"error": f"shard {shard.index} answered "
+                                     f"{status}"}
+            raise ShardError(f"shard {shard.index}: client error {status}",
+                             outcome="client_error", retryable=False,
+                             status=status, body=err_body)
+        if status != 200:
+            raise ShardError(f"shard {shard.index}: HTTP {status}",
+                             outcome="http_error", status=status)
+        try:
+            payload = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            raise ShardError(f"shard {shard.index}: unparseable 200 body",
+                             outcome="network") from None
+        if not isinstance(payload, dict) or "ids" not in payload:
+            raise ShardError(f"shard {shard.index}: malformed payload",
+                             outcome="network")
+        shard.note_latency(time.monotonic() - t0)
+        obs.get_registry().histogram(
+            "kdtree_router_shard_seconds",
+            buckets=_ROUTER_LATENCY_BUCKETS, labels=shard.label(),
+        ).observe(time.monotonic() - t0)
+        return payload
+
+    def _attempt_hedged(
+        self, shard: ShardState, body: bytes, deadline: float, trace: str,
+        allow_hedge: bool = True,
+    ) -> dict:
+        """One logical attempt = a primary call plus (maybe) one hedge.
+        The first success wins and the loser's connection is closed;
+        both failing raises the primary's error. Raises ShardError.
+        ``allow_hedge=False`` keeps a breaker's half-open probe to the
+        single request its contract promises."""
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise ShardError(f"shard {shard.index}: deadline exhausted",
+                             outcome="timeout")
+        result: dict = {}
+        conns: dict = {}
+        cond = threading.Condition()
+        reg = obs.get_registry()
+
+        def run(tag: str) -> None:
+            budget = deadline - time.monotonic()
+            try:
+                payload = self._call_shard(
+                    shard, body, budget, trace, conn_box=conns, tag=tag,
+                    # a loser registering after the winner's close sweep
+                    # aborts itself before sending anything
+                    abort_check=lambda: result.get("winner") not in
+                    (None, tag),
+                )
+                with cond:
+                    if "winner" not in result:
+                        result["winner"] = tag
+                        result["payload"] = payload
+                    result[tag] = "ok"
+                    cond.notify_all()
+                # abort the losing twin: its answer is redundant and its
+                # socket must not outlive the request
+                loser = "hedge" if tag == "primary" else "primary"
+                other = conns.get(loser)
+                if other is not None and result.get("winner") == tag:
+                    try:
+                        other.close()
+                    except Exception:
+                        pass
+                if result.get("winner") == tag and tag == "hedge":
+                    reg.counter("kdtree_router_hedge_wins_total",
+                                labels=shard.label()).inc()
+            except ShardError as e:
+                with cond:
+                    result[tag] = e
+                    cond.notify_all()
+
+        primary = threading.Thread(
+            target=run, args=("primary",), name="kdtree-route-primary"
+        )
+        primary.start()
+        hedge_after = min(shard.hedge_delay(), max(remaining, 0.0))
+        hedge_thread: Optional[threading.Thread] = None
+        with cond:
+            if allow_hedge:
+                cond.wait_for(lambda: "primary" in result
+                              or "winner" in result,
+                              timeout=hedge_after)
+            launch_hedge = (allow_hedge
+                            and "winner" not in result
+                            and not isinstance(result.get("primary"),
+                                               ShardError)
+                            and deadline - time.monotonic() > 0)
+        if launch_hedge:
+            reg.counter("kdtree_router_hedges_total",
+                        labels=shard.label()).inc()
+            flight.record("route.hedge", shard=shard.index, trace=trace,
+                          after_ms=round(hedge_after * 1e3, 3))
+            hedge_thread = threading.Thread(
+                target=run, args=("hedge",), name="kdtree-route-hedge"
+            )
+            hedge_thread.start()
+
+        def settled() -> bool:
+            if "winner" in result:
+                return True
+            done = isinstance(result.get("primary"), ShardError)
+            if hedge_thread is not None:
+                done = done and isinstance(result.get("hedge"), ShardError)
+            return done
+
+        with cond:
+            cond.wait_for(settled, timeout=max(deadline - time.monotonic(),
+                                               0.0) + 0.05)
+        # join quickly; threads whose sockets were closed unwind fast,
+        # a still-running loser is bounded by its own socket timeout
+        primary.join(timeout=0.05)
+        if hedge_thread is not None:
+            hedge_thread.join(timeout=0.05)
+        if "winner" in result:
+            return result["payload"]
+        err = result.get("primary")
+        if not isinstance(err, ShardError):
+            err = result.get("hedge")
+        if not isinstance(err, ShardError):
+            # nothing settled inside the deadline: abort both calls so
+            # their threads unwind instead of outliving the request
+            for conn in list(conns.values()):
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            err = ShardError(f"shard {shard.index}: no answer before "
+                             "deadline", outcome="timeout")
+        raise err
+
+    def _shard_task(
+        self, shard: ShardState, body: bytes, deadline: float, trace: str,
+    ):
+        """The full per-shard policy: ejection check, breaker, bounded
+        retry with jittered backoff (429 Retry-After honored). Returns
+        the payload, or the final ShardError."""
+        cfg = self.config
+        if not shard.healthy:
+            self._count_attempt(shard, "breaker_open")
+            return ShardError(f"shard {shard.index}: ejected (unhealthy)",
+                              outcome="breaker_open")
+        # deterministic jitter: a replayed request backs off identically
+        rng = random.Random(f"{trace}:{shard.index}")
+        last: Optional[ShardError] = None
+        for attempt in range(cfg.retries + 1):
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            if not shard.breaker.allow(now):
+                self._count_attempt(shard, "breaker_open")
+                return ShardError(
+                    f"shard {shard.index}: circuit breaker open",
+                    outcome="breaker_open",
+                )
+            try:
+                payload = self._attempt_hedged(
+                    shard, body, deadline, trace,
+                    # a half-open probe is ONE request by contract — a
+                    # just-recovering shard must not be hedged into 2x
+                    # load at its weakest moment
+                    allow_hedge=shard.breaker.state != HALF_OPEN,
+                )
+            except ShardError as e:
+                last = e
+                self._count_attempt(shard, e.outcome)
+                if not e.retryable:
+                    # a 4xx is the SHARD ANSWERING — the request was
+                    # wrong, the shard is alive. Counting it a breaker
+                    # failure would be unjust; not recording anything
+                    # would leak a claimed half-open probe slot and
+                    # refuse the shard forever. Success it is.
+                    shard.breaker.record_success()
+                    return e
+                shard.breaker.record_failure()
+                if e.retry_after_s is not None:
+                    shard.note_retry_after(e.retry_after_s)
+                if attempt >= cfg.retries:
+                    break
+                backoff = min(cfg.backoff_base_s * (2 ** attempt),
+                              cfg.backoff_max_s)
+                backoff *= 0.5 + 0.5 * rng.random()  # jitter in [0.5, 1.0]x
+                # a shard that said "Retry-After: N" means it: the shed
+                # backoff wins over the generic schedule. Fresh clock —
+                # the pre-attempt `now` is stale by the attempt's own
+                # duration and would over-sleep past the advice (and
+                # maybe past the deadline, forfeiting a viable retry).
+                backoff = max(backoff, shard.retry_after_remaining())
+                if time.monotonic() + backoff >= deadline:
+                    break
+                obs.get_registry().counter(
+                    "kdtree_router_retries_total", labels=shard.label()
+                ).inc()
+                flight.record("route.retry", shard=shard.index, trace=trace,
+                              attempt=attempt, outcome=e.outcome,
+                              backoff_ms=round(backoff * 1e3, 3))
+                time.sleep(backoff)
+                continue
+            shard.breaker.record_success()
+            self._count_attempt(shard, "ok")
+            return payload
+        return last if last is not None else ShardError(
+            f"shard {shard.index}: deadline exhausted", outcome="timeout"
+        )
+
+    # -- the scatter/gather core --------------------------------------------
+
+    def route_knn(
+        self, body: bytes, k: Optional[int], trace: str,
+    ) -> Tuple[int, dict, Optional[dict]]:
+        """Fan one validated request out to every shard, gather inside
+        the deadline, merge. Returns (status, response body, headers)."""
+        t0 = time.monotonic()
+        deadline = t0 + self.config.deadline_s
+        n = len(self.shards)
+        results: List[Optional[object]] = [None] * n
+        threads = []
+        for shard in self.shards:
+            def task(s=shard):
+                results[s.index] = self._shard_task(s, body, deadline, trace)
+
+            t = threading.Thread(target=task, name="kdtree-route-scatter")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0) + 0.25)
+        # ONE snapshot: a laggard task finishing between two reads of
+        # `results` must not let the merge and the missing-list disagree
+        snapshot = list(results)
+        payloads = [r for r in snapshot if isinstance(r, dict)]
+        errors = {i: r for i, r in enumerate(snapshot)
+                  if isinstance(r, ShardError)}
+        # a 4xx from a shard means the REQUEST is bad — propagate it
+        # verbatim rather than merging around it or retrying it
+        for err in errors.values():
+            if err.outcome == "client_error" and err.body is not None:
+                self._count_request("client_error")
+                out = dict(err.body)
+                out["trace_id"] = trace
+                return err.status or 400, out, None
+        elapsed = time.monotonic() - t0
+        self._req_lat.observe(elapsed)
+        missing = sorted(set(range(n)) - {i for i, r in enumerate(snapshot)
+                                          if isinstance(r, dict)})
+        if len(payloads) == n:
+            dists, ids, kk = merge_topk(payloads, k)
+            degraded = next(
+                (p["degraded"] for p in payloads if p.get("degraded")), None
+            )
+            self._count_request("ok")
+            return 200, {
+                "k": kk, "ids": ids, "distances": dists,
+                "degraded": degraded, "trace_id": trace,
+                "shards": {"total": n, "answered": n, "missing": []},
+            }, None
+        if len(payloads) >= self.quorum:
+            # partial degradation: exact over the answered shards,
+            # honestly flagged — never a silent wrong answer
+            dists, ids, kk = merge_topk(payloads, k)
+            self._partial.inc()
+            self._count_request("partial")
+            flight.record(
+                "route.partial", trace=trace, answered=len(payloads),
+                total=n, missing=missing,
+                outcomes={str(i): e.outcome for i, e in errors.items()},
+            )
+            flight.auto_dump("route-partial")
+            return 200, {
+                "k": kk, "ids": ids, "distances": dists,
+                "degraded": f"partial:{len(payloads)}/{n}",
+                "trace_id": trace,
+                "shards": {"total": n, "answered": len(payloads),
+                           "missing": missing},
+            }, None
+        self._count_request("unavailable")
+        flight.record(
+            "route.unavailable", trace=trace, answered=len(payloads),
+            total=n, quorum=self.quorum, missing=missing,
+            outcomes={str(i): e.outcome for i, e in errors.items()},
+        )
+        flight.auto_dump("route-unavailable")
+        return 503, {
+            "error": f"only {len(payloads)}/{n} shards answered "
+                     f"(quorum {self.quorum}); failing shards: {missing}",
+            "trace_id": trace,
+            "shards": {"total": n, "answered": len(payloads),
+                       "missing": missing},
+        }, {"Retry-After": str(int(max(self.config.breaker_reset_s, 1.0)))}
+
+    # -- health ejection -----------------------------------------------------
+
+    def _probe_health(self, shard: ShardState) -> None:
+        """One /healthz probe: a shard is routable only while it answers
+        200 AND its SLO block is not PAGE-burning (a burning replica
+        wants traffic routed away — obs/slo.py's contract)."""
+        import http.client
+
+        timeout = max(min(self.config.health_period_s, 2.0), 0.1)
+        healthy = False
+        detail: dict = {}
+        try:
+            conn = http.client.HTTPConnection(shard.host, shard.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                raw = resp.read()
+                if resp.status == 200:
+                    try:
+                        detail = json.loads(raw.decode("utf-8"))
+                    except (UnicodeDecodeError, ValueError):
+                        detail = {}
+                    healthy = detail.get("slo", {}).get("state") != "PAGE"
+                    if not healthy:
+                        detail = {"ejected": "slo PAGE"}
+                else:
+                    detail = {"ejected": f"healthz {resp.status}"}
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            # HTTPException covers a DROPPED/garbled probe (BadStatusLine
+            # from a connection closed with no status) — miss it and a
+            # healthz=drop shard would never eject
+            detail = {"ejected": f"unreachable: {e!r}"}
+        was = shard.healthy
+        shard.healthy = healthy
+        shard.health_detail = detail
+        obs.get_registry().gauge(
+            "kdtree_router_shard_healthy", labels=shard.label()
+        ).set(1 if healthy else 0)
+        if was != healthy:
+            flight.record("route.eject" if not healthy else "route.admit",
+                          shard=shard.index, detail=detail)
+            if not healthy:
+                flight.auto_dump("route-eject")
+
+    def _health_loop(self) -> None:
+        while not self._stopping.is_set():
+            for shard in self.shards:
+                if self._stopping.is_set():
+                    return
+                try:
+                    self._probe_health(shard)
+                except Exception:
+                    pass  # the loop must outlive any single probe bug
+            self._stopping.wait(self.config.health_period_s)
+
+    def shard_report(self) -> List[dict]:
+        out = []
+        for s in self.shards:
+            state = s.breaker.state
+            out.append({
+                "index": s.index,
+                "url": s.url,
+                "healthy": s.healthy,
+                "breaker": BREAKER_NAMES[state],
+                "routable": s.healthy and state != OPEN,
+                "detail": s.health_detail,
+            })
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, health_loop: bool = True) -> None:
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="kdtree-route-accept"
+        )
+        self._serve_thread.start()
+        if health_loop:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="kdtree-route-health",
+                daemon=True,
+            )
+            self._health_thread.start()
+        if self.slo_engine is not None:
+            from kdtree_tpu.obs import history as obs_history
+
+            self._sampler = obs_history.Sampler(
+                history=self.slo_engine.history,
+                on_sample=self._slo_tick,
+            )
+            self._sampler.start()
+
+    def _slo_tick(self) -> None:
+        if self.slo_engine is not None:
+            self.slo_engine.evaluate()
+
+    def stop(self) -> None:
+        """Graceful: stop accepting, let in-flight scatters run to their
+        own deadlines (handler threads are joined by ``server_close``,
+        and every shard connection closes in the attempt that opened
+        it), then stop the background loops."""
+        self._stopping.set()
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join()
+            self._serve_thread = None
+        if self._sampler is not None:
+            self._sampler.stop()
+            self._sampler = None
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2 * self.config.health_period_s
+                                     + 2.0)
+            self._health_thread = None
+        self.server_close()
+        obs.flush()
+
+
+def make_router(
+    shard_urls: List[str],
+    host: str = "127.0.0.1",
+    port: int = 0,
+    config: Optional[RouterConfig] = None,
+    slo_engine=None,
+) -> Router:
+    """Bind (port 0 = ephemeral) but do not start — same contract as
+    :func:`kdtree_tpu.serve.server.make_server`."""
+    return Router((host, port), shard_urls, config=config,
+                  slo_engine=slo_engine)

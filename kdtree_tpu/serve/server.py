@@ -20,6 +20,16 @@
   timeline (``obs/timeline.py`` report JSON). One capture at a time
   (409 while one is running); tracing is the one telemetry feature that
   is not host-cheap, so it runs only on demand.
+- ``GET`` / ``POST /debug/faults`` — the deterministic fault-injection
+  layer (``serve/faults.py``): GET lists armed clauses, POST arms a
+  spec (``{"spec": "knn=latency:250"}``) or clears (``{"clear": true}``).
+  This is how the router's fault-tolerance tests *cause* shard failure
+  on demand; ``KDTREE_TPU_FAULTS`` arms the same clauses at startup.
+
+429 shed responses carry a ``Retry-After`` header derived from the
+admission queue's measured drain rate (how long until the shed rows
+would fit), so a well-behaved client — the router included — backs off
+by measurement instead of by guess.
 
 Every ``/v1/knn`` request carries a trace id (client ``X-Request-Id``
 or server-generated, echoed as ``trace_id`` in the response): the same
@@ -35,6 +45,7 @@ than letting one huge request distort every micro-batch behind it.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import uuid
@@ -55,7 +66,17 @@ from kdtree_tpu.serve.batcher import (
     DEFAULT_MAX_WAIT_MS,
     MicroBatcher,
 )
+from kdtree_tpu.serve.faults import (
+    SITE_HEALTHZ,
+    SITE_KNN,
+    FaultSpecError,
+    from_env,
+)
 from kdtree_tpu.serve.lifecycle import ServeState
+
+__all__ = ["GracefulHTTPServer", "JsonRequestHandler", "KnnRequestHandler",
+           "KnnServer", "make_server",
+           "FaultSpecError"]  # FaultSpecError re-exported for the CLI
 
 MAX_BODY_BYTES = 64 << 20  # a [max_batch, D] float batch is far smaller
 MAX_PROFILE_SECONDS = 60.0  # /debug/profile window cap
@@ -79,14 +100,13 @@ def _count_request(status: str) -> None:
     ).inc()
 
 
-class KnnRequestHandler(BaseHTTPRequestHandler):
-    """Request glue. Methods of this class legitimately materialize
-    device results into JSON — the KDT201 hot-path rule exempts
-    BaseHTTPRequestHandler subclasses by detection for exactly this
-    boundary (docs/STATIC_ANALYSIS.md)."""
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared JSON-over-HTTP handler glue for the shard server AND the
+    router (serve/router.py): one implementation of response
+    serialization and the keep-alive socket timeout, so a fix to either
+    cannot silently miss the other."""
 
     protocol_version = "HTTP/1.1"
-    server_version = "kdtree-tpu-serve/1.0"
     # idle keep-alive connections park their handler thread in readline();
     # with daemon_threads=False server_close() would join that thread
     # FOREVER and a persistent scraper (Prometheus' default) would wedge
@@ -99,8 +119,6 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
     # telemetry lives in the metrics registry instead
     def log_message(self, format: str, *args) -> None:
         pass
-
-    # -- plumbing -----------------------------------------------------------
 
     def _send_bytes(
         self, code: int, body: bytes, content_type: str,
@@ -126,11 +144,92 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             "application/json", extra_headers,
         )
 
+    # shared observability endpoints (the shard server and the router
+    # both expose them; the scrape format and flush semantics must not
+    # be able to drift between the two)
+
+    def _send_metrics(self) -> None:
+        """``GET /metrics``: the process registry's Prometheus text,
+        deferred device fetches flushed first."""
+        from kdtree_tpu.obs.export import prometheus_text
+
+        obs.flush()
+        self._send_bytes(
+            200, prometheus_text().encode("utf-8"),
+            "text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def _send_flight(self) -> None:
+        """``GET /debug/flight``: the live ring, no file involved — same
+        payload shape as a SIGUSR2 dump so one reader handles both."""
+        self._send_json(200, flight.recorder().report("debug-endpoint"))
+
+
+class GracefulHTTPServer(ThreadingHTTPServer):
+    """Shared server base: non-daemon handler threads (server_close()
+    joins every in-flight handler, so stop() cannot drop an accepted
+    request) and disconnect-tolerant error handling — a client that
+    hung up mid-response (router deadline expired, hedge loser
+    cancelled, curl ^C) is normal serving weather, not a stack trace."""
+
+    daemon_threads = False
+    client_gone_event = "serve.client_gone"  # flight-ring event name
+
+    def handle_error(self, request, client_address) -> None:
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            ConnectionAbortedError)):
+            flight.record(self.client_gone_event,
+                          peer=str(client_address), error=repr(exc)[:200])
+            return
+        super().handle_error(request, client_address)
+
+
+class KnnRequestHandler(JsonRequestHandler):
+    """Request glue. Methods of this class legitimately materialize
+    device results into JSON — the KDT201 hot-path rule exempts
+    BaseHTTPRequestHandler subclasses by detection for exactly this
+    boundary (docs/STATIC_ANALYSIS.md)."""
+
+    server_version = "kdtree-tpu-serve/1.0"
+
     # -- GET ----------------------------------------------------------------
+
+    def _fire_fault(self, site: str) -> bool:
+        """Run the fault-injection site; True when a response (or a
+        deliberate non-response) was already produced and the caller
+        must return. Delay faults (latency/hang) are served inside
+        ``fire`` and fall through to normal handling."""
+        act = self.server.faults.fire(site)
+        if act is None:
+            return False
+        if act["kind"] == "drop":
+            # no status line, no body: the client sees the connection
+            # close mid-exchange — a network fault, not an HTTP one
+            self.close_connection = True
+            return True
+        # error kind: answer WITHOUT touching the engine — but consume
+        # the request body first, or a keep-alive client's next request
+        # line would be parsed out of the unread JSON (protocol desync)
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+        except ValueError:
+            length = -1
+        if 0 < length <= MAX_BODY_BYTES:
+            self.rfile.read(length)
+        elif length != 0:
+            self.close_connection = True
+        self._send_json(act["status"],
+                        {"error": "injected fault (serve/faults.py)"})
+        return True
 
     def do_GET(self) -> None:
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
+            if self._fire_fault(SITE_HEALTHZ):
+                return
             state: ServeState = self.server.state
             if state.ready:
                 body = {
@@ -151,18 +250,10 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
                                 extra_headers={"Retry-After": "1"})
             return
         if path == "/metrics":
-            from kdtree_tpu.obs.export import prometheus_text
-
-            obs.flush()  # run deferred device fetches before snapshotting
-            self._send_bytes(
-                200, prometheus_text().encode("utf-8"),
-                "text/plain; version=0.0.4; charset=utf-8",
-            )
+            self._send_metrics()
             return
         if path == "/debug/flight":
-            # the live ring, no file involved — same payload shape as a
-            # SIGUSR2 dump so one reader handles both
-            self._send_json(200, flight.recorder().report("debug-endpoint"))
+            self._send_flight()
             return
         if path == "/debug/history":
             # the metric-history ring the SLO engine reads — same payload
@@ -176,6 +267,10 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
                 limit = None
             self._send_json(200, self.server.history.report(limit=limit))
             return
+        if path == "/debug/faults":
+            self._send_json(200, {"enabled": self.server.faults_mutable,
+                                  "active": self.server.faults.describe()})
+            return
         self._send_json(404, {"error": f"no such path: {path}"})
 
     # -- POST ---------------------------------------------------------------
@@ -185,8 +280,13 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
         if path == "/debug/profile":
             self._do_debug_profile()
             return
+        if path == "/debug/faults":
+            self._do_debug_faults()
+            return
         if path != "/v1/knn":
             self._send_json(404, {"error": f"no such path: {path}"})
+            return
+        if self._fire_fault(SITE_KNN):
             return
         trace = _trace_id(self.headers)
         parsed = self._parse_knn_body()
@@ -214,7 +314,8 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
                 self._send_json(429, {"error": "overloaded: admission "
                                                "queue at capacity",
                                       "trace_id": trace},
-                                extra_headers={"Retry-After": "1"})
+                                extra_headers=self._retry_after(
+                                    queries.shape[0]))
                 return
             except QueueClosedError:
                 _count_request("unready")
@@ -255,7 +356,7 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             self._send_json(429, {"error": "overloaded: admission queue "
                                            "at capacity",
                                   "trace_id": trace},
-                            extra_headers={"Retry-After": "1"})
+                            extra_headers=self._retry_after(req.rows))
             return
         except QueueClosedError:
             _count_request("unready")
@@ -349,6 +450,56 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
             deadline_s = float(deadline_ms) / 1e3
         return queries, k, deadline_s
 
+    def _retry_after(self, rows: int) -> dict:
+        """The 429 extra-headers dict: Retry-After derived from the
+        admission queue's measured drain rate (seconds, integer-ceil so
+        a compliant client never retries early)."""
+        import math
+
+        return {"Retry-After":
+                str(int(math.ceil(self.server.queue.retry_after_s(rows))))}
+
+    def _do_debug_faults(self) -> None:
+        """``POST /debug/faults``: arm (``{"spec": ...}``) or clear
+        (``{"clear": true}``) the process's injected faults; the response
+        echoes what is now armed. Validation errors name the bad clause —
+        a drill that silently armed nothing is a failed drill."""
+        if not self.server.faults_mutable:
+            self._send_json(403, {"error": "fault injection is disabled "
+                                           "on this server; start with "
+                                           "--debug-faults (or "
+                                           "KDTREE_TPU_FAULTS) to arm the "
+                                           "drill endpoint"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", ""))
+        except ValueError:
+            self._send_json(411, {"error": "Content-Length required"})
+            return
+        if not (0 <= length <= (1 << 20)):
+            self._send_json(400, {"error": "bad Content-Length"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._send_json(400, {"error": "body is not valid JSON"})
+            return
+        if not isinstance(payload, dict) or \
+                ("spec" not in payload) == ("clear" not in payload) or \
+                ("clear" in payload and payload["clear"] is not True):
+            self._send_json(400, {"error": 'body must be {"spec": "..."} '
+                                           'or {"clear": true}'})
+            return
+        try:
+            if "clear" in payload:
+                self.server.faults.clear()
+            else:
+                self.server.faults.set_spec(str(payload["spec"]))
+        except FaultSpecError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        self._send_json(200, {"active": self.server.faults.describe()})
+
     def _do_debug_profile(self) -> None:
         """``POST /debug/profile?seconds=N``: open a capture window over
         the live process, then answer with the analyzed device-timeline
@@ -396,28 +547,30 @@ class KnnRequestHandler(BaseHTTPRequestHandler):
         rep["seconds_requested"] = seconds
         self._send_json(200, rep)
 
-    @staticmethod
     def _result_json(
-        d2: np.ndarray, ids: np.ndarray, k: int, degraded: Optional[str],
-        trace_id: str = "",
+        self, d2: np.ndarray, ids: np.ndarray, k: int,
+        degraded: Optional[str], trace_id: str = "",
     ) -> dict:
         dist = np.sqrt(d2[:, :k].astype(np.float64))
+        ids = ids[:, :k]
+        offset = self.server.state.id_offset
+        if offset:
+            # sharded serving answers GLOBAL ids: shard-local rows shift
+            # by the shard's offset, padding ids stay -1. int64 so a deep
+            # shard in a huge partition can't wrap the i32 gid table.
+            ids = np.where(ids >= 0, ids.astype(np.int64) + offset, -1)
         return {
             "k": k,
-            "ids": ids[:, :k].tolist(),
+            "ids": ids.tolist(),
             "distances": dist.tolist(),
             "degraded": degraded,
             "trace_id": trace_id,
         }
 
 
-class KnnServer(ThreadingHTTPServer):
+class KnnServer(GracefulHTTPServer):
     """The serving process object: HTTP accept loop + admission queue +
     batch worker, with an explicit graceful-stop sequence."""
-
-    # non-daemon handler threads + block_on_close: server_close() joins
-    # every in-flight handler, so stop() cannot drop an accepted request
-    daemon_threads = False
 
     def __init__(
         self,
@@ -425,9 +578,24 @@ class KnnServer(ThreadingHTTPServer):
         state: ServeState,
         max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
         queue_rows: Optional[int] = None,
+        faults=None,
+        debug_faults: Optional[bool] = None,
     ) -> None:
         super().__init__(address, KnnRequestHandler)
         self.state = state
+        # per-server fault set (serve/faults.py): defaults to the
+        # KDTREE_TPU_FAULTS env spec; in-process multi-shard tests pass
+        # their own so one shard can fault without its neighbors
+        self.faults = faults if faults is not None else from_env()
+        # POST /debug/faults is a remote wedge-this-process button: it
+        # must be OPTED INTO (--debug-faults, an explicit faults= set,
+        # or the KDTREE_TPU_FAULTS env var — a process armed at startup
+        # is already a drill), never ambient on a production shard
+        self.faults_mutable = (
+            faults is not None
+            or bool(debug_faults)
+            or "KDTREE_TPU_FAULTS" in os.environ
+        )
         # default admission budget: a few batches' worth of rows — deep
         # enough to ride a burst, shallow enough that shed beats queueing
         self.queue = AdmissionQueue(
@@ -481,6 +649,10 @@ class KnnServer(ThreadingHTTPServer):
         """Graceful shutdown: stop accepting, drain every accepted
         request, join the handler threads, flush deferred telemetry."""
         self.shutdown()  # stops serve_forever; no new connections accepted
+        # release (not disarm) injected hangs: server_close() below joins
+        # every handler thread, and a drained shutdown must not be
+        # hostage to a fault drill parked in an injected wedge
+        self.faults.release()
         if self._serve_thread is not None:
             self._serve_thread.join()
             self._serve_thread = None
@@ -498,8 +670,11 @@ def make_server(
     port: int = 0,
     max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
     queue_rows: Optional[int] = None,
+    faults=None,
+    debug_faults: Optional[bool] = None,
 ) -> KnnServer:
     """Bind (port 0 = ephemeral; read ``server_address[1]``) but do not
     start — callers decide when the accept loop and warmup run."""
     return KnnServer((host, port), state, max_wait_ms=max_wait_ms,
-                     queue_rows=queue_rows)
+                     queue_rows=queue_rows, faults=faults,
+                     debug_faults=debug_faults)

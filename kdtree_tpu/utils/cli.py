@@ -883,15 +883,23 @@ def cmd_serve(args) -> None:
         state = lifecycle.build_state(
             tree=tree, points=points, problem=problem, k=args.k,
             max_batch=args.max_batch, meta=meta,
+            id_offset=args.id_offset,
         )
     except TypeError as e:
         # un-servable checkpoint kind — crisp stderr + exit code (C10)
         print(f"cannot serve: {e}", file=sys.stderr)
         sys.exit(1)
-    httpd = srv.make_server(
-        state, host=args.host, port=args.port,
-        max_wait_ms=args.max_wait_ms, queue_rows=args.queue_depth,
-    )
+    try:
+        httpd = srv.make_server(
+            state, host=args.host, port=args.port,
+            max_wait_ms=args.max_wait_ms, queue_rows=args.queue_depth,
+            debug_faults=args.debug_faults,
+        )
+    except srv.FaultSpecError as e:
+        # a typo'd KDTREE_TPU_FAULTS must fail the drill at startup,
+        # crisply — never silently arm nothing (C10 contract)
+        print(f"bad KDTREE_TPU_FAULTS: {e}", file=sys.stderr)
+        sys.exit(1)
     host, port = httpd.server_address[:2]
     stop = threading.Event()
 
@@ -927,6 +935,79 @@ def cmd_serve(args) -> None:
           f"{port}", file=sys.stderr)
     stop.wait()
     print("shutting down: draining in-flight requests...", file=sys.stderr)
+    httpd.stop()
+    print("drained; bye", file=sys.stderr)
+
+
+def cmd_route(args) -> None:
+    """Scatter/gather routing over per-shard serve processes
+    (docs/SERVING.md "Routing & fault tolerance"): fan each POST /v1/knn
+    to every shard, merge per-shard top-k by (distance, id), and keep
+    the service available through shard failure — deadlines, bounded
+    retry with jittered backoff, p95 hedging, per-shard circuit
+    breakers, health ejection, and exact partial-result degradation."""
+    import signal
+    import threading
+
+    from kdtree_tpu.serve import faults as faults_mod
+    from kdtree_tpu.serve import router as rt
+
+    urls = []
+    for chunk in args.shard or []:
+        urls.extend(u.strip() for u in chunk.split(",") if u.strip())
+    if not urls:
+        print("route needs at least one --shard http://host:port "
+              "(repeat the flag or comma-separate)", file=sys.stderr)
+        sys.exit(1)
+    # fail a typo'd KDTREE_TPU_FAULTS crisply here too: the router does
+    # not inject faults itself, but a drill operator exporting the spec
+    # into the wrong process should hear about it
+    try:
+        faults_mod.from_env()
+    except faults_mod.FaultSpecError as e:
+        print(f"bad KDTREE_TPU_FAULTS: {e}", file=sys.stderr)
+        sys.exit(1)
+    try:
+        config = rt.RouterConfig(
+            deadline_s=args.deadline_ms / 1e3,
+            retries=args.retries,
+            hedge_min_s=args.hedge_ms / 1e3,
+            quorum=args.quorum,
+            breaker_failures=args.breaker_failures,
+            breaker_reset_s=args.breaker_reset_s,
+            health_period_s=args.health_period_s,
+        )
+        from kdtree_tpu.obs import slo as obs_slo
+
+        engine = obs_slo.SloEngine(specs=obs_slo.router_specs())
+        httpd = rt.make_router(urls, host=args.host, port=args.port,
+                               config=config, slo_engine=engine)
+    except ValueError as e:
+        print(f"cannot route: {e}", file=sys.stderr)
+        sys.exit(1)
+    host, port = httpd.server_address[:2]
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    from kdtree_tpu.obs import flight
+
+    if flight.install_signal_handler():
+        print("flight recorder armed: kill -USR2 this pid dumps the "
+              "recent-event ring", file=sys.stderr)
+    print(f"kdtree-tpu route: {len(urls)} shard(s), quorum "
+          f"{httpd.quorum}, deadline {config.deadline_s * 1e3:g} ms, "
+          f"retries {config.retries}, breaker "
+          f"{config.breaker_failures}x/{config.breaker_reset_s:g}s",
+          file=sys.stderr)
+    httpd.start()
+    print(f"ready: routing POST /v1/knn, GET /healthz, GET /metrics on "
+          f"port {port}", file=sys.stderr)
+    stop.wait()
+    print("shutting down: draining in-flight scatters...", file=sys.stderr)
     httpd.stop()
     print("drained; bye", file=sys.stderr)
 
@@ -1351,7 +1432,53 @@ def main(argv=None) -> None:
     sv.add_argument("--queue-depth", type=int, default=None, metavar="ROWS",
                     help="admission budget in query rows; beyond it "
                          "requests shed with 429 (default 4x max-batch)")
+    sv.add_argument("--id-offset", type=int, default=0, metavar="ROWS",
+                    help="sharded serving: this process holds rows "
+                         "[offset, offset+n) of a partitioned point set "
+                         "and answers GLOBAL ids (local id + offset); "
+                         "the route subcommand's merge depends on it")
+    sv.add_argument("--debug-faults", action="store_true",
+                    help="arm POST /debug/faults (live fault injection, "
+                         "docs/SERVING.md) — a remote wedge-this-process "
+                         "button, so it is opt-in; setting "
+                         "KDTREE_TPU_FAULTS also arms it")
     sv.set_defaults(fn=cmd_serve)
+
+    ro = sub.add_parser(
+        "route",
+        help="fault-tolerant scatter/gather router over per-shard serve "
+             "processes: merged exact top-k, deadlines, retries, "
+             "hedging, circuit breakers, partial results "
+             "(docs/SERVING.md)",
+    )
+    ro.add_argument("--shard", action="append", metavar="URL",
+                    help="shard serve process base url (http://host:port); "
+                         "repeat the flag or comma-separate")
+    ro.add_argument("--host", default="127.0.0.1")
+    ro.add_argument("--port", type=int, default=8081,
+                    help="TCP port (0 = ephemeral, printed on stderr)")
+    ro.add_argument("--deadline-ms", type=float, default=2000.0,
+                    help="scatter/gather budget per request; a shard "
+                         "that cannot answer inside it goes missing, "
+                         "never blocking")
+    ro.add_argument("--retries", type=int, default=2,
+                    help="bounded per-shard retries (jittered exponential "
+                         "backoff; shard Retry-After honored)")
+    ro.add_argument("--hedge-ms", type=float, default=50.0,
+                    help="hedge-delay floor: a second attempt fires when "
+                         "a shard call outlives max(its p95, this)")
+    ro.add_argument("--quorum", type=int, default=None,
+                    help="shards that must answer for a (partial) 200 "
+                         "(default: majority)")
+    ro.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive failures that open a shard's "
+                         "circuit breaker")
+    ro.add_argument("--breaker-reset-s", type=float, default=2.0,
+                    help="open-breaker cooldown before the half-open "
+                         "probe")
+    ro.add_argument("--health-period-s", type=float, default=1.0,
+                    help="per-shard /healthz poll period for ejection")
+    ro.set_defaults(fn=cmd_route)
 
     st = sub.add_parser(
         "stats", help="render a --metrics-out telemetry report "

@@ -594,3 +594,68 @@ def test_kdt106_suppressible_with_reason(tmp_path):
     ))
     assert rules_of(res) == []
     assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# KDT107 client-without-timeout
+# ---------------------------------------------------------------------------
+
+
+def test_kdt107_flags_urlopen_without_timeout(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import urllib.request\n"
+        "def probe(url):\n"
+        "    with urllib.request.urlopen(url) as r:\n"
+        "        return r.read()\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT107"]
+    assert "block-forever" in res.findings[0].message
+
+
+def test_kdt107_flags_httpconnection_and_create_connection(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import http.client\n"
+        "import socket\n"
+        "def call(host, port):\n"
+        "    conn = http.client.HTTPConnection(host, port)\n"
+        "    sock = socket.create_connection((host, port))\n"
+        "    return conn, sock\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT107", "KDT107"]
+
+
+def test_kdt107_clean_with_explicit_timeout(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import http.client\n"
+        "import socket\n"
+        "import urllib.request\n"
+        "def call(host, port, url, t):\n"
+        "    conn = http.client.HTTPConnection(host, port, timeout=t)\n"
+        "    sock = socket.create_connection((host, port), 5.0)\n"
+        "    with urllib.request.urlopen(url, None, 30.0) as r:\n"
+        "        return conn, sock, r.read()\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt107_quiet_on_kwargs_passthrough(tmp_path):
+    # **kwargs may carry the timeout: the syntactic rule stays quiet
+    # rather than guessing (predictable false negatives over
+    # unpredictable false positives — the file's contract)
+    res = lint_snippet(tmp_path, (
+        "import urllib.request\n"
+        "def probe(url, **kw):\n"
+        "    return urllib.request.urlopen(url, **kw)\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt107_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import urllib.request\n"
+        "def probe(url):\n"
+        "    return urllib.request.urlopen(url)  "
+        "# kdt-lint: disable=KDT107 interactive CLI path, user can ^C\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1

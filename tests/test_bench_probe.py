@@ -54,3 +54,50 @@ def test_second_wedge_fails_crisply():
     assert res.returncode == 2, (res.stdout, res.stderr[-500:])
     rep = _failed_line(res.stdout)
     assert "did not complete" in rep["metric"]
+
+
+def test_transient_init_error_healed_by_bounded_retry():
+    """A fast init error that clears on the second attempt (transient
+    tunnel hiccup) must be retried — KDTREE_TPU_DEVICE_INIT_RETRIES
+    bounds the attempts — and every attempt must land in the flight ring
+    with its reason."""
+    code = (
+        "import bench\n"
+        "calls = {'n': 0}\n"
+        "def flaky():\n"
+        "    calls['n'] += 1\n"
+        "    if calls['n'] == 1:\n"
+        "        raise RuntimeError('transient tunnel hiccup')\n"
+        "    return ['dev']\n"
+        "bench.jax.devices = flaky\n"
+        "init_s = bench._device_probe(30.0)\n"
+        "from kdtree_tpu.obs import flight\n"
+        "ev = [e for e in flight.recorder().snapshot()\n"
+        "      if e['type'] == 'bench.device_init']\n"
+        "assert [e['outcome'] for e in ev] == ['error', 'ok'], ev\n"
+        "assert 'hiccup' in ev[0]['reason'], ev\n"
+        "print('HEALED', calls['n'], init_s >= 0)\n"
+    )
+    res = _run(code, {"KDTREE_TPU_DEVICE_INIT_RETRIES": "2",
+                      "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 0, (res.stdout, res.stderr[-800:])
+    assert "HEALED 2 True" in res.stdout
+
+
+def test_exhausted_retries_still_fail_crisply():
+    """Retries are BOUNDED: a persistent init error exhausts them and
+    fails with the standard metric line, never silent CPU numbers."""
+    code = (
+        "import bench\n"
+        "calls = {'n': 0}\n"
+        "def broken():\n"
+        "    calls['n'] += 1\n"
+        "    raise RuntimeError('bad credentials')\n"
+        "bench.jax.devices = broken\n"
+        "bench._device_probe(30.0)\n"
+    )
+    res = _run(code, {"KDTREE_TPU_DEVICE_INIT_RETRIES": "1",
+                      "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 2, (res.stdout, res.stderr[-500:])
+    rep = _failed_line(res.stdout)
+    assert "bad credentials" in rep["metric"]

@@ -1,0 +1,829 @@
+"""Scatter/gather routing (docs/SERVING.md "Routing & fault tolerance").
+
+Two layers of evidence:
+
+1. **Exactness**: with every shard healthy, the routed answer is
+   byte-identical (ids AND distances) to the single-index oracle — over
+   in-process shards for speed, and over real multi-process
+   ``kdtree-tpu serve`` spawns for the acceptance e2e.
+2. **Robustness**: every injected fault class (latency, error, hang,
+   connection drop — ``serve/faults.py``) is pinned by a deterministic
+   test: the router meets its own deadline, failures surface as flagged
+   partial results or crisp 503s (never silent wrong answers), and the
+   faulty shard's breaker opens then recovers half-open → closed when
+   the fault clears.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import obs
+from kdtree_tpu.serve import faults as faults_mod
+from kdtree_tpu.serve import lifecycle
+from kdtree_tpu.serve import router as rt
+from kdtree_tpu.serve import server as srv
+
+REPO = Path(__file__).resolve().parents[1]
+DIM, K = 3, 4
+SHARD_N = 1024
+N_SHARDS = 3
+SEED = 7
+
+
+# ---------------------------------------------------------------------------
+# helpers / fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def points():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    return np.asarray(
+        generate_points_rowwise(SEED, DIM, N_SHARDS * SHARD_N)
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle_tree(points):
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import build_morton
+
+    return build_morton(jnp.asarray(points))
+
+
+def _oracle(tree, queries, k):
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    d2, ids = morton_knn_tiled(tree, jnp.asarray(queries), k=k)
+    return (
+        np.sqrt(np.asarray(d2).astype(np.float64)).tolist(),
+        np.asarray(ids).tolist(),
+    )
+
+
+class Shards:
+    """N in-process shard servers over a contiguous partition, each with
+    its own FaultSet — one shard faults, its neighbors don't."""
+
+    def __init__(self, points):
+        self.servers = []
+        self.faults = []
+        self.urls = []
+        for i in range(N_SHARDS):
+            sub = points[i * SHARD_N:(i + 1) * SHARD_N]
+            state = lifecycle.build_state(
+                points=sub, k=K, max_batch=64, id_offset=i * SHARD_N,
+            )
+            fset = faults_mod.FaultSet()
+            httpd = srv.make_server(state, port=0, faults=fset)
+            httpd.start(warmup_buckets=[8])
+            self.servers.append(httpd)
+            self.faults.append(fset)
+            self.urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    def clear_faults(self):
+        for f in self.faults:
+            f.clear()
+
+    def stop(self):
+        for httpd in self.servers:
+            httpd.stop()
+
+
+@pytest.fixture(scope="module")
+def shards(points):
+    sh = Shards(points)
+    yield sh
+    sh.clear_faults()
+    sh.stop()
+
+
+@contextlib.contextmanager
+def router_for(shards, health_loop=False, **cfg):
+    defaults = dict(deadline_s=30.0, retries=2, backoff_base_s=0.01,
+                    hedge_min_s=0.05, breaker_failures=2,
+                    breaker_reset_s=0.3, health_period_s=0.2)
+    defaults.update(cfg)
+    router = rt.make_router(shards.urls, config=rt.RouterConfig(**defaults))
+    router.start(health_loop=health_loop)
+    try:
+        yield router
+    finally:
+        router.stop()
+
+
+def _post(router, payload, timeout=120.0, headers=None):
+    url = f"http://127.0.0.1:{router.server_address[1]}/v1/knn"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(router, path, timeout=30.0):
+    url = f"http://127.0.0.1:{router.server_address[1]}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _queries(rows, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((rows, DIM)) * 200.0 - 100.0).astype(np.float32)
+
+
+def _counter(key):
+    return obs.get_registry().snapshot()["counters"].get(key, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# fault-spec + breaker + merge units
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    fs = faults_mod.parse_spec(
+        "knn=latency:250,healthz=error:503*2,knn=hang"
+    )
+    assert [f.kind for f in fs] == ["latency", "error", "hang"]
+    assert fs[0].param == 250.0 and fs[1].remaining == 2
+    assert faults_mod.parse_spec("") == []
+    for bad in ("knn", "knn=bogus", "knn=latency", "knn=error*0",
+                "knn=error*x", "=error", "knn=error:9000",
+                "knn=latency:abc",
+                # sites are a bounded enum: a typo'd site must be a
+                # parse error, never a silently-inert clause
+                "helthz=error", "kn=hang"):
+        with pytest.raises(faults_mod.FaultSpecError):
+            faults_mod.parse_spec(bad)
+
+
+def test_fault_budget_spends_deterministically():
+    fs = faults_mod.FaultSet("knn=error*2")
+    assert fs.fire("knn")["status"] == 500
+    assert fs.fire("knn")["kind"] == "error"
+    assert fs.fire("knn") is None  # spent
+    assert fs.fire("healthz") is None  # site mismatch never fires
+    assert fs.describe()[0]["fired"] == 2
+
+
+def test_fault_hang_param_is_milliseconds():
+    """hang's optional max-park bound shares latency's unit (ms): a
+    hang:50 releases itself in ~50 ms, not 50 s."""
+    fs = faults_mod.FaultSet("knn=hang:50")
+    t0 = time.monotonic()
+    assert fs.fire("knn") is None
+    assert time.monotonic() - t0 < 2.0
+    with pytest.raises(faults_mod.FaultSpecError):
+        faults_mod.parse_spec("knn=hang:-5")
+
+
+def test_fault_hang_releases_on_clear():
+    fs = faults_mod.FaultSet("knn=hang")
+    released = []
+
+    def victim():
+        fs.fire("knn")  # parks on the unblock event
+        released.append(time.monotonic())
+
+    t = threading.Thread(target=victim)
+    t.start()
+    time.sleep(0.1)
+    assert not released  # genuinely parked
+    fs.clear()
+    t.join(timeout=5)
+    assert released, "clear() must release a parked hang"
+
+
+def test_breaker_state_machine():
+    b = rt.CircuitBreaker(failures=2, reset_s=0.15)
+    assert b.allow()
+    b.record_failure()
+    assert b.state == rt.CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == rt.OPEN and not b.allow()
+    time.sleep(0.16)
+    assert b.allow()  # half-open probe
+    assert b.state == rt.HALF_OPEN
+    assert not b.allow()  # only ONE probe at a time
+    b.record_failure()  # probe failed: re-open for another cooldown
+    assert b.state == rt.OPEN and not b.allow()
+    time.sleep(0.16)
+    assert b.allow()
+    b.record_success()
+    assert b.state == rt.CLOSED and b.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = rt.CircuitBreaker(failures=2, reset_s=10.0)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == rt.CLOSED  # 2 failures, but not consecutive
+
+
+def test_merge_topk_matches_forest_tie_break():
+    a = {"k": 2, "ids": [[5, 1]], "distances": [[0.5, 1.5]]}
+    b = {"k": 2, "ids": [[2, 0]], "distances": [[1.5, 3.0]]}
+    dists, ids, kk = rt.merge_topk([a, b], 2)
+    # the 1.5 tie breaks by id (stable (distance, id) sort — the
+    # _merge_partials rule), so id 1 wins over id 2
+    assert kk == 2 and dists == [[0.5, 1.5]] and ids == [[5, 1]]
+    dists, ids, kk = rt.merge_topk([a, b], None)
+    assert kk == 2  # k defaults to the min shard k
+    dists, ids, kk = rt.merge_topk([a], 1)
+    assert ids == [[5]]
+
+
+# ---------------------------------------------------------------------------
+# exactness: routed == oracle
+# ---------------------------------------------------------------------------
+
+
+def test_routed_matches_oracle_in_process(shards, oracle_tree):
+    """All shards healthy: merged ids AND distances byte-identical to
+    the single-index oracle, per-request k respected, degraded null."""
+    with router_for(shards) as router:
+        for rows, k, seed in ((5, K, 1), (3, 2, 2), (8, 1, 3)):
+            q = _queries(rows, seed=seed)
+            status, out = _post(router, {"queries": q.tolist(), "k": k})
+            assert status == 200
+            dist, ids = _oracle(oracle_tree, q, k)
+            assert out["ids"] == ids
+            assert out["distances"] == dist
+            assert out["degraded"] is None
+            assert out["shards"] == {"total": N_SHARDS,
+                                     "answered": N_SHARDS, "missing": []}
+
+
+def test_router_trace_id_threads_to_shards(shards):
+    with router_for(shards) as router:
+        status, out = _post(router, {"queries": _queries(2).tolist()},
+                            headers={"X-Request-Id": "route-trace-1"})
+        assert status == 200
+        assert out["trace_id"] == "route-trace-1"
+        # the SAME id flows to every shard (X-Request-Id forwarded), so
+        # the shard-side flight rings correlate with the router's
+        from kdtree_tpu.obs import flight
+
+        events = flight.recorder().snapshot()
+        mine = [e for e in events if e.get("type") == "serve.request"
+                and e.get("trace") == "route-trace-1"]
+        assert len(mine) >= N_SHARDS
+
+
+# ---------------------------------------------------------------------------
+# fault classes: error / latency / hang / drop
+# ---------------------------------------------------------------------------
+
+
+def test_error_fault_healed_by_bounded_retry(shards):
+    """A transient error (2 bounded 503s) is absorbed by the retry
+    policy: the client sees a full, exact answer and the retry counter
+    moved."""
+    retry_key = 'kdtree_router_retries_total{shard="1"}'
+    shards.faults[1].set_spec("knn=error:503*2")
+    try:
+        # breaker threshold ABOVE the in-request failure count: this
+        # test is about retries healing, not the breaker opening
+        with router_for(shards, retries=2, breaker_failures=5) as router:
+            r0 = _counter(retry_key)
+            status, out = _post(router, {"queries": _queries(4).tolist()})
+            assert status == 200
+            assert out["degraded"] is None
+            assert out["shards"]["answered"] == N_SHARDS
+            assert _counter(retry_key) >= r0 + 2
+    finally:
+        shards.clear_faults()
+
+
+def test_error_fault_partial_then_breaker_opens(shards):
+    """A persistently erroring shard: responses degrade to flagged
+    partials (never 5xx, never silent wrong answers), the partial
+    counter moves, and the shard's breaker opens."""
+    partial_key = "kdtree_router_partial_total"
+    shards.faults[2].set_spec("knn=error")
+    try:
+        with router_for(shards, retries=1) as router:
+            p0 = _counter(partial_key)
+            for i in range(2):
+                status, out = _post(
+                    router, {"queries": _queries(4, seed=i).tolist()}
+                )
+                assert status == 200
+                assert out["degraded"] == f"partial:2/{N_SHARDS}"
+                assert out["shards"]["missing"] == [2]
+            assert _counter(partial_key) == p0 + 2
+            report = router.shard_report()
+            assert report[2]["breaker"] == "open"
+            assert not report[2]["routable"]
+            # breaker state is live on the router's registry too
+            gauges = obs.get_registry().snapshot()["gauges"]
+            assert gauges['kdtree_router_breaker_state{shard="2"}'] == rt.OPEN
+    finally:
+        shards.clear_faults()
+
+
+def test_breaker_recovers_half_open_to_closed(shards, oracle_tree):
+    """Fault cleared: after the cooldown the half-open probe succeeds
+    and the breaker closes — the shard is back in every merge."""
+    shards.faults[0].set_spec("knn=error")
+    try:
+        with router_for(shards, retries=0,
+                        breaker_reset_s=0.25) as router:
+            for i in range(2):  # 2 consecutive failures open the breaker
+                _post(router, {"queries": _queries(3, seed=i).tolist()})
+            assert router.shard_report()[0]["breaker"] == "open"
+            shards.clear_faults()
+            time.sleep(0.3)  # past the cooldown: next allow() is the probe
+            q = _queries(5, seed=9)
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+            assert status == 200
+            assert out["degraded"] is None
+            dist, ids = _oracle(oracle_tree, q, K)
+            assert out["ids"] == ids and out["distances"] == dist
+            assert router.shard_report()[0]["breaker"] == "closed"
+            trans = _counter(
+                'kdtree_router_breaker_transitions_total'
+                '{shard="0",to="closed"}'
+            )
+            assert trans >= 1
+    finally:
+        shards.clear_faults()
+
+
+def test_latency_fault_triggers_hedge_still_exact(shards, oracle_tree):
+    """A slow shard (injected latency past the hedge delay) fires a
+    hedge; the answer stays full and exact, within the deadline."""
+    hedge_key = 'kdtree_router_hedges_total{shard="1"}'
+    shards.faults[1].set_spec("knn=latency:400")
+    try:
+        with router_for(shards, deadline_s=10.0,
+                        hedge_min_s=0.05) as router:
+            h0 = _counter(hedge_key)
+            q = _queries(4, seed=11)
+            t0 = time.monotonic()
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+            elapsed = time.monotonic() - t0
+            assert status == 200 and out["degraded"] is None
+            dist, ids = _oracle(oracle_tree, q, K)
+            assert out["ids"] == ids and out["distances"] == dist
+            assert _counter(hedge_key) >= h0 + 1
+            assert elapsed < 10.0  # well inside the deadline
+    finally:
+        shards.clear_faults()
+
+
+def test_hang_fault_partial_within_deadline(shards):
+    """A hung shard: the router answers a flagged partial no later than
+    deadline + one hedge interval (the acceptance bound), and the shard
+    handler is released by the fault clear, not the router."""
+    deadline_s = 1.0
+    shards.faults[2].set_spec("knn=hang")
+    try:
+        with router_for(shards, deadline_s=deadline_s, retries=0,
+                        hedge_min_s=0.05) as router:
+            t0 = time.monotonic()
+            status, out = _post(router, {"queries": _queries(3).tolist()})
+            elapsed = time.monotonic() - t0
+            assert status == 200
+            assert out["degraded"] == f"partial:2/{N_SHARDS}"
+            assert out["shards"]["missing"] == [2]
+            assert elapsed < deadline_s + 0.05 + 1.0  # deadline + hedge + slack
+    finally:
+        shards.clear_faults()
+
+
+def test_drop_fault_partial_and_fast(shards):
+    """A connection-dropping shard fails FAST (protocol error, not a
+    timeout): the partial answer arrives in a fraction of the deadline
+    and the attempt counter records a network outcome."""
+    net_key = 'kdtree_router_shard_attempts_total{outcome="network",shard="0"}'
+    shards.faults[0].set_spec("knn=drop")
+    try:
+        with router_for(shards, deadline_s=5.0, retries=0) as router:
+            n0 = _counter(net_key)
+            t0 = time.monotonic()
+            status, out = _post(router, {"queries": _queries(3).tolist()})
+            elapsed = time.monotonic() - t0
+            assert status == 200
+            assert out["degraded"] == f"partial:2/{N_SHARDS}"
+            assert out["shards"]["missing"] == [0]
+            assert elapsed < 2.0  # drop is fast, nothing waited out 5 s
+            assert _counter(net_key) >= n0 + 1
+    finally:
+        shards.clear_faults()
+
+
+def test_below_quorum_crisp_503_never_silent(shards):
+    """Two of three shards erroring (majority quorum = 2): a crisp 503
+    naming the failing shards — a sub-quorum merge must never pass as an
+    answer."""
+    shards.faults[0].set_spec("knn=error")
+    shards.faults[1].set_spec("knn=error")
+    try:
+        with router_for(shards, retries=0) as router:
+            status, out = _post(router, {"queries": _queries(3).tolist()})
+            assert status == 503
+            assert "quorum" in out["error"]
+            assert out["shards"]["missing"] == [0, 1]
+            assert _counter(
+                'kdtree_router_requests_total{status="unavailable"}'
+            ) >= 1
+    finally:
+        shards.clear_faults()
+
+
+def test_client_error_propagates_not_retried(shards):
+    """k beyond the shards' compiled cap is the CLIENT's error: the
+    router propagates the 400 instead of retrying it into a partial."""
+    with router_for(shards) as router:
+        status, out = _post(
+            router, {"queries": _queries(2).tolist(), "k": K + 1}
+        )
+        assert status == 400
+        assert "k" in out["error"]
+        # malformed router-level bodies reject at the router itself
+        assert _post(router, {"nope": 1})[0] == 400
+        assert _post(router, {"queries": [[0.0] * DIM], "k": 0})[0] == 400
+
+
+# ---------------------------------------------------------------------------
+# health aggregation + ejection
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_aggregates_and_ejects(shards):
+    with router_for(shards) as router:
+        for shard in router.shards:
+            router._probe_health(shard)
+        status, body = _get(router, "/healthz")
+        assert status == 200 and body["status"] == "ok"
+        assert body["available"] == N_SHARDS and body["quorum"] == 2
+        # fail one shard's health endpoint: the probe ejects it, the
+        # aggregate stays 200 (quorum still holds) and names it
+        shards.faults[1].set_spec("healthz=error:503")
+        try:
+            router._probe_health(router.shards[1])
+            status, body = _get(router, "/healthz")
+            assert status == 200 and body["available"] == N_SHARDS - 1
+            assert body["shards"][1]["healthy"] is False
+            assert not body["shards"][1]["routable"]
+            # an ejected shard is skipped by the scatter: partial answer
+            # without burning the deadline on a known-bad shard
+            status, out = _post(router, {"queries": _queries(2).tolist()})
+            assert status == 200
+            assert out["degraded"] == f"partial:2/{N_SHARDS}"
+        finally:
+            shards.clear_faults()
+        router._probe_health(router.shards[1])
+        assert router.shards[1].healthy
+        status, body = _get(router, "/debug/shards")
+        assert status == 200 and len(body["shards"]) == N_SHARDS
+
+
+def test_healthz_below_quorum_503(shards):
+    with router_for(shards) as router:
+        shards.faults[0].set_spec("healthz=error:503")
+        shards.faults[1].set_spec("healthz=error:503")
+        try:
+            for shard in router.shards:
+                router._probe_health(shard)
+            status, body = _get(router, "/healthz")
+            assert status == 503 and body["status"] == "unavailable"
+            assert body["available"] == 1
+        finally:
+            shards.clear_faults()
+        for shard in router.shards:
+            router._probe_health(shard)
+
+
+# ---------------------------------------------------------------------------
+# Retry-After honored
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedShard:
+    """A stub shard: scripted (status, headers, body) responses, so shed
+    semantics are tested without timing a real queue into 429."""
+
+    def __init__(self, script):
+        import http.server
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(length)
+                status, headers, body = stub.script.pop(0)
+                stub.served.append((time.monotonic(), status))
+                raw = json.dumps(body).encode()
+                self.send_response(status)
+                for key, val in headers.items():
+                    self.send_header(key, val)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+        self.script = list(script)
+        self.served = []
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.thread = threading.Thread(target=self.httpd.serve_forever)
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.thread.join()
+        self.httpd.server_close()
+
+
+def test_router_honors_shard_retry_after():
+    """A shard shedding with Retry-After: 1 must not see its retry
+    before that second has passed — the shard's measured advice outranks
+    the router's generic backoff schedule."""
+    ok_body = {"k": 1, "ids": [[3]], "distances": [[0.25]],
+               "degraded": None, "trace_id": ""}
+    stub = _ScriptedShard([
+        (429, {"Retry-After": "1"}, {"error": "overloaded"}),
+        (200, {}, ok_body),
+    ])
+    try:
+        router = rt.make_router(
+            [stub.url],
+            config=rt.RouterConfig(deadline_s=10.0, retries=2, quorum=1,
+                                   backoff_base_s=0.01),
+        )
+        router.start(health_loop=False)
+        try:
+            status, out = _post(router, {"queries": [[0.0] * DIM]})
+            assert status == 200 and out["ids"] == [[3]]
+            assert len(stub.served) == 2
+            gap = stub.served[1][0] - stub.served[0][0]
+            assert gap >= 0.9, f"retried after only {gap:.2f}s"
+            shed = _counter(
+                'kdtree_router_shard_attempts_total'
+                '{outcome="shed",shard="0"}'
+            )
+            assert shed >= 1
+        finally:
+            router.stop()
+    finally:
+        stub.stop()
+
+
+# ---------------------------------------------------------------------------
+# shutdown under partial failure
+# ---------------------------------------------------------------------------
+
+
+def test_half_open_probe_answered_with_4xx_closes_breaker():
+    """A 4xx is the shard ANSWERING: a half-open probe that draws a
+    client error must release the probe slot and close the breaker —
+    the leak would otherwise refuse the shard forever."""
+    ok_body = {"k": 1, "ids": [[3]], "distances": [[0.25]],
+               "degraded": None, "trace_id": ""}
+    stub = _ScriptedShard([
+        (500, {}, {"error": "boom"}),
+        (500, {}, {"error": "boom"}),
+        (400, {}, {"error": "bad k"}),   # the half-open probe
+        (200, {}, ok_body),
+    ])
+    try:
+        router = rt.make_router(
+            [stub.url],
+            config=rt.RouterConfig(deadline_s=10.0, retries=0, quorum=1,
+                                   breaker_failures=2, breaker_reset_s=0.2),
+        )
+        router.start(health_loop=False)
+        try:
+            for _ in range(2):  # open the breaker
+                assert _post(router, {"queries": [[0.0] * DIM]})[0] == 503
+            assert router.shards[0].breaker.state == rt.OPEN
+            time.sleep(0.25)
+            # the probe: shard answers 400 -> propagated, breaker CLOSED
+            assert _post(router, {"queries": [[0.0] * DIM]})[0] == 400
+            assert router.shards[0].breaker.state == rt.CLOSED
+            status, out = _post(router, {"queries": [[0.0] * DIM]})
+            assert status == 200 and out["ids"] == [[3]]
+        finally:
+            router.stop()
+    finally:
+        stub.stop()
+
+
+def test_shutdown_mid_fanout_drains_in_flight_scatter(shards):
+    """SIGTERM contract (cmd_route wires SIGTERM to exactly this
+    ``stop()``): stopping the router while a scatter is mid-flight — one
+    shard hung — still answers the in-flight request (partial or
+    complete, never dropped), and stop() returns with every handler and
+    scatter thread joined, no shard connection orphaned."""
+    deadline_s = 1.2
+    shards.faults[1].set_spec("knn=hang")
+    try:
+        router = rt.make_router(
+            shards.urls,
+            config=rt.RouterConfig(deadline_s=deadline_s, retries=0,
+                                   hedge_min_s=0.05),
+        )
+        router.start(health_loop=False)
+        out = [None]
+
+        def client():
+            try:
+                out[0] = _post(router, {"queries": _queries(3).tolist()},
+                               timeout=30.0)
+            except OSError as e:  # a dropped in-flight request fails the test
+                out[0] = ("refused", repr(e))
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.3)  # the scatter is now mid-flight, shard 1 hung
+        t0 = time.monotonic()
+        router.stop()  # must drain, not drop
+        stop_elapsed = time.monotonic() - t0
+        t.join(timeout=30)
+        assert out[0] is not None and out[0][0] == 200, out[0]
+        assert out[0][1]["degraded"] == f"partial:2/{N_SHARDS}"
+        # stop() waited for the in-flight scatter but not much longer
+        assert stop_elapsed < deadline_s + 5.0
+        # post-stop requests are refused at the TCP level
+        with pytest.raises(OSError):
+            _post(router, {"queries": _queries(2).tolist()}, timeout=2)
+    finally:
+        shards.clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: multi-process spawn
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def spawned_shards(points, tmp_path_factory):
+    """Three REAL ``kdtree-tpu serve`` processes over a contiguous
+    3-way partition, global ids via --id-offset."""
+    tmp = tmp_path_factory.mktemp("route-shards")
+    procs, logs, urls = [], [], []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for i in range(N_SHARDS):
+        shard_file = tmp / f"shard{i}.npy"
+        np.save(shard_file, points[i * SHARD_N:(i + 1) * SHARD_N])
+        log = open(tmp / f"serve{i}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kdtree_tpu", "--platform", "cpu",
+             "serve", "--points", str(shard_file), "--port", "0",
+             "--k", str(K), "--max-batch", "8", "--debug-faults",
+             "--id-offset", str(i * SHARD_N)],
+            cwd=REPO, env=env, stderr=log,
+            stdout=subprocess.DEVNULL,
+        )
+        procs.append(proc)
+        logs.append(tmp / f"serve{i}.log")
+    try:
+        deadline = time.monotonic() + 180
+        for i in range(N_SHARDS):
+            port = None
+            while time.monotonic() < deadline:
+                if procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"shard {i} died: {logs[i].read_text()[-2000:]}"
+                    )
+                for line in logs[i].read_text().splitlines():
+                    if line.startswith("ready:"):
+                        port = int(line.rsplit("port", 1)[1].strip())
+                        break
+                if port is not None:
+                    break
+                time.sleep(0.2)
+            assert port is not None, f"shard {i} never became ready"
+            urls.append(f"http://127.0.0.1:{port}")
+        yield urls
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                assert proc.wait(timeout=60) == 0  # graceful drain, exit 0
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+
+
+def test_multiprocess_routed_byte_identical_to_oracle(
+    spawned_shards, oracle_tree,
+):
+    """THE acceptance e2e: three real serve processes, routed answers
+    byte-identical (ids and distances) to the single-index oracle."""
+    router = rt.make_router(
+        spawned_shards, config=rt.RouterConfig(deadline_s=60.0)
+    )
+    router.start(health_loop=True)
+    try:
+        for rows, k, seed in ((5, K, 21), (7, 2, 22)):
+            q = _queries(rows, seed=seed)
+            status, out = _post(router, {"queries": q.tolist(), "k": k},
+                                timeout=120.0)
+            assert status == 200
+            dist, ids = _oracle(oracle_tree, q, k)
+            assert out["ids"] == ids
+            assert out["distances"] == dist
+            assert out["degraded"] is None
+    finally:
+        router.stop()
+
+
+def test_multiprocess_fault_injection_over_http(spawned_shards):
+    """The drill an operator would run: arm a hang fault on one REAL
+    shard process via POST /debug/faults, watch the routed answer go
+    partial inside the deadline, clear the fault, watch it recover."""
+    def arm(url, spec):
+        req = urllib.request.Request(
+            f"{url}/debug/faults",
+            data=json.dumps(spec).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    router = rt.make_router(
+        spawned_shards,
+        config=rt.RouterConfig(deadline_s=1.5, retries=0, hedge_min_s=0.05,
+                               breaker_failures=2, breaker_reset_s=0.3),
+    )
+    router.start(health_loop=False)
+    try:
+        armed = arm(spawned_shards[2], {"spec": "knn=hang"})
+        assert armed["active"][0]["kind"] == "hang"
+        status, out = _post(router, {"queries": _queries(3).tolist()},
+                            timeout=30.0)
+        assert status == 200
+        assert out["degraded"] == f"partial:2/{N_SHARDS}"
+        armed = arm(spawned_shards[2], {"clear": True})
+        assert armed["active"] == []
+        status, out = _post(router, {"queries": _queries(3).tolist()},
+                            timeout=30.0)
+        assert status == 200 and out["degraded"] is None
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+def test_router_config_validation():
+    with pytest.raises(ValueError):
+        rt.RouterConfig(quorum=5).resolve_quorum(3)
+    with pytest.raises(ValueError):
+        rt.RouterConfig(quorum=0).resolve_quorum(3)
+    assert rt.RouterConfig().resolve_quorum(3) == 2
+    assert rt.RouterConfig(quorum=3).resolve_quorum(3) == 3
+    with pytest.raises(ValueError):
+        rt.make_router([])
+    with pytest.raises(ValueError):
+        rt.ShardState(0, "ftp://x", rt.CircuitBreaker())
+
+
+def test_route_cli_needs_shards(capsys):
+    from kdtree_tpu.utils import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["route"])
+    assert e.value.code == 1
+    assert "--shard" in capsys.readouterr().err

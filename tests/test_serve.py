@@ -53,13 +53,14 @@ def server(tree):
 
 
 @contextlib.contextmanager
-def fresh_server(tree, *, max_wait_ms=1.0, queue_rows=None, start_batcher=True):
+def fresh_server(tree, *, max_wait_ms=1.0, queue_rows=None,
+                 start_batcher=True, faults=None):
     """A per-test server on an ephemeral port, readiness flipped without
     the warmup ladder (``warmup(buckets=[])`` runs zero compiles), torn
     down even when the test body raises."""
     state = lifecycle.build_state(tree=tree, k=K, max_batch=64)
     httpd = srv.make_server(state, port=0, max_wait_ms=max_wait_ms,
-                            queue_rows=queue_rows)
+                            queue_rows=queue_rows, faults=faults)
     accept = threading.Thread(target=httpd.serve_forever)
     accept.start()
     if start_batcher:
@@ -364,6 +365,184 @@ def test_queue_full_sheds_429(tree):
         httpd.batcher.start()  # drain so client A completes
         ta.join()
         assert first[0][0] == 200
+
+
+def test_shed_429_carries_measured_retry_after(tree):
+    """Every 429 must advise a concrete Retry-After (integer seconds,
+    derived from the admission queue's drain rate) — the router's
+    backoff honors it, and so should any other client."""
+    with fresh_server(tree, queue_rows=8, start_batcher=False) as httpd:
+        first = [None]
+
+        def client_a():
+            first[0] = _post(httpd, {"queries": _queries(5, seed=30).tolist()})
+
+        ta = threading.Thread(target=client_a)
+        ta.start()
+        deadline = time.monotonic() + 10
+        while httpd.queue.rows < 5 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        req = urllib.request.Request(
+            _url(httpd, "/v1/knn"),
+            data=json.dumps({"queries": _queries(5, seed=31).tolist()}
+                            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 429
+        retry_after = e.value.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        httpd.batcher.start()
+        ta.join()
+
+
+def test_retry_after_tracks_drain_rate():
+    """Unit for the derivation: a measured drain rate turns backlog into
+    seconds; no history or no backlog falls back to the 1 s floor, and
+    the estimate is clamped to the [1, 30] s advisory band."""
+    from kdtree_tpu.serve.admission import AdmissionQueue
+
+    q = AdmissionQueue(max_rows=100)
+    assert q.retry_after_s(10) == 1.0  # no backlog, floor
+    q.reserve(100)  # saturate the budget
+    assert q.retry_after_s(50) == 1.0  # backlog but no drain history yet
+    now = time.monotonic()
+    with q._cond:
+        for i in range(5):
+            q._note_pop(10, now=now - 5.0 + i)  # 10 rows/s measured
+    # needs 50 rows freed at 10 rows/s -> ~5 s advised
+    assert 4.0 <= q.retry_after_s(50, now=now) <= 7.0
+    # a huge backlog clamps to the advisory max
+    with q._cond:
+        q._pops.clear()
+        for i in range(5):
+            q._note_pop(1, now=now - 5.0 + i)  # 1 row/s
+    assert q.retry_after_s(100, now=now) == 30.0
+
+
+def test_debug_faults_endpoint_disabled_by_default(tree):
+    """POST /debug/faults is a remote wedge-this-process button: without
+    --debug-faults / KDTREE_TPU_FAULTS / an explicit fault set, arming
+    must be refused (403), never ambient on a production server."""
+    with fresh_server(tree) as httpd:
+        assert httpd.faults_mutable is False
+        req = urllib.request.Request(
+            _url(httpd, "/debug/faults"),
+            data=json.dumps({"spec": "knn=error"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 403
+        status, body = _get(httpd, "/debug/faults")
+        assert status == 200
+        listing = json.loads(body)
+        assert listing == {"enabled": False, "active": []}
+
+
+def test_debug_faults_endpoint_arms_fires_and_clears(tree):
+    """The injection drill over HTTP: arm an error fault, watch it fire
+    with its budget spent, list it, clear it, watch traffic recover."""
+    from kdtree_tpu.serve import faults as faults_mod
+
+    with fresh_server(tree, faults=faults_mod.FaultSet()) as httpd:
+        payload = {"queries": _queries(2, seed=40).tolist()}
+        req = urllib.request.Request(
+            _url(httpd, "/debug/faults"),
+            data=json.dumps({"spec": "knn=error:503*1"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            armed = json.loads(r.read())
+        assert armed["active"][0]["kind"] == "error"
+        status, body = _post(httpd, payload)
+        assert status == 503 and "injected fault" in body["error"]
+        status, _ = _post(httpd, payload)  # budget of 1 is spent
+        assert status == 200
+        status, body = _get(httpd, "/debug/faults")
+        assert status == 200
+        assert json.loads(body)["active"][0]["fired"] == 1
+        # malformed specs reject crisply, naming the bad clause
+        req = urllib.request.Request(
+            _url(httpd, "/debug/faults"),
+            data=json.dumps({"spec": "knn=bogus"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+        # {"clear": false} is neither an arm nor a clear: crisp 400,
+        # never a KeyError-dropped connection
+        req = urllib.request.Request(
+            _url(httpd, "/debug/faults"),
+            data=json.dumps({"clear": False}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+        req = urllib.request.Request(
+            _url(httpd, "/debug/faults"),
+            data=json.dumps({"clear": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["active"] == []
+
+
+def test_injected_error_keeps_keepalive_connection_synced(tree):
+    """An injected error answers before the engine runs — but it must
+    still consume the request body, or a keep-alive client's NEXT
+    request line would be parsed out of the unread JSON."""
+    import http.client
+
+    with fresh_server(tree) as httpd:
+        httpd.faults.set_spec("knn=error:503*1")
+        body = json.dumps({"queries": _queries(2, seed=50).tolist()})
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          httpd.server_address[1],
+                                          timeout=30)
+        try:
+            conn.request("POST", "/v1/knn", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 503
+            resp.read()
+            # SAME connection: the fault budget is spent, and the stream
+            # must still be request-aligned
+            conn.request("POST", "/v1/knn", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["degraded"] is None
+        finally:
+            conn.close()
+
+
+def test_id_offset_shifts_answered_ids(tree):
+    """Sharded serving answers GLOBAL ids: the same index served with an
+    --id-offset answers every id shifted by exactly that offset."""
+    offset = 100000
+    state = lifecycle.build_state(tree=tree, k=K, max_batch=64,
+                                  id_offset=offset)
+    httpd = srv.make_server(state, port=0)
+    accept = threading.Thread(target=httpd.serve_forever)
+    accept.start()
+    httpd.batcher.start()
+    state.warmup(buckets=[])
+    try:
+        q = _queries(3, seed=41)
+        status, body = _post(httpd, {"queries": q.tolist(), "k": 2})
+        assert status == 200
+        dist, ids = _oracle(tree, q, 2)
+        assert body["ids"] == [[i + offset for i in row] for row in ids]
+        assert body["distances"] == dist  # distances untouched
+    finally:
+        httpd.shutdown()
+        accept.join()
+        httpd.batcher.stop()
+        httpd.server_close()
 
 
 def test_deadline_falls_back_to_bruteforce_degraded(tree):

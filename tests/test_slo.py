@@ -308,11 +308,23 @@ def test_slo_chain_end_to_end_page_and_recover(tree, tmp_path, monkeypatch):
         assert status == 200  # readiness STAYS; the slo block degrades
         assert hz["slo"]["state"] == "PAGE"
         assert hz["slo"]["slos"]["shed-rate"]["state"] == "PAGE"
+        # the incident pair is written ASYNCHRONOUSLY on the sampler
+        # thread after the PAGE gauge flips — flight file first, then
+        # the (much larger) history companion, whose serialization can
+        # take seconds once the process registry has grown (hundreds of
+        # series by this point of a full tier-1 run) — so poll, don't
+        # assert instantly
         dump_path = tmp_path / "flight-slo-shed-rate.json"
+        companion = tmp_path / "history-slo-shed-rate.json"
+        dump_deadline = time.monotonic() + 30.0
+        while not (dump_path.exists() and companion.exists()) and \
+                time.monotonic() < dump_deadline:
+            time.sleep(0.1)
         assert dump_path.exists(), "no flight dump naming the burning SLO"
         dump = json.loads(dump_path.read_text())
         assert dump["reason"] == "slo-shed-rate"
-        assert (tmp_path / "history-slo-shed-rate.json").exists()
+        assert companion.exists(), \
+            "flight dump written without its history companion"
     finally:
         stop_load.set()
         for t in threads:
