@@ -105,9 +105,9 @@ R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
     "int()/float()/bool() of device values) inside ops/, parallel/, "
-    "pallas/, serve/ functions unless inside an obs.defer callback or an "
-    "HTTP handler class (BaseHTTPRequestHandler subclasses legitimately "
-    "materialize responses)",
+    "pallas/, serve/, mutable/ functions unless inside an obs.defer "
+    "callback or an HTTP handler class (BaseHTTPRequestHandler "
+    "subclasses legitimately materialize responses)",
     "a per-batch bool(overflow) fetch serialized the async dispatch loop "
     "~8x at the 10M-query north-star shape (PR 1); obs.defer exists "
     "precisely so metrics fetches leave the hot path — and the serving "
@@ -549,7 +549,7 @@ def check_client_without_timeout(ctx) -> Iterator[Finding]:
 # KDT201 — sync-in-hot-path
 # --------------------------------------------------------------------------
 
-_HOT_DIRS = ("ops", "parallel", "pallas", "serve")
+_HOT_DIRS = ("ops", "parallel", "pallas", "serve", "mutable")
 # HTTP handler glue is the sanctioned response-materialization boundary:
 # a do_POST that np.asarray()s a result into JSON is the endpoint working
 # as designed, not a hot-path sync. Detected by base-class name (the

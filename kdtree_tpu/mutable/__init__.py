@@ -1,0 +1,44 @@
+"""kdtree_tpu.mutable — the write path: an LSM-shaped mutable index.
+
+Every engine in this repo builds once and serves a frozen snapshot —
+the course reference's batch shape (generate → build → query → exit).
+Real serving traffic inserts and deletes, so this package converts the
+serving stack into a vector-store-shaped system (ROADMAP direction 2)
+without giving up the repo's core invariant: **answers are exact at
+every moment**, byte-identical to a rebuild-from-scratch index over the
+surviving points.
+
+- :mod:`~kdtree_tpu.mutable.delta` — the L0: a small brute-force-exact
+  buffer of upserted rows in the same padded flat-storage shape the
+  serving degradation path already queries (+inf coords, -1 ids);
+- :mod:`~kdtree_tpu.mutable.merge` — the exact (distance, id) host
+  merge shared in spirit with the SPMD forest and the serving router;
+- :mod:`~kdtree_tpu.mutable.engine` — :class:`MutableEngine`: the
+  write-capable facade (upsert / delete / overlay query / masked
+  degradation path) and the background epoch rebuilder that compacts
+  main+delta into a fresh Morton tree and swaps it in atomically
+  between batches (generation-numbered epochs, ``kdtree_epoch``).
+
+Serving wires this through ``POST /v1/upsert`` / ``POST /v1/delete``
+(docs/SERVING.md "Mutable index"); the router forwards writes to the
+owning shard by id range.
+"""
+
+from __future__ import annotations
+
+from kdtree_tpu.mutable.delta import DeltaBuffer
+from kdtree_tpu.mutable.engine import (
+    DEFAULT_MAX_DELTA_FRAC,
+    DEFAULT_MAX_DELTA_ROWS,
+    MutableEngine,
+)
+from kdtree_tpu.mutable.merge import in_sorted, merge_rows
+
+__all__ = [
+    "DEFAULT_MAX_DELTA_FRAC",
+    "DEFAULT_MAX_DELTA_ROWS",
+    "DeltaBuffer",
+    "MutableEngine",
+    "in_sorted",
+    "merge_rows",
+]

@@ -1,0 +1,616 @@
+"""The mutable index engine: LSM-style overlay + zero-downtime epochs.
+
+``MutableEngine`` wraps the serving facade
+(:class:`~kdtree_tpu.serve.lifecycle.ServeEngine`) with a write path
+while keeping every answer **exact at every moment**:
+
+- **Upserts** land in a small brute-force :class:`DeltaBuffer`; if the
+  id already exists in the main tree, the main copy is *masked*
+  (tombstoned in place on the device flat storage — +inf coordinates,
+  -1 id, exactly the padding convention every engine already prunes).
+- **Deletes** drop the delta copy and mask the main copy.
+- **Queries** run the warm tiled main-tree dispatch unchanged, then
+  overlay: mask tombstoned ids out of the main hits, brute-force the
+  delta buffer (same kernel as the proven degradation path), and merge
+  by the stable (distance, id) order. A row whose main top-k lost a
+  masked hit is re-answered through the masked flat storage — the main
+  survivors alone might be one candidate short at the k boundary — so
+  the result is byte-identical to a rebuild-from-scratch index over the
+  surviving points, always.
+
+A background **epoch rebuilder** compacts main+delta into a fresh Morton
+tree once the write backlog (delta rows + tombstones) crosses the
+configured threshold, pre-warms it, and swaps it in atomically between
+batches: queries snapshot the epoch state per call, so an in-flight
+batch finishes on the epoch it started on and the next batch runs on the
+new one — zero downtime, zero dropped or double answers. Writes that
+arrive during a rebuild apply live AND append to a journal that is
+replayed onto the new epoch before the swap, so nothing is lost.
+
+Threading model: one RLock serializes writers, epoch swaps, and the
+per-query snapshot read; queries hold it only long enough to copy
+references. Nothing inside the lock ever blocks on the device — masking
+and delta-view refreshes are async dispatches/transfers, and the
+expensive host fetches (epoch snapshot, rebuild) run on the rebuild
+thread outside the lock (lint rule KDT201 covers this package).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kdtree_tpu import obs
+from kdtree_tpu.mutable.delta import MIN_CAPACITY, DeltaBuffer
+from kdtree_tpu.mutable.merge import in_sorted, merge_rows
+from kdtree_tpu.obs import flight
+from kdtree_tpu.tuning.store import _pow2_ceil
+
+DEFAULT_MAX_DELTA_ROWS = 4096
+DEFAULT_MAX_DELTA_FRAC = 0.25
+MAX_ID = 2**31  # local ids must fit the engines' int32 gid storage
+_CORRECTION_MIN_BUCKET = 8  # pow2 pad floor for the re-answer dispatch
+
+
+class _EpochState:
+    """Everything one epoch serves from. Queries snapshot references to
+    these fields; writers replace the replaced-on-write fields (masked
+    arrays, sorted-id arrays) instead of mutating them, so a snapshot
+    taken before a write stays internally consistent."""
+
+    def __init__(self, inner, epoch: int, min_cap: int) -> None:
+        self.inner = inner
+        self.epoch = int(epoch)
+        self.n_main = int(inner.tree.n_real)
+        self.delta = DeltaBuffer(inner.tree.dim, min_capacity=min_cap)
+        self.dead: set = set()  # masked main ids: deleted or superseded
+        self.dead_sorted = np.empty(0, dtype=np.int64)
+        # masked flat storage starts as the tree's own flat views; each
+        # mask batch produces new device arrays via .at[].set (async
+        # dispatch, no host sync)
+        self.masked_pts = inner._flat_pts
+        self.masked_gid = inner._flat_gid
+        # main id -> flat position, for masking and shadow detection.
+        # One host fetch per EPOCH (construction / rebuild thread), not
+        # per query or per write.
+        flat_gid = np.asarray(inner._flat_gid).reshape(-1)  # kdt-lint: disable=KDT201 once-per-epoch id-map construction, off the query and write hot paths
+        valid = flat_gid >= 0
+        order = np.argsort(flat_gid[valid], kind="stable")
+        self.gid_sorted = flat_gid[valid][order].astype(np.int64)
+        self.gid_pos = np.nonzero(valid)[0][order]
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """Flat positions of main-tree ids (-1 where absent)."""
+        if self.gid_sorted.size == 0:
+            return np.full(ids.shape, -1, dtype=np.int64)
+        idx = np.searchsorted(self.gid_sorted, ids)
+        idx_c = np.minimum(idx, self.gid_sorted.size - 1)
+        ok = (idx < self.gid_sorted.size) & (self.gid_sorted[idx_c] == ids)
+        return np.where(ok, self.gid_pos[idx_c], -1)
+
+    def apply_masks(self, positions: List[int]) -> None:
+        """Tombstone flat rows in place on the device copy: +inf
+        coordinates (never selected while real candidates remain) and
+        -1 ids (the padding id every downstream mask already drops).
+        Async dispatch — no sync, safe under the engine lock."""
+        if not positions:
+            return
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(np.array(positions, dtype=np.int32))  # kdt-lint: disable=KDT201 positions is a host-built int list (no device value); this packs it for the async .at[].set dispatch
+        self.masked_pts = self.masked_pts.at[idx].set(jnp.inf)
+        self.masked_gid = self.masked_gid.at[idx].set(-1)
+
+    def refresh_dead(self) -> None:
+        self.dead_sorted = np.array(sorted(self.dead), dtype=np.int64)  # kdt-lint: disable=KDT201 self.dead is a host-side python set of ids, not a device value
+
+    def backlog(self) -> int:
+        """Write backlog that the epoch rebuild compacts away: live
+        delta rows, masked main rows, AND dropped delta slots (holes
+        are garbage only a compaction reclaims — without counting them
+        an upsert-then-delete churn workload would double the buffer
+        forever while the gauge read ~0)."""
+        return self.delta.rows + len(self.dead) + self.delta.holes
+
+
+class _Snapshot:
+    """One query's consistent view of the epoch (plain references)."""
+
+    __slots__ = ("inner", "epoch", "delta_rows", "delta_view",
+                 "dead_sorted", "masked_pts", "masked_gid")
+
+    def __init__(self, st: _EpochState) -> None:
+        self.inner = st.inner
+        self.epoch = st.epoch
+        self.delta_rows = st.delta.rows
+        self.delta_view = st.delta.view() if self.delta_rows else None
+        self.dead_sorted = st.dead_sorted
+        self.masked_pts = st.masked_pts
+        self.masked_gid = st.masked_gid
+
+    @property
+    def empty(self) -> bool:
+        return self.delta_rows == 0 and self.dead_sorted.size == 0
+
+
+class MutableEngine:
+    """The write-capable engine facade the serving stack dispatches
+    through. Duck-compatible with
+    :class:`~kdtree_tpu.serve.lifecycle.ServeEngine` (``tree``, ``k``,
+    ``knn_batch``, ``fallback_knn``) plus the write path
+    (``upsert``/``delete``), epoch introspection, and ``close``."""
+
+    def __init__(
+        self,
+        inner,
+        max_delta_rows: int = DEFAULT_MAX_DELTA_ROWS,
+        max_delta_frac: float = DEFAULT_MAX_DELTA_FRAC,
+        requested_k: Optional[int] = None,
+    ) -> None:
+        self._lock = threading.RLock()
+        # the CONFIGURED k, not inner.k: the bootstrap ServeEngine clamps
+        # k to its n_real, and pinning that clamp as the forever-k would
+        # cap every future epoch at the seed index's size (a 5-point
+        # bootstrap would lock a --k 16 server at k<=5 after 10k upserts)
+        self._k_cfg = int(requested_k) if requested_k is not None \
+            else int(inner.k)
+        self._min_cap = max(MIN_CAPACITY, _pow2_ceil(self._k_cfg))
+        self.max_delta_rows = int(max_delta_rows)
+        self.max_delta_frac = float(max_delta_frac)
+        # buckets the epoch rebuilder pre-warms on the NEW engine before
+        # the swap (ServeState.warmup records what it actually compiled)
+        self.warm_buckets: List[int] = []
+        self._state = _EpochState(inner, epoch=0, min_cap=self._min_cap)
+        self.last_answer_epoch = 0  # epoch of the latest knn_batch answer
+        self._rebuilding = False
+        self._journal: Optional[List[tuple]] = None
+        self._rebuild_thread: Optional[threading.Thread] = None
+        self._closed = False
+        reg = obs.get_registry()
+        self._writes = {
+            op: reg.counter("kdtree_mutable_writes_total",
+                            labels={"op": op})
+            for op in ("upsert", "delete")
+        }
+        self._rebuilds = reg.counter("kdtree_mutable_rebuilds_total")
+        self._corrections = reg.counter("kdtree_mutable_corrections_total")
+        self._g_epoch = reg.gauge("kdtree_epoch")
+        self._g_delta = reg.gauge("kdtree_mutable_delta_rows")
+        self._g_tomb = reg.gauge("kdtree_mutable_tombstones")
+        self._g_headroom = reg.gauge("kdtree_mutable_delta_headroom")
+        self._update_gauges(self._state)
+
+    # -- ServeEngine-compatible surface -------------------------------------
+
+    @property
+    def tree(self):
+        return self._state.inner.tree
+
+    @property
+    def k(self) -> int:
+        return self._state.inner.k
+
+    @property
+    def epoch(self) -> int:
+        return self._state.epoch
+
+    def _snapshot(self) -> _Snapshot:
+        with self._lock:
+            return _Snapshot(self._state)
+
+    def knn_batch(
+        self, queries: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray, str]:
+        """Exact k-NN for one padded micro-batch: the warm main-tree
+        dispatch, overlaid with the delta buffer and tombstone masks.
+        With an empty overlay this is a pure passthrough — byte-for-byte
+        the immutable serving path."""
+        snap = self._snapshot()
+        d2, ids, source = snap.inner.knn_batch(queries)
+        # which epoch ANSWERED this call — the snapshot's, not whatever
+        # self.epoch reads after a concurrent swap. The batch worker is
+        # the only steady-state caller, so the plain attribute is
+        # race-free for its call-then-record sequence (the flight
+        # event's epoch field exists to place each batch relative to a
+        # swap, so it must name the answering generation exactly).
+        self.last_answer_epoch = snap.epoch
+        if snap.empty:
+            return d2, ids, source
+        return self._overlay(queries, d2, ids, snap) + (source,)
+
+    def fallback_knn(
+        self, queries: np.ndarray, k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The degradation path, mutable-aware: masked flat storage plus
+        delta, merged — exact over the surviving points, like everything
+        else."""
+        snap = self._snapshot()
+        if snap.empty:
+            return snap.inner.fallback_knn(queries, k)
+        k = min(int(k), snap.inner.k)
+        d2, ids = self._masked_main_knn(queries, snap, k)
+        if snap.delta_rows:
+            dd2, dids = self._delta_knn(queries, snap, k)
+            d2 = np.concatenate([d2, dd2], axis=1)
+            ids = np.concatenate([ids, dids], axis=1)
+        return merge_rows(d2, ids, k)
+
+    # -- query overlay -------------------------------------------------------
+
+    def _overlay(
+        self, queries: np.ndarray, d2: np.ndarray, ids: np.ndarray,
+        snap: _Snapshot,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        kk = d2.shape[1]
+        # the inner engine already host-materialized these at its
+        # response boundary; copy so masking never mutates a buffer the
+        # caller may still hold
+        d2 = d2.copy()
+        ids = ids.copy()
+        contaminated = None
+        if snap.dead_sorted.size:
+            hit = in_sorted(snap.dead_sorted, ids)
+            if hit.any():
+                contaminated = hit.any(axis=1)
+                d2[hit] = np.inf
+                ids[hit] = -1
+        dd2 = dids = None
+        if snap.delta_rows:
+            dd2, dids = self._delta_knn(queries, snap, kk)
+            d2 = np.concatenate([d2, dd2], axis=1)
+            ids = np.concatenate([ids, dids], axis=1)
+        d2, ids = merge_rows(d2, ids, kk)
+        if contaminated is not None and contaminated.any():
+            # a masked hit inside a row's main top-k means the main
+            # survivors may be short exactly at the k boundary: the
+            # masked slot's replacement (the true (k+1)-th main point)
+            # was never fetched. Re-answer those rows over the masked
+            # flat storage — exact by construction — and re-merge.
+            nrows = int(contaminated.sum())
+            self._corrections.inc(nrows)
+            sub = queries[contaminated]
+            fd2, fids = self._masked_main_knn_padded(sub, snap, kk)
+            if dd2 is not None:
+                fd2 = np.concatenate([fd2, dd2[contaminated]], axis=1)
+                fids = np.concatenate([fids, dids[contaminated]], axis=1)
+            cd2, cids = merge_rows(fd2, fids, kk)
+            d2[contaminated] = cd2
+            ids[contaminated] = cids
+        return d2, ids
+
+    def _delta_knn(
+        self, queries: np.ndarray, snap: _Snapshot, k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact top-k over the padded delta buffer — the same
+        brute-force kernel and padding convention as the proven
+        flat-storage degradation path, so +inf slots come back as
+        (inf, -1) and sort last in the merge."""
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops import bruteforce
+
+        dev_pts, gid_host = snap.delta_view
+        kk = min(int(k), dev_pts.shape[0])
+        d2, idx = bruteforce.knn(dev_pts, jnp.asarray(queries), k=kk)
+        d2 = np.asarray(d2)  # kdt-lint: disable=KDT201 overlay merge boundary: delta hits must be host-materialized to merge with the already-fetched main hits
+        idx = np.asarray(idx)  # kdt-lint: disable=KDT201 overlay merge boundary: delta hits must be host-materialized to merge with the already-fetched main hits
+        # idx can be -1: when fewer finite candidates than kk exist, the
+        # scan's (inf, -1) init carry wins the inf ties — mapping it
+        # through gid_host unguarded would wrap to the LAST slot's real
+        # id (the same guard the flat-storage fallback applies)
+        ids = np.where(idx >= 0, gid_host[np.maximum(idx, 0)], -1)
+        return d2, ids.astype(np.int32)
+
+    def _masked_main_knn(
+        self, queries: np.ndarray, snap: _Snapshot, k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact top-k over the tombstone-masked flat storage (masked
+        rows carry +inf coords / -1 ids — identical to padding)."""
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops import bruteforce
+
+        kk = min(int(k), snap.masked_pts.shape[0])
+        d2, idx = bruteforce.knn(snap.masked_pts, jnp.asarray(queries),
+                                 k=kk)
+        gids = jnp.where(idx >= 0, snap.masked_gid[jnp.maximum(idx, 0)], -1)
+        return (
+            np.asarray(d2),  # kdt-lint: disable=KDT201 overlay merge boundary: corrected rows must be host-materialized to merge and answer
+            np.asarray(gids),  # kdt-lint: disable=KDT201 overlay merge boundary: corrected rows must be host-materialized to merge and answer
+        )
+
+    def _masked_main_knn_padded(
+        self, sub: np.ndarray, snap: _Snapshot, k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """The correction dispatch, pow2-padded so steady-state
+        contamination cycles a handful of compiled shapes — the
+        batcher's own quantization trick."""
+        rows = sub.shape[0]
+        bucket = _pow2_ceil(max(rows, _CORRECTION_MIN_BUCKET))
+        if bucket > rows:
+            pad = np.broadcast_to(sub[-1], (bucket - rows, sub.shape[1]))
+            sub = np.concatenate([sub, pad], axis=0)
+        d2, ids = self._masked_main_knn(sub, snap, k)
+        return d2[:rows], ids[:rows]
+
+    # -- the write path ------------------------------------------------------
+
+    @staticmethod
+    def _check_write(ids: np.ndarray,
+                     points: Optional[np.ndarray]) -> np.ndarray:
+        ids = ids.astype(np.int64, copy=False).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("write needs at least one id")
+        if ids.min() < 0 or ids.max() >= MAX_ID:
+            raise ValueError(
+                f"point ids must be in [0, {MAX_ID}) — the engines store "
+                "ids as int32"
+            )
+        if len(np.unique(ids)) != ids.size:
+            raise ValueError("duplicate ids in one write request")
+        if points is not None and (
+            points.ndim != 2 or points.shape[0] != ids.size
+        ):
+            raise ValueError(
+                f"points must be [{ids.size}, D] to match ids, got "
+                f"{points.shape}"
+            )
+        return ids
+
+    def upsert(self, ids: np.ndarray, points: np.ndarray) -> Dict:
+        """Insert or update points (validated host arrays: int ids,
+        f32[m, D] finite coordinates). Existing main-tree copies of the
+        ids are masked; the delta copy is authoritative from now until
+        the next epoch compacts it into the main tree."""
+        points = points.astype(np.float32, copy=False)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("mutable engine is closed")
+            ids = self._check_write(ids, points)
+            if points.shape[1] != self._state.inner.tree.dim:
+                raise ValueError(
+                    f"points are {points.shape[1]}-D but the index is "
+                    f"{self._state.inner.tree.dim}-D"
+                )
+            st = self._state
+            res = self._apply_upsert(st, ids, points)
+            if self._journal is not None:
+                self._journal.append(("upsert", ids.copy(), points.copy()))
+            self._writes["upsert"].inc(ids.size)
+            flight.record("mutable.upsert", ids=int(ids.size),
+                          fresh=res["fresh"], epoch=st.epoch,
+                          delta_rows=st.delta.rows)
+            self._update_gauges(st)
+            self._maybe_rebuild(st)
+            return self._write_report(st, res)
+
+    def delete(self, ids: np.ndarray) -> Dict:
+        """Delete points by id: masks main copies, drops delta copies.
+        Unknown ids are counted but not an error (idempotent)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("mutable engine is closed")
+            ids = self._check_write(ids, None)
+            st = self._state
+            res = self._apply_delete(st, ids)
+            if self._journal is not None:
+                self._journal.append(("delete", ids.copy(), None))
+            self._writes["delete"].inc(ids.size)
+            flight.record("mutable.delete", ids=int(ids.size),
+                          applied=res["applied"], epoch=st.epoch,
+                          tombstones=len(st.dead))
+            self._update_gauges(st)
+            self._maybe_rebuild(st)
+            return self._write_report(st, res)
+
+    def _apply_upsert(self, st: _EpochState, ids: np.ndarray,
+                      points: np.ndarray) -> Dict:
+        pos = st.lookup(ids)
+        fresh = 0
+        masks: List[int] = []
+        for i, gid in enumerate(ids.tolist()):
+            if st.delta.put(gid, points[i]):
+                fresh += 1
+            if pos[i] >= 0 and gid not in st.dead:
+                # the id already lives in the main tree: shadow that
+                # copy — the delta row is now the authoritative one
+                st.dead.add(gid)
+                masks.append(int(pos[i]))
+        st.apply_masks(masks)
+        st.delta.refresh()
+        st.refresh_dead()
+        return {"applied": int(ids.size), "fresh": fresh,
+                "updated": int(ids.size) - fresh}
+
+    def _apply_delete(self, st: _EpochState, ids: np.ndarray) -> Dict:
+        pos = st.lookup(ids)
+        applied = 0
+        masks: List[int] = []
+        for i, gid in enumerate(ids.tolist()):
+            was_delta = st.delta.drop(gid)
+            newly_dead = False
+            if pos[i] >= 0 and gid not in st.dead:
+                st.dead.add(gid)
+                masks.append(int(pos[i]))
+                newly_dead = True
+            if was_delta or newly_dead:
+                applied += 1
+        st.apply_masks(masks)
+        st.delta.refresh()
+        st.refresh_dead()
+        return {"applied": applied}
+
+    def _write_report(self, st: _EpochState, res: Dict) -> Dict:
+        out = dict(res)
+        out.update(
+            delta_rows=st.delta.rows,
+            tombstones=len(st.dead),
+            backlog=st.backlog(),
+            epoch=st.epoch,
+            rebuilding=self._rebuilding,
+            threshold=self.rebuild_threshold(st),
+        )
+        return out
+
+    # -- epoch rebuild -------------------------------------------------------
+
+    def rebuild_threshold(
+        self, st: Optional[_EpochState] = None,
+    ) -> Optional[int]:
+        """Backlog size that triggers a compaction: the tighter of the
+        absolute row cap and the fraction-of-main cap; None when both
+        knobs are disabled (<= 0) — writes then accumulate forever."""
+        st = st if st is not None else self._state
+        cands = []
+        if self.max_delta_rows > 0:
+            cands.append(self.max_delta_rows)
+        if self.max_delta_frac > 0:
+            cands.append(max(1, int(self.max_delta_frac * st.n_main)))
+        return min(cands) if cands else None
+
+    def _update_gauges(self, st: _EpochState) -> None:
+        self._g_epoch.set(st.epoch)
+        self._g_delta.set(st.delta.rows)
+        self._g_tomb.set(len(st.dead))
+        thr = self.rebuild_threshold(st)
+        self._g_headroom.set(
+            1.0 if thr is None else max(0.0, 1.0 - st.backlog() / thr)
+        )
+
+    def _maybe_rebuild(self, st: _EpochState) -> None:
+        """(Holding the lock.) Kick the background compaction when the
+        backlog crosses the threshold — at most one rebuild in flight,
+        so one overflow triggers exactly one rebuild."""
+        thr = self.rebuild_threshold(st)
+        if thr is None or st.backlog() < thr:
+            return
+        if self._rebuilding or self._closed:
+            return
+        self._rebuilding = True
+        self._journal = []
+        delta_pts, delta_ids = st.delta.items()
+        dead = set(st.dead)
+        flight.record("mutable.rebuild_start", epoch=st.epoch,
+                      backlog=st.backlog(), threshold=thr)
+        self._rebuild_thread = threading.Thread(
+            target=self._rebuild_worker, args=(st, delta_pts, delta_ids,
+                                               dead),
+            name="kdtree-mutable-rebuild", daemon=True,
+        )
+        self._rebuild_thread.start()
+
+    def _rebuild_worker(self, old: _EpochState, delta_pts: np.ndarray,
+                        delta_ids: np.ndarray, dead: set) -> None:
+        try:
+            with obs.span("mutable.rebuild", sync=False, epoch=old.epoch,
+                          delta_rows=int(delta_ids.size),
+                          tombstones=len(dead)):
+                new_st = self._compact(old, delta_pts, delta_ids, dead)
+                with self._lock:
+                    journal = self._journal or []
+                    for op, ids, pts in journal:
+                        if op == "upsert":
+                            self._apply_upsert(new_st, ids, pts)
+                        else:
+                            self._apply_delete(new_st, ids)
+                    self._state = new_st
+                    self._journal = None
+                    self._rebuilding = False
+                    self._rebuilds.inc()
+                    self._update_gauges(new_st)
+                    flight.record(
+                        "mutable.epoch_swap", epoch=new_st.epoch,
+                        n=new_st.n_main, replayed=len(journal),
+                        delta_rows=new_st.delta.rows,
+                        tombstones=len(new_st.dead),
+                    )
+            with self._lock:
+                # journal replay may have re-crossed the threshold (a
+                # write flood during the rebuild); evaluate once more
+                self._maybe_rebuild(self._state)
+        except Exception as e:  # a failed rebuild must not kill serving
+            flight.record("mutable.rebuild_error", error=repr(e)[:200],
+                          epoch=old.epoch)
+            flight.auto_dump("mutable-rebuild-error")
+            with self._lock:
+                self._rebuilding = False
+                self._journal = None
+
+    def _compact(self, old: _EpochState, delta_pts: np.ndarray,
+                 delta_ids: np.ndarray, dead: set) -> _EpochState:
+        """Build the next epoch: surviving main rows + delta rows into a
+        fresh Morton tree (original ids preserved through the
+        ``morton_view`` gid mapping), pre-warmed before anyone serves
+        from it. Runs on the rebuild thread — the host fetches here are
+        once-per-epoch, not hot-path."""
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops.morton import morton_view
+        from kdtree_tpu.serve.lifecycle import ServeEngine
+
+        t = old.inner.tree
+        flat_pts = np.asarray(t.bucket_pts).reshape(-1, t.dim)  # kdt-lint: disable=KDT201 epoch compaction snapshot on the rebuild thread, not the serving hot path
+        flat_gid = np.asarray(t.bucket_gid).reshape(-1)  # kdt-lint: disable=KDT201 epoch compaction snapshot on the rebuild thread, not the serving hot path
+        dead_sorted = np.array(sorted(dead), dtype=np.int64)  # kdt-lint: disable=KDT201 dead is a host-side python set of ids, not a device value
+        alive = (flat_gid >= 0) & ~in_sorted(dead_sorted, flat_gid)
+        pts = np.concatenate([flat_pts[alive], delta_pts], axis=0)
+        ids = np.concatenate(
+            [flat_gid[alive].astype(np.int64),
+             delta_ids.astype(np.int64)]
+        )
+        if ids.size == 0:
+            raise RuntimeError(
+                "refusing to compact to an empty index — the last point "
+                "was deleted; keep serving the overlay instead"
+            )
+        new_tree = morton_view(
+            jnp.asarray(pts), gid=jnp.asarray(ids.astype(np.int32)),
+            n_real=int(ids.size),
+        )
+        new_inner = ServeEngine(new_tree, self._k_cfg)
+        self._prewarm(new_inner)
+        return _EpochState(new_inner, epoch=old.epoch + 1,
+                           min_cap=self._min_cap)
+
+    def _prewarm(self, inner) -> None:
+        """Compile the new epoch's batch shapes BEFORE the swap (same
+        dummy-batch construction as the serving warmup ladder), so the
+        first post-swap batch dispatches warm — the plan store already
+        makes its launch plan warm (same signature)."""
+        t = inner.tree
+        lo = np.asarray(t.node_lo[0], dtype=np.float64)  # kdt-lint: disable=KDT201 once-per-epoch pre-warm on the rebuild thread
+        hi = np.asarray(t.node_hi[0], dtype=np.float64)  # kdt-lint: disable=KDT201 once-per-epoch pre-warm on the rebuild thread
+        lo = np.where(np.isfinite(lo), lo, 0.0)
+        hi = np.where(np.isfinite(hi) & (hi > lo), hi, lo + 1.0)
+        for b in list(self.warm_buckets):
+            frac = (np.arange(b, dtype=np.float64)[:, None] + 0.5) / b
+            q = (lo[None, :] + frac * (hi - lo)[None, :]).astype(np.float32)
+            inner.knn_batch(q)
+
+    # -- lifecycle / introspection ------------------------------------------
+
+    def stats(self) -> Dict:
+        """The /healthz "mutable" block."""
+        with self._lock:
+            st = self._state
+            return {
+                "epoch": st.epoch,
+                "n": st.inner.tree.n_real,
+                "delta_rows": st.delta.rows,
+                "tombstones": len(st.dead),
+                "backlog": st.backlog(),
+                "rebuilding": self._rebuilding,
+                "threshold": self.rebuild_threshold(st),
+            }
+
+    def close(self, timeout_s: float = 120.0) -> None:
+        """Stop accepting writes and join any in-flight rebuild — the
+        serving shutdown path calls this so a drain never races an
+        epoch swap."""
+        with self._lock:
+            self._closed = True
+            t = self._rebuild_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout_s)

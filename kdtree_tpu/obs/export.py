@@ -229,6 +229,29 @@ METRIC_HELP = {
     "kdtree_router_shard_healthy":
         "1 while the shard's /healthz answers 200 without SLO PAGE",
     "kdtree_router_shards": "shards this router scatters to",
+    "kdtree_router_write_requests_total":
+        "routed mutable-index writes by op and outcome",
+    "kdtree_router_federate_errors_total":
+        "per-shard /metrics federation scrape failures",
+    "kdtree_router_federated_up":
+        "1 when the shard's /metrics scrape succeeded in the last "
+        "federated exposition",
+    # mutable index (docs/SERVING.md "Mutable index")
+    "kdtree_epoch":
+        "index epoch generation; increments on each delta compaction "
+        "swap",
+    "kdtree_mutable_delta_rows":
+        "live upserted rows in the exact delta buffer",
+    "kdtree_mutable_tombstones":
+        "main-tree rows masked out (deleted or superseded by an upsert)",
+    "kdtree_mutable_delta_headroom":
+        "1 - write backlog / epoch-rebuild threshold (SLO delta-backlog)",
+    "kdtree_mutable_writes_total": "mutable-index writes applied, by op",
+    "kdtree_mutable_rebuilds_total":
+        "epoch compactions completed and swapped in",
+    "kdtree_mutable_corrections_total":
+        "query rows re-answered over masked flat storage because a "
+        "tombstoned id sat inside their main top-k",
     # SLOs + metric history (docs/OBSERVABILITY.md "SLOs & burn rates")
     "kdtree_slo_state":
         "SLO state by spec: 0 OK, 1 WARN, 2 PAGE (multi-window burn rate)",

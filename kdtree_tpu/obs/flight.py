@@ -135,6 +135,30 @@ class FlightRecorder:
         os.replace(tmp, path)
         return path
 
+    def reset_dump_rate_limit(self) -> None:
+        """Forget every per-reason dump timestamp, so the next
+        :meth:`auto_dump` of any reason writes immediately. Test
+        isolation: the process-wide recorder otherwise couples tests
+        that dump the same reason within ``_MIN_DUMP_INTERVAL_S``
+        (tests/conftest.py clears it before every test so any
+        hand-picked collection order passes)."""
+        with self._lock:
+            self._last_dump.clear()
+
+    def claim_dump(self, reason: str, force: bool = False) -> bool:
+        """Claim the per-reason rate-limit slot (at most one dump per
+        reason per ``_MIN_DUMP_INTERVAL_S``; ``force`` always claims).
+        Split out from the write so the module-level :func:`auto_dump`
+        can claim synchronously and serialize on a background thread."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(reason)
+            if not force and last is not None and \
+                    now - last < _MIN_DUMP_INTERVAL_S:
+                return False
+            self._last_dump[reason] = now
+            return True
+
     def auto_dump(self, reason: str, force: bool = False) -> Optional[str]:
         """Rate-limited incident dump to the flight dir (see
         :func:`_dump_dir`): at most one file write per reason per
@@ -147,19 +171,17 @@ class FlightRecorder:
             d = _dump_dir()
             if d is None:
                 return None
-            now = time.monotonic()
-            with self._lock:
-                last = self._last_dump.get(reason)
-                if not force and last is not None and \
-                        now - last < _MIN_DUMP_INTERVAL_S:
-                    return None
-                self._last_dump[reason] = now
-            safe = "".join(c if c.isalnum() or c in "-_" else "-"
-                           for c in reason) or "dump"
-            return self.dump(os.path.join(d, f"flight-{safe}.json"),
+            if not self.claim_dump(reason, force=force):
+                return None
+            return self.dump(os.path.join(d, f"flight-{_safe_reason(reason)}.json"),
                              reason=reason)
         except Exception:
             return None
+
+
+def _safe_reason(reason: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in reason) or "dump"
 
 
 class BurstDetector:
@@ -224,21 +246,62 @@ def record(kind: str, **fields) -> None:
     _recorder.record(kind, **fields)
 
 
-def auto_dump(reason: str, force: bool = False) -> Optional[str]:
-    path = _recorder.auto_dump(reason, force=force)
-    if path is not None:
-        # every incident that earned a flight dump gets the metric-
-        # history ring dumped alongside it (history-<reason>.json): the
-        # flight ring says what happened in order, the history ring says
-        # how the totals were trending into it. Piggybacks the flight
-        # rate limit — this only runs when a flight file was written.
-        try:
-            from kdtree_tpu.obs import history
+def _dump_history_companion(reason: str) -> None:
+    """Every incident that earned a flight dump gets the metric-history
+    ring dumped alongside it (``history-<reason>.json``): the flight
+    ring says what happened in order, the history ring says how the
+    totals were trending into it. Piggybacks the flight rate limit —
+    this only runs when a flight file was claimed."""
+    try:
+        from kdtree_tpu.obs import history
 
-            history.auto_dump(reason)
-        except Exception:
-            pass
-    return path
+        history.auto_dump(reason)
+    except Exception:
+        pass
+
+
+def _write_dump(path: str, reason: str) -> None:
+    try:
+        _recorder.dump(path, reason=reason)
+    except Exception:
+        return
+    _dump_history_companion(reason)
+
+
+def auto_dump(reason: str, force: bool = False) -> Optional[str]:
+    """The incident-dump entry point instrumentation calls.
+
+    ``force=True`` (operator triggers: SIGUSR2, the CLI's exit-time
+    dump) writes SYNCHRONOUSLY — those dumps must exist before the
+    process moves on or exits. Rate-limited incident dumps
+    (``force=False``) claim their per-reason slot synchronously but
+    serialize on a short-lived background thread: the callers sit on
+    serving threads (batch worker, scatter/gather, the SLO sampler,
+    the admission gate), and once a process registry has grown to
+    hundreds of series the history companion can take SECONDS to
+    serialize — a partial answer must not pay that inline (observed:
+    a routed partial stalling ~2.5 s on its own incident dump). The
+    writer thread is non-daemon, so a claimed dump is never lost to
+    interpreter exit; at most one per reason per rate-limit window
+    exists by construction. Returns the path that is (being) written,
+    or None (disabled / rate-limited)."""
+    if force:
+        path = _recorder.auto_dump(reason, force=True)
+        if path is not None:
+            _dump_history_companion(reason)
+        return path
+    try:
+        d = _dump_dir()
+        if d is None:
+            return None
+        if not _recorder.claim_dump(reason):
+            return None
+        path = os.path.join(d, f"flight-{_safe_reason(reason)}.json")
+        threading.Thread(target=_write_dump, args=(path, reason),
+                         name="kdtree-flight-dump").start()
+        return path
+    except Exception:
+        return None
 
 
 _handler_installed = False

@@ -191,6 +191,32 @@ def default_specs() -> List[SloSpec]:
     ]
 
 
+def mutable_specs() -> List[SloSpec]:
+    """The mutable-index SLO (armed alongside :func:`default_specs` by
+    a serving process, which is always write-capable): the write backlog
+    must not outrun the epoch rebuilder. ``kdtree_mutable_delta_headroom``
+    is 1 - backlog/threshold — a healthy replica compacts long before it
+    reaches 0, so sustained samples under the floor mean rebuilds are
+    not keeping up with write traffic (docs/SERVING.md "Mutable
+    index")."""
+    return [
+        SloSpec(
+            name="delta-backlog",
+            objective="delta+tombstone backlog stays under 90% of the "
+                      "epoch-rebuild threshold (headroom >= 0.1)",
+            target=0.90,
+            kind="gauge_min",
+            gauge="kdtree_mutable_delta_headroom",
+            threshold=0.1,
+            # same wide-budget burn sizing as device-busy: with budget
+            # 0.1 the default >10x fast tier is mathematically
+            # unreachable (max burn = 1.0/0.1 = 10)
+            fast=BurnWindow(long_s=60.0, short_s=10.0, max_burn=4.0),
+            slow=BurnWindow(long_s=600.0, short_s=60.0, max_burn=1.5),
+        ),
+    ]
+
+
 def router_specs() -> List[SloSpec]:
     """The routing-process SLOs (``kdtree-tpu route`` arms these instead
     of :func:`default_specs` — a router has no batches or device, it has

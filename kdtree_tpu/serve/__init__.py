@@ -30,6 +30,12 @@ package is that organ (see ``docs/SERVING.md``):
   circuit breakers, health ejection, and exact partial-result
   degradation — the reference's L1 MPI data-parallel layer re-expressed
   at serving time;
+- the **mutable index** (:mod:`kdtree_tpu.mutable`) rides through this
+  package: ``POST /v1/upsert`` / ``/v1/delete`` append to an exact
+  delta buffer with tombstones, queries merge tree + delta hits, and a
+  background epoch rebuilder compacts and atomically swaps a fresh
+  Morton tree between batches — answers byte-identical to a
+  rebuild-from-scratch index at every moment;
 - :mod:`~kdtree_tpu.serve.faults` — deterministic fault injection
   (``KDTREE_TPU_FAULTS`` / ``POST /debug/faults``): latency, error,
   hang, and connection-drop faults at named sites, so every router

@@ -201,6 +201,14 @@ class MicroBatcher:
             "serve.batch", rows=rows, bucket=bucket, requests=len(live),
             plan=source, dispatch_ms=round((done - live[0].dispatched_at)
                                            * 1e3, 3),
+            # which index generation ANSWERED this batch (mutable
+            # serving): an epoch swap between two batches is visible in
+            # the ring as this number stepping — the post-incident
+            # proof of when the swap landed relative to each request.
+            # last_answer_epoch is the dispatch snapshot's epoch, so a
+            # swap landing mid-batch cannot mislabel the batch it
+            # didn't answer.
+            epoch=getattr(self.engine, "last_answer_epoch", 0),
             traces=[r.trace_id for r in live],
         )
         off = 0

@@ -172,6 +172,11 @@ class ServeState:
                     np.float32
                 )
                 self.engine.knn_batch(q)
+        if hasattr(self.engine, "warm_buckets"):
+            # tell the mutable engine's epoch rebuilder which batch
+            # shapes serving actually compiled, so a rebuilt epoch is
+            # pre-warmed on the same ladder before it is swapped in
+            self.engine.warm_buckets = list(buckets)
         obs.get_registry().gauge("kdtree_serve_warmup_buckets").set(
             len(buckets)
         )
@@ -215,10 +220,24 @@ def build_state(
     slo_engine=None,
     history_period_s: Optional[float] = None,
     id_offset: int = 0,
+    max_delta_rows: Optional[int] = None,
+    max_delta_frac: Optional[float] = None,
 ) -> ServeState:
     """Assemble a ready-to-warmup :class:`ServeState` from exactly one
     index source: a loaded ``tree``, a materialized ``points`` array, or
-    a seeded ``problem`` (seed, dim, n) on the threefry row stream."""
+    a seeded ``problem`` (seed, dim, n) on the threefry row stream.
+
+    The engine is always write-capable
+    (:class:`~kdtree_tpu.mutable.engine.MutableEngine`): ``/v1/upsert``
+    and ``/v1/delete`` append to the delta buffer, and the epoch
+    rebuilder compacts once the backlog crosses
+    ``min(max_delta_rows, max_delta_frac * n)`` (docs/SERVING.md
+    "Mutable index"; either knob <= 0 disables that bound)."""
+    from kdtree_tpu.mutable.engine import (
+        DEFAULT_MAX_DELTA_FRAC,
+        DEFAULT_MAX_DELTA_ROWS,
+        MutableEngine,
+    )
     from kdtree_tpu.serve.batcher import MIN_BUCKET
     from kdtree_tpu.tuning.store import _pow2_ceil
 
@@ -241,13 +260,27 @@ def build_state(
             seed, dim, n = (int(x) for x in problem[:3])
             points = generate_points_rowwise(seed, dim, n)
         tree = build_morton(jnp.asarray(points))
-    engine = ServeEngine(tree, k)
+    engine = MutableEngine(
+        ServeEngine(tree, k),
+        max_delta_rows=(DEFAULT_MAX_DELTA_ROWS if max_delta_rows is None
+                        else int(max_delta_rows)),
+        max_delta_frac=(DEFAULT_MAX_DELTA_FRAC if max_delta_frac is None
+                        else float(max_delta_frac)),
+        # the configured k, so an epoch rebuilt over a grown index can
+        # serve the full k even when the bootstrap index was smaller
+        requested_k=int(k),
+    )
     if slo_engine is None:
-        # the process-default engine: default specs (request p99, error/
-        # shed/degraded rates, device busy) over the process history ring
+        # the process-default specs (request p99, error/shed/degraded
+        # rates, device busy) plus the mutable-path delta-backlog SLO,
+        # over the process history ring
+        from kdtree_tpu.obs import history as obs_history
         from kdtree_tpu.obs import slo as obs_slo
 
-        slo_engine = obs_slo.get_engine()
+        slo_engine = obs_slo.SloEngine(
+            specs=obs_slo.default_specs() + obs_slo.mutable_specs(),
+            history=obs_history.get_history(),
+        )
     return ServeState(
         engine,
         max_batch=_pow2_ceil(max_batch),

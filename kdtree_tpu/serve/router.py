@@ -41,15 +41,32 @@ fault tolerance"):
 The router holds no index, no jax, and no queue — shards shed (429 +
 ``Retry-After``, which the backoff honors) and the router propagates
 pressure instead of buffering it.
+
+Two fleet-facing extras ride on the same shard table:
+
+- **write passthrough** (``POST /v1/upsert`` / ``/v1/delete``): the
+  mutable-index write path (docs/SERVING.md "Mutable index") partitions
+  ids by the owning shard — ownership is the contiguous id range
+  starting at each shard's ``id_offset``, learned from its ``/healthz``
+  body — and forwards each partition verbatim (ids are global; shards
+  localize). Partial failures answer 502 with per-shard outcomes,
+  never a silent half-write.
+- **scrape federation** (``GET /metrics?federate=1``): one scrape
+  returns the router's own exposition plus every shard's, re-labeled
+  with ``shard="<index>"`` and regrouped per metric family (the text
+  format requires families contiguous). Unreachable shards are
+  reported as ``kdtree_router_federated_up{shard=...} 0`` instead of
+  failing the scrape.
 """
 
 from __future__ import annotations
 
 import json
 import random
+import re
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from kdtree_tpu import obs
@@ -215,6 +232,11 @@ class ShardState:
         self.healthy = True          # optimistic until the first probe
         self.health_detail: dict = {}
         self.retry_after_until = 0.0  # monotonic; set from 429 Retry-After
+        # the shard's partition start (GLOBAL ids >= this belong here,
+        # up to the next shard's offset): learned from the /healthz
+        # body and kept across later probe failures — ownership is
+        # topology, not liveness
+        self.id_offset: Optional[int] = None
 
     # -- latency / hedging ---------------------------------------------------
 
@@ -335,6 +357,19 @@ class RouterHandler(JsonRequestHandler):
             self._send_health()
             return
         if path == "/metrics":
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            if qs.get("federate", ["0"])[0] not in ("", "0"):
+                # one scrape for the whole fleet: the router's own
+                # exposition + every shard's, shard-labeled and
+                # regrouped per family (docs/SERVING.md)
+                self._send_bytes(
+                    200,
+                    self.server.federated_metrics_text().encode("utf-8"),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                return
             self._send_metrics()
             return
         if path == "/debug/flight":
@@ -368,7 +403,7 @@ class RouterHandler(JsonRequestHandler):
 
     def do_POST(self) -> None:
         path = self.path.split("?", 1)[0]
-        if path != "/v1/knn":
+        if path not in ("/v1/knn", "/v1/upsert", "/v1/delete"):
             self._send_json(404, {"error": f"no such path: {path}"})
             return
         trace = _trace_id(self.headers)
@@ -385,6 +420,11 @@ class RouterHandler(JsonRequestHandler):
             payload = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, ValueError):
             self._send_json(400, {"error": "body is not valid JSON"})
+            return
+        if path in ("/v1/upsert", "/v1/delete"):
+            op = "upsert" if path == "/v1/upsert" else "delete"
+            code, out = self.server.route_write(op, payload, trace)
+            self._send_json(code, out)
             return
         if not isinstance(payload, dict) or "queries" not in payload:
             self._send_json(400, {"error": 'body must be a JSON object '
@@ -488,7 +528,7 @@ class Router(GracefulHTTPServer):
     def _call_shard(
         self, shard: ShardState, body: bytes, timeout_s: float, trace: str,
         conn_box: Optional[dict] = None, tag: str = "primary",
-        abort_check=None,
+        abort_check=None, path: str = "/v1/knn",
     ) -> dict:
         """One HTTP attempt against one shard; returns the parsed
         payload or raises :class:`ShardError`. The connection is stored
@@ -513,7 +553,7 @@ class Router(GracefulHTTPServer):
         try:
             try:
                 conn.request(
-                    "POST", "/v1/knn", body=body,
+                    "POST", path, body=body,
                     headers={"Content-Type": "application/json",
                              "X-Request-Id": trace},
                 )
@@ -565,7 +605,8 @@ class Router(GracefulHTTPServer):
         except (UnicodeDecodeError, ValueError):
             raise ShardError(f"shard {shard.index}: unparseable 200 body",
                              outcome="network") from None
-        if not isinstance(payload, dict) or "ids" not in payload:
+        want_key = "ids" if path == "/v1/knn" else "applied"
+        if not isinstance(payload, dict) or want_key not in payload:
             raise ShardError(f"shard {shard.index}: malformed payload",
                              outcome="network")
         shard.note_latency(time.monotonic() - t0)
@@ -843,6 +884,312 @@ class Router(GracefulHTTPServer):
                        "missing": missing},
         }, {"Retry-After": str(int(max(self.config.breaker_reset_s, 1.0)))}
 
+    # -- write passthrough (mutable index) -----------------------------------
+
+    def _owner_table(self) -> Optional[List[Tuple[int, ShardState]]]:
+        """(offset, shard) ascending, or None while any shard's
+        ``id_offset`` is still unknown (no successful health probe yet)
+        — routing a write on a guessed partition would corrupt it."""
+        offs = [(s.id_offset, s) for s in self.shards]
+        if any(o is None for o, _ in offs):
+            return None
+        return sorted(offs, key=lambda t: t[0])
+
+    def route_write(
+        self, op: str, payload, trace: str,
+    ) -> Tuple[int, dict]:
+        """Partition a write request's GLOBAL ids by owning shard (the
+        contiguous range starting at each shard's ``id_offset``) and
+        forward each partition verbatim. One attempt per shard — writes
+        are idempotent but a retry storm against a shedding shard helps
+        nobody; the per-shard outcome map makes partial application
+        visible, never silent."""
+        def count(status: str) -> None:
+            obs.get_registry().counter(
+                "kdtree_router_write_requests_total",
+                labels={"op": op, "status": status},
+            ).inc()
+
+        from kdtree_tpu.serve.server import MAX_WRITE_IDS
+
+        ids = payload.get("ids") if isinstance(payload, dict) else None
+        if not isinstance(ids, list) or not ids or not all(
+            isinstance(i, int) and not isinstance(i, bool) for i in ids
+        ):
+            count("client_error")
+            return 400, {"error": '"ids" must be a non-empty list of '
+                                  "ints", "trace_id": trace}
+        if len(ids) > MAX_WRITE_IDS:
+            # enforce the shards' per-request cap HERE: forwarding an
+            # oversized partition would get it 400d by its shard while
+            # other partitions apply — a guaranteed partial write for a
+            # request the router appeared to accept
+            count("client_error")
+            return 400, {"error": f'"ids" must hold at most '
+                                  f"{MAX_WRITE_IDS} ids per request "
+                                  "(split larger writes)",
+                         "trace_id": trace}
+        if len(set(ids)) != len(ids):
+            # same reasoning for duplicates: the shard's engine rejects
+            # them, so a dup spanning shards would half-apply
+            count("client_error")
+            return 400, {"error": "duplicate ids in one write request",
+                         "trace_id": trace}
+        points = payload.get("points") if op == "upsert" else None
+        if op == "upsert" and (
+            not isinstance(points, list) or len(points) != len(ids)
+        ):
+            count("client_error")
+            return 400, {"error": '"points" must be a list matching '
+                                  '"ids"', "trace_id": trace}
+        table = self._owner_table()
+        if table is None:
+            count("unavailable")
+            return 503, {"error": "shard id ranges unknown — health "
+                                  "probes have not yet read every "
+                                  "shard's id_offset",
+                         "trace_id": trace}
+        if min(ids) < table[0][0]:
+            count("client_error")
+            return 400, {"error": f"ids below the first shard's "
+                                  f"id_offset {table[0][0]} are owned "
+                                  "by no shard", "trace_id": trace}
+        offsets = [o for o, _ in table]
+        parts: Dict[int, List[int]] = {}
+        import bisect
+
+        for pos, gid in enumerate(ids):
+            owner = bisect.bisect_right(offsets, gid) - 1
+            parts.setdefault(owner, []).append(pos)
+        deadline = time.monotonic() + self.config.deadline_s
+        shard_out: Dict[str, dict] = {}
+        applied = 0
+        failures = client_error = None
+        ordered = sorted(parts.items())
+        for n_done, (owner, rows) in enumerate(ordered):
+            shard = table[owner][1]
+            # the reads' fail-fast policy applies to writes too: an
+            # ejected or breaker-open shard answers immediately instead
+            # of burning budget the remaining partitions need
+            if not shard.healthy:
+                self._count_attempt(shard, "breaker_open")
+                shard_out[str(shard.index)] = {
+                    "error": f"shard {shard.index}: ejected (unhealthy)",
+                    "outcome": "breaker_open",
+                }
+                failures = failures or "breaker_open"
+                continue
+            if not shard.breaker.allow():
+                self._count_attempt(shard, "breaker_open")
+                shard_out[str(shard.index)] = {
+                    "error": f"shard {shard.index}: circuit breaker open",
+                    "outcome": "breaker_open",
+                }
+                failures = failures or "breaker_open"
+                continue
+            sub = {"ids": [ids[i] for i in rows]}
+            if points is not None:
+                sub["points"] = [points[i] for i in rows]
+            # split the remaining budget evenly over the remaining
+            # partitions: one hung shard must not starve the healthy
+            # owners behind it into "deadline exhausted"
+            budget = (deadline - time.monotonic()) / (len(ordered)
+                                                      - n_done)
+            if budget <= 0:
+                shard_out[str(shard.index)] = {"error": "deadline "
+                                                        "exhausted"}
+                failures = failures or "timeout"
+                continue
+            try:
+                res = self._call_shard(
+                    shard, json.dumps(sub).encode("utf-8"), budget,
+                    trace, path=f"/v1/{op}",
+                )
+            except ShardError as e:
+                # mirror the read path's breaker contract: a 4xx is the
+                # shard ANSWERING (success — and a half-open probe slot
+                # claimed by allow() above must be released either way)
+                if e.retryable:
+                    shard.breaker.record_failure()
+                else:
+                    shard.breaker.record_success()
+                self._count_attempt(shard, e.outcome)
+                shard_out[str(shard.index)] = {
+                    "error": str(e), "outcome": e.outcome,
+                    "status": e.status,
+                }
+                if e.body is not None:
+                    shard_out[str(shard.index)]["body"] = e.body
+                if not e.retryable:
+                    client_error = e
+                failures = failures or e.outcome
+                continue
+            shard.breaker.record_success()
+            self._count_attempt(shard, "ok")
+            applied += int(res.get("applied", 0))
+            shard_out[str(shard.index)] = {
+                "applied": res.get("applied"),
+                "delta_rows": res.get("delta_rows"),
+                "tombstones": res.get("tombstones"),
+                "epoch": res.get("epoch"),
+                "rebuilding": res.get("rebuilding"),
+            }
+        out = {"op": op, "requested": len(ids), "applied": applied,
+               "shards": shard_out, "trace_id": trace}
+        flight.record("route.write", op=op, trace=trace, ids=len(ids),
+                      applied=applied, failed=failures is not None)
+        if failures is None:
+            count("ok")
+            return 200, out
+        if client_error is not None and len(parts) == 1:
+            # the single owning shard rejected the request itself:
+            # propagate its verdict verbatim (nothing was applied
+            # anywhere, so this is a clean 4xx, not a partial write)
+            count("client_error")
+            out["error"] = str(client_error)
+            return client_error.status or 400, out
+        count("error")
+        out["error"] = "one or more shards failed the write (see shards)"
+        return 502, out
+
+    # -- /metrics federation -------------------------------------------------
+
+    _PROM_SERIES = re.compile(
+        r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(.+)$"
+    )
+
+    @classmethod
+    def _parse_prom_families(cls, text: str) -> dict:
+        """Group one exposition into {family: {help, type, series}} —
+        ``series`` keeps (name, inner-labels | None, value). Histogram
+        ``_bucket``/``_sum``/``_count`` series attach to the family the
+        preceding ``# TYPE`` declared, the grouping the text format
+        requires."""
+        fams: dict = {}
+        current = None
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(" ", 3)
+                name = parts[2]
+                fam = fams.setdefault(
+                    name, {"help": None, "type": None, "series": []}
+                )
+                fam["help" if parts[1] == "HELP" else "type"] = (
+                    parts[3] if len(parts) > 3 else ""
+                )
+                current = name
+                continue
+            if not line.strip() or line.startswith("#"):
+                continue
+            m = cls._PROM_SERIES.match(line)
+            if not m:
+                continue
+            sname = m.group(1)
+            fam_name = (
+                current
+                if current is not None
+                and (sname == current or sname.startswith(current + "_"))
+                else sname
+            )
+            fam = fams.setdefault(
+                fam_name, {"help": None, "type": None, "series": []}
+            )
+            fam["series"].append((sname, m.group(2), m.group(3)))
+        return fams
+
+    def _scrape_shard(self, shard: ShardState) -> Optional[str]:
+        """One shard /metrics fetch for federation; None on any failure
+        (the federated exposition reports it, never fails the scrape)."""
+        import http.client
+
+        timeout = max(min(self.config.deadline_s, 2.0), 0.5)
+        try:
+            conn = http.client.HTTPConnection(shard.host, shard.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                raw = resp.read()
+                if resp.status != 200:
+                    return None
+                return raw.decode("utf-8", errors="replace")
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException):
+            return None
+
+    def federated_metrics_text(self) -> str:
+        """``GET /metrics?federate=1``: the router's own exposition plus
+        every shard's, shard-labeled, regrouped so each metric family is
+        one contiguous block (a format requirement, not cosmetics).
+        Unreachable shards become ``kdtree_router_federated_up 0``."""
+        from kdtree_tpu.obs.export import METRIC_HELP, prometheus_text
+
+        obs.flush()
+        merged: dict = {}
+
+        def absorb(fams: dict, shard_label: Optional[str]) -> None:
+            for name, fam in fams.items():
+                tgt = merged.setdefault(
+                    name, {"help": None, "type": None, "series": []}
+                )
+                for key in ("help", "type"):
+                    if tgt[key] is None:
+                        tgt[key] = fam[key]
+                for sname, inner, value in fam["series"]:
+                    if shard_label is not None:
+                        tag = f'shard="{shard_label}"'
+                        inner = f"{tag},{inner}" if inner else tag
+                    tgt["series"].append((sname, inner, value))
+
+        absorb(self._parse_prom_families(prometheus_text()), None)
+        # scrape shards CONCURRENTLY: serially, a few hung shards at
+        # ~2 s socket timeout each would push the whole federated
+        # scrape past a scraper's own timeout and take the entire fleet
+        # dark — the exact failure the up-gauge design exists to avoid
+        texts: List[Optional[str]] = [None] * len(self.shards)
+        scrapers = [
+            threading.Thread(
+                target=lambda i=i, s=s: texts.__setitem__(
+                    i, self._scrape_shard(s)
+                ),
+                name="kdtree-route-federate",
+            )
+            for i, s in enumerate(self.shards)
+        ]
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join()
+        up: Dict[int, int] = {}
+        reg = obs.get_registry()
+        for shard, text in zip(self.shards, texts):
+            up[shard.index] = 1 if text is not None else 0
+            if text is None:
+                reg.counter("kdtree_router_federate_errors_total",
+                            labels=shard.label()).inc()
+                continue
+            absorb(self._parse_prom_families(text), str(shard.index))
+        fam = merged.setdefault(
+            "kdtree_router_federated_up",
+            {"help": METRIC_HELP.get("kdtree_router_federated_up"),
+             "type": "gauge", "series": []},
+        )
+        for i in sorted(up):
+            fam["series"].append(
+                ("kdtree_router_federated_up", f'shard="{i}"', str(up[i]))
+            )
+        lines: List[str] = []
+        for name, fam in merged.items():
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            if fam["type"]:
+                lines.append(f"# TYPE {name} {fam['type']}")
+            for sname, inner, value in fam["series"]:
+                key = f"{sname}{{{inner}}}" if inner else sname
+                lines.append(f"{key} {value}")
+        return "\n".join(lines) + "\n"
+
     # -- health ejection -----------------------------------------------------
 
     def _probe_health(self, shard: ShardState) -> None:
@@ -866,6 +1213,9 @@ class Router(GracefulHTTPServer):
                         detail = json.loads(raw.decode("utf-8"))
                     except (UnicodeDecodeError, ValueError):
                         detail = {}
+                    off = detail.get("id_offset")
+                    if isinstance(off, int) and not isinstance(off, bool):
+                        shard.id_offset = off
                     healthy = detail.get("slo", {}).get("state") != "PAGE"
                     if not healthy:
                         detail = {"ejected": "slo PAGE"}

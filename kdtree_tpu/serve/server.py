@@ -5,8 +5,17 @@
   "k": int, "degraded": null | reason}`` out. Distances are Euclidean
   (sqrt of the engines' d2, float64 — the same transform the protocol
   lines use), ids are the original point rows.
+- ``POST /v1/upsert`` / ``POST /v1/delete`` — the mutable-index write
+  path (docs/SERVING.md "Mutable index"): ``{"ids": [...], "points":
+  [[...]]}`` / ``{"ids": [...]}`` with GLOBAL ids (this shard's
+  ``--id-offset`` is subtracted; ids below it are rejected — they
+  belong to another shard). Upserts land in the exact delta buffer,
+  deletes tombstone; answers stay exact at every moment and the epoch
+  rebuilder compacts in the background (``kdtree_epoch``).
 - ``GET /healthz`` — 200 once the index is loaded and warmup compiles
-  are done, 503 (with ``Retry-After``) while warming.
+  are done, 503 (with ``Retry-After``) while warming. The body carries
+  the mutable-index block (epoch, delta rows, tombstones) and this
+  shard's ``id_offset`` — the router's write-ownership source.
 - ``GET /metrics`` — the Prometheus text exposition of the whole obs
   registry (deferred device fetches flushed first), closing the ROADMAP
   scrape-endpoint item.
@@ -81,6 +90,7 @@ __all__ = ["GracefulHTTPServer", "JsonRequestHandler", "KnnRequestHandler",
 MAX_BODY_BYTES = 64 << 20  # a [max_batch, D] float batch is far smaller
 MAX_PROFILE_SECONDS = 60.0  # /debug/profile window cap
 DEFAULT_PROFILE_SECONDS = 3.0
+MAX_WRITE_IDS = 4096  # rows per upsert/delete request (split larger)
 
 _TRACE_ID_BAD = re.compile(r"[^A-Za-z0-9._-]")
 
@@ -164,6 +174,34 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         payload shape as a SIGUSR2 dump so one reader handles both."""
         self._send_json(200, flight.recorder().report("debug-endpoint"))
 
+    def _read_json_object(self, max_bytes: int = MAX_BODY_BYTES):
+        """Read + parse one JSON-object request body, or None with the
+        4xx already written: 411 missing Content-Length, 400 negative
+        (``rfile.read(-1)`` would stall to the socket timeout and drop
+        the connection responseless), 413 oversized, 400 non-JSON /
+        non-object. ONE implementation of this contract — the knn,
+        write, and faults handlers all parse through here so the
+        rejections cannot drift apart."""
+        try:
+            length = int(self.headers.get("Content-Length", ""))
+        except ValueError:
+            self._send_json(411, {"error": "Content-Length required"})
+            return None
+        if not (0 <= length <= max_bytes):
+            self._send_json(400 if length < 0 else 413,
+                            {"error": f"Content-Length must be in "
+                                      f"[0, {max_bytes}]"})
+            return None
+        try:
+            payload = json.loads(self.rfile.read(length).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            self._send_json(400, {"error": "body is not valid JSON"})
+            return None
+        if not isinstance(payload, dict):
+            self._send_json(400, {"error": "body must be a JSON object"})
+            return None
+        return payload
+
 
 class GracefulHTTPServer(ThreadingHTTPServer):
     """Shared server base: non-daemon handler threads (server_close()
@@ -238,7 +276,14 @@ class KnnRequestHandler(JsonRequestHandler):
                     "dim": state.engine.tree.dim,
                     "k_max": state.engine.k,
                     "max_batch": state.max_batch,
+                    # the router's write-ownership source: this shard
+                    # owns global ids in [id_offset, next shard's offset)
+                    "id_offset": state.id_offset,
                 }
+                if hasattr(state.engine, "stats"):
+                    mut = state.engine.stats()
+                    body["mutable"] = mut
+                    body["epoch"] = mut["epoch"]
                 if state.slo_engine is not None:
                     # SLO verdict rides along without gating readiness:
                     # a burning p99 wants traffic drained elsewhere, not
@@ -282,6 +327,9 @@ class KnnRequestHandler(JsonRequestHandler):
             return
         if path == "/debug/faults":
             self._do_debug_faults()
+            return
+        if path in ("/v1/upsert", "/v1/delete"):
+            self._do_write("upsert" if path == "/v1/upsert" else "delete")
             return
         if path != "/v1/knn":
             self._send_json(404, {"error": f"no such path: {path}"})
@@ -387,26 +435,10 @@ class KnnRequestHandler(JsonRequestHandler):
         None with the 4xx already written. Every rejection names what was
         wrong — the same crisp-contract idea as the CLI's loaders."""
         state: ServeState = self.server.state
-        try:
-            length = int(self.headers.get("Content-Length", ""))
-        except ValueError:
-            self._send_json(411, {"error": "Content-Length required"})
+        payload = self._read_json_object()
+        if payload is None:
             return None
-        if length < 0:
-            # rfile.read(-1) would mean read-to-EOF: the handler would
-            # stall to the socket timeout and answer nothing at all
-            self._send_json(400, {"error": "Content-Length must be >= 0"})
-            return None
-        if length > MAX_BODY_BYTES:
-            self._send_json(413, {"error": f"body exceeds {MAX_BODY_BYTES} "
-                                           "bytes"})
-            return None
-        try:
-            payload = json.loads(self.rfile.read(length).decode("utf-8"))
-        except (UnicodeDecodeError, ValueError):
-            self._send_json(400, {"error": "body is not valid JSON"})
-            return None
-        if not isinstance(payload, dict) or "queries" not in payload:
+        if "queries" not in payload:
             self._send_json(400, {"error": 'body must be a JSON object '
                                            'with "queries"'})
             return None
@@ -450,6 +482,100 @@ class KnnRequestHandler(JsonRequestHandler):
             deadline_s = float(deadline_ms) / 1e3
         return queries, k, deadline_s
 
+    def _do_write(self, op: str) -> None:
+        """``POST /v1/upsert`` / ``/v1/delete``: the mutable-index write
+        path. Validates, converts GLOBAL ids to this shard's local ids
+        (``--id-offset``), applies through the engine's write lock, and
+        reports the post-write delta/tombstone/epoch state — the
+        caller's backpressure signal."""
+        trace = _trace_id(self.headers)
+        state: ServeState = self.server.state
+        engine = state.engine
+        # consume the body BEFORE any early 501/503: answering with the
+        # JSON still unread leaves its bytes on the keep-alive socket,
+        # and the client's retry (told Retry-After: 1!) gets parsed out
+        # of them — the same protocol-desync class the injected-error
+        # fault path had to fix in PR 9
+        payload = self._read_json_object()
+        if payload is None:
+            return
+        if not hasattr(engine, "upsert"):
+            self._send_json(501, {"error": "this index is immutable "
+                                           "(no delta buffer wired)",
+                                  "trace_id": trace})
+            return
+        if self.server.queue.closed:
+            self._send_json(503, {"error": "server is shutting down",
+                                  "trace_id": trace})
+            return
+        if not state.ready:
+            self._send_json(503, {"error": "index is still warming up",
+                                  "trace_id": trace},
+                            extra_headers={"Retry-After": "1"})
+            return
+        ids = payload.get("ids")
+        if not isinstance(ids, list) or not (1 <= len(ids) <= MAX_WRITE_IDS):
+            self._send_json(400, {"error": f'"ids" must be a list of 1..'
+                                           f"{MAX_WRITE_IDS} ints"})
+            return
+        if not all(isinstance(i, int) and not isinstance(i, bool)
+                   for i in ids):
+            self._send_json(400, {"error": '"ids" must all be ints'})
+            return
+        offset = state.id_offset
+        if min(ids) < offset:
+            # ids are GLOBAL; anything below this shard's offset belongs
+            # to another shard — applying it here would corrupt the
+            # partition the router's merge depends on
+            self._send_json(400, {"error": f"ids below this shard's "
+                                           f"id_offset {offset} are not "
+                                           "owned here"})
+            return
+        try:
+            local = np.asarray(ids, dtype=np.int64) - offset
+        except OverflowError:
+            # a Python int past int64 passes the isinstance checks but
+            # cannot convert — that must be a 400, not a dead handler
+            # thread and a dropped connection
+            self._send_json(400, {"error": "ids must fit a 64-bit int"})
+            return
+        points = None
+        if op == "upsert":
+            try:
+                points = np.asarray(payload.get("points"), dtype=np.float32)
+            except (TypeError, ValueError):
+                self._send_json(400, {"error": '"points" must be a '
+                                               "[m, d] number array"})
+                return
+            dim = engine.tree.dim
+            if points.ndim != 2 or points.shape != (len(ids), dim):
+                self._send_json(400, {"error": f'"points" must be '
+                                               f"[{len(ids)}, {dim}] to "
+                                               "match ids, got shape "
+                                               f"{points.shape}"})
+                return
+            if not np.isfinite(points).all():
+                self._send_json(400, {"error": "points contain non-finite "
+                                               "values"})
+                return
+        try:
+            if op == "upsert":
+                res = engine.upsert(local, points)
+            else:
+                res = engine.delete(local)
+        except ValueError as e:
+            self._send_json(400, {"error": str(e), "trace_id": trace})
+            return
+        except RuntimeError as e:
+            self._send_json(503, {"error": str(e), "trace_id": trace})
+            return
+        flight.record("serve.write", op=op, trace=trace,
+                      ids=len(ids), applied=res["applied"],
+                      delta_rows=res["delta_rows"], epoch=res["epoch"])
+        res["op"] = op
+        res["trace_id"] = trace
+        self._send_json(200, res)
+
     def _retry_after(self, rows: int) -> dict:
         """The 429 extra-headers dict: Retry-After derived from the
         admission queue's measured drain rate (seconds, integer-ceil so
@@ -471,21 +597,10 @@ class KnnRequestHandler(JsonRequestHandler):
                                            "KDTREE_TPU_FAULTS) to arm the "
                                            "drill endpoint"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", ""))
-        except ValueError:
-            self._send_json(411, {"error": "Content-Length required"})
+        payload = self._read_json_object(max_bytes=1 << 20)
+        if payload is None:
             return
-        if not (0 <= length <= (1 << 20)):
-            self._send_json(400, {"error": "bad Content-Length"})
-            return
-        try:
-            payload = json.loads(self.rfile.read(length).decode("utf-8"))
-        except (UnicodeDecodeError, ValueError):
-            self._send_json(400, {"error": "body is not valid JSON"})
-            return
-        if not isinstance(payload, dict) or \
-                ("spec" not in payload) == ("clear" not in payload) or \
+        if ("spec" not in payload) == ("clear" not in payload) or \
                 ("clear" in payload and payload["clear"] is not True):
             self._send_json(400, {"error": 'body must be {"spec": "..."} '
                                            'or {"clear": true}'})
@@ -660,6 +775,11 @@ class KnnServer(GracefulHTTPServer):
             self._sampler.stop()
             self._sampler = None
         self.batcher.stop()  # closes admission, drains, fulfills futures
+        if hasattr(self.state.engine, "close"):
+            # join any in-flight epoch rebuild: the drain must not race
+            # an epoch swap, and the rebuild thread must not outlive
+            # the process teardown
+            self.state.engine.close()
         self.server_close()  # joins in-flight handler threads
         obs.flush()
 

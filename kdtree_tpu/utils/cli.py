@@ -884,6 +884,8 @@ def cmd_serve(args) -> None:
             tree=tree, points=points, problem=problem, k=args.k,
             max_batch=args.max_batch, meta=meta,
             id_offset=args.id_offset,
+            max_delta_rows=args.max_delta_rows,
+            max_delta_frac=args.max_delta_frac,
         )
     except TypeError as e:
         # un-servable checkpoint kind — crisp stderr + exit code (C10)
@@ -921,6 +923,11 @@ def cmd_serve(args) -> None:
           f"{obs_history.default_period():g}s-period metric-history ring "
           "(GET /debug/history; burn-rate verdicts in /healthz and "
           "kdtree_slo_* on /metrics)", file=sys.stderr)
+    thr = state.engine.rebuild_threshold()
+    print("mutable index armed: POST /v1/upsert + /v1/delete, epoch "
+          "rebuild at backlog >= "
+          f"{'disabled' if thr is None else thr} rows "
+          "(docs/SERVING.md \"Mutable index\")", file=sys.stderr)
     print(f"kdtree-tpu serve: binding http://{host}:{port} "
           f"(n={state.engine.tree.n_real}, dim={state.engine.tree.dim}, "
           f"k<={state.engine.k}); warming up...", file=sys.stderr)
@@ -1437,6 +1444,18 @@ def main(argv=None) -> None:
                          "[offset, offset+n) of a partitioned point set "
                          "and answers GLOBAL ids (local id + offset); "
                          "the route subcommand's merge depends on it")
+    sv.add_argument("--max-delta-rows", type=int, default=None,
+                    metavar="ROWS",
+                    help="mutable index: epoch rebuild triggers when the "
+                         "write backlog (delta rows + tombstones) reaches "
+                         "this many rows (default 4096; <= 0 disables "
+                         "this bound)")
+    sv.add_argument("--max-delta-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="mutable index: epoch rebuild triggers when the "
+                         "write backlog reaches this fraction of the "
+                         "main tree (default 0.25; <= 0 disables this "
+                         "bound; the tighter of the two bounds wins)")
     sv.add_argument("--debug-faults", action="store_true",
                     help="arm POST /debug/faults (live fault injection, "
                          "docs/SERVING.md) — a remote wedge-this-process "

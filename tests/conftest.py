@@ -60,6 +60,20 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.mesh)
 
 
+@pytest.fixture(autouse=True)
+def _isolate_flight_dump_rate_limit():
+    """The process-wide flight recorder rate-limits auto-dumps per
+    reason (5 s); without isolation, any two tests that dump the same
+    reason pass or fail by collection ORDER (the PR 9 gotcha:
+    test_flight's shed-burst vs test_slo's flood e2e). Clearing the
+    limiter before every test makes every hand-picked order behave
+    like a fresh process."""
+    from kdtree_tpu.obs import flight
+
+    flight.recorder().reset_dump_rate_limit()
+    yield
+
+
 @pytest.fixture
 def mesh8():
     from kdtree_tpu.parallel.mesh import make_mesh

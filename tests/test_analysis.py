@@ -233,6 +233,21 @@ def test_kdt201_covers_serve_batch_dispatch(tmp_path):
     assert rules_of(res) == ["KDT201"]
 
 
+def test_kdt201_covers_mutable_package(tmp_path):
+    # the mutable overlay and the epoch swap run on the serving hot
+    # path (every batch snapshots them; the swap critical section runs
+    # under the write lock queries also take) — a sync smuggled in must
+    # be flagged exactly like ops/ and serve/
+    res = lint_snippet(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def swap_epoch(state, masked):\n"
+        "    flags = jnp.sum(masked)\n"
+        "    return np.asarray(flags)\n"
+    ), relpath="mutable/engine.py")
+    assert rules_of(res) == ["KDT201"]
+
+
 def test_kdt201_exempts_http_handler_glue(tmp_path):
     # BaseHTTPRequestHandler subclasses ARE the response boundary:
     # materializing a result into JSON there is the endpoint working as

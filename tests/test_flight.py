@@ -153,6 +153,21 @@ def test_auto_dump_rate_limited_and_disableable(tmp_path, monkeypatch):
     assert rec.auto_dump("other-reason") is None
 
 
+def test_reset_dump_rate_limit_unblocks_every_reason(tmp_path,
+                                                     monkeypatch):
+    """The conftest isolation hook: clearing the limiter makes the next
+    auto_dump of ANY reason write immediately — this is what decouples
+    the shed-burst test here from test_slo's flood e2e (the PR 9
+    collection-order gotcha)."""
+    monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
+    rec = flight.FlightRecorder(capacity=4)
+    rec.record("evt")
+    assert rec.auto_dump("iso-reason") is not None
+    assert rec.auto_dump("iso-reason") is None  # rate-limited
+    rec.reset_dump_rate_limit()
+    assert rec.auto_dump("iso-reason") is not None
+
+
 def test_burst_detector_fires_on_burst_not_trickle():
     det = flight.BurstDetector(threshold=5, window_s=10.0)
     fired = [det.mark() for _ in range(5)]
@@ -210,7 +225,12 @@ def test_serve_error_path_triggers_ring_event_and_dump(tmp_path, monkeypatch):
     events = flight.recorder().snapshot()
     errs = [e for e in events if e["type"] == "serve.batch_error"]
     assert errs and "trace-boom" in errs[-1]["traces"]
+    # incident dumps serialize on a background writer thread (the batch
+    # worker must not pay file I/O inline) — poll, don't assert instantly
     dump = tmp_path / "flight-serve-error.json"
+    deadline = time.monotonic() + 30.0
+    while not dump.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert dump.exists()
     data = json.loads(dump.read_text())
     assert data["reason"] == "serve-error"
@@ -233,6 +253,9 @@ def test_shed_burst_triggers_dump(tmp_path, monkeypatch):
             queue.submit(PendingRequest(np.zeros((1, 3), np.float32), k=1,
                                         trace_id="shedder"))
     dump = tmp_path / "flight-serve-shed-burst.json"
+    deadline = time.monotonic() + 30.0  # async writer thread — poll
+    while not dump.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert dump.exists()
     data = json.loads(dump.read_text())
     sheds = [e for e in data["events"] if e["type"] == "serve.shed"]

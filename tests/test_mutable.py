@@ -1,0 +1,681 @@
+"""Mutable index (docs/SERVING.md "Mutable index"): the LSM-style delta
+buffer + tombstones + epoch rebuild must never change an answer.
+
+The contract under test is the exactness invariant: after ANY
+interleaving of upserts, deletes, and queries — including the k-boundary
+case where the deleted point was the k-th hit, and including queries
+in flight across an epoch swap — the engine's answer is byte-identical
+(distances AND ids) to a rebuild-from-scratch index over the surviving
+points. Epoch mechanics (threshold trigger fires exactly once, swap is
+atomic between batches, journal replay loses nothing) are pinned on
+top of that.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import obs
+from kdtree_tpu.mutable import DeltaBuffer, MutableEngine, merge_rows
+from kdtree_tpu.serve import lifecycle, server as srv
+from kdtree_tpu.serve.lifecycle import ServeEngine
+
+DIM, N, K = 3, 512, 4
+SEED = 7
+
+
+@pytest.fixture(scope="module")
+def base_points():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    return np.asarray(generate_points_rowwise(SEED, DIM, N))
+
+
+@pytest.fixture(scope="module")
+def queries():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    return np.asarray(
+        generate_points_rowwise(11, DIM, 8), dtype=np.float32
+    )
+
+
+def fresh_engine(points, **kw) -> MutableEngine:
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import build_morton
+
+    kw.setdefault("max_delta_rows", 1 << 30)
+    kw.setdefault("max_delta_frac", 0.0)
+    return MutableEngine(
+        ServeEngine(build_morton(jnp.asarray(points)), K), **kw
+    )
+
+
+def oracle_answer(model, queries, k=K):
+    """The rebuild-from-scratch oracle: a fresh Morton index over the
+    surviving points (original ids preserved), queried through the same
+    serving facade."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import morton_view
+
+    ids = np.array(sorted(model), dtype=np.int64)
+    pts = np.stack([model[i] for i in ids.tolist()]).astype(np.float32)
+    tree = morton_view(
+        jnp.asarray(pts), gid=jnp.asarray(ids.astype(np.int32)),
+        n_real=int(ids.size),
+    )
+    d2, gids, _ = ServeEngine(tree, k).knn_batch(queries)
+    return d2, gids
+
+
+def assert_exact(eng, model, queries, tag=""):
+    d2, ids, _ = eng.knn_batch(queries)
+    od2, oids = oracle_answer(model, queries)
+    np.testing.assert_array_equal(ids, oids, err_msg=f"ids differ ({tag})")
+    np.testing.assert_array_equal(d2, od2, err_msg=f"d2 differ ({tag})")
+
+
+def _counter(key):
+    return obs.get_registry().snapshot()["counters"].get(key, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# units: delta buffer + merge
+# ---------------------------------------------------------------------------
+
+
+def test_delta_buffer_put_update_drop_grow():
+    buf = DeltaBuffer(dim=2, min_capacity=2)
+    assert buf.capacity >= 64  # floor guards capacity >= any sane k
+    cap0 = buf.capacity
+    assert buf.put(5, np.array([1.0, 2.0]))       # fresh
+    assert not buf.put(5, np.array([3.0, 4.0]))   # update, same slot
+    assert buf.rows == 1
+    np.testing.assert_array_equal(buf.get(5), [3.0, 4.0])
+    assert buf.drop(5) and not buf.drop(5)
+    assert buf.rows == 0 and buf.get(5) is None
+    # growth doubles the pow2 capacity and keeps every live row
+    for i in range(cap0 + 1):
+        buf.put(100 + i, np.array([float(i), 0.0]))
+    assert buf.capacity == 2 * cap0 and buf.rows == cap0 + 1
+    np.testing.assert_array_equal(buf.get(100), [0.0, 0.0])
+
+
+def test_delta_view_is_a_stable_snapshot():
+    buf = DeltaBuffer(dim=2)
+    buf.put(1, np.array([1.0, 1.0]))
+    buf.refresh()
+    pts_a, gid_a = buf.view()
+    buf.put(2, np.array([2.0, 2.0]))
+    buf.refresh()
+    pts_b, gid_b = buf.view()
+    # the old snapshot still describes the old state: a query that
+    # grabbed it before the write must not see a half-applied buffer
+    assert gid_a.tolist().count(2) == 0
+    assert gid_b.tolist().count(2) == 1
+    assert np.isinf(np.asarray(pts_a)[1]).all()
+
+
+def test_merge_rows_distance_id_order_and_padding():
+    d2 = np.array([[0.5, np.inf, 0.25], [1.0, 1.0, np.inf]],
+                  dtype=np.float32)
+    ids = np.array([[7, -1, 9], [3, 1, -1]], dtype=np.int32)
+    md, mi = merge_rows(d2, ids, k=2)
+    assert mi.tolist() == [[9, 7], [1, 3]]  # ties break by id
+    assert md.tolist() == [[0.25, 0.5], [1.0, 1.0]]
+    # fewer real candidates than k: (inf, -1) padding survives, last
+    md, mi = merge_rows(d2[:1], ids[:1], k=3)
+    assert mi.tolist() == [[9, 7, -1]]
+    assert md[0, 2] == np.inf
+
+
+# ---------------------------------------------------------------------------
+# exactness: interleavings vs the rebuild-from-scratch oracle
+# ---------------------------------------------------------------------------
+
+
+def test_interleaved_mutations_byte_identical_to_oracle(base_points,
+                                                        queries):
+    eng = fresh_engine(base_points)
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(0)
+    assert_exact(eng, model, queries, "pristine (empty overlay)")
+    # inserts: brand-new ids beyond the main range
+    new_ids = np.arange(N, N + 24)
+    new_pts = rng.uniform(-100, 100, (24, DIM)).astype(np.float32)
+    eng.upsert(new_ids, new_pts)
+    for i, p in zip(new_ids.tolist(), new_pts):
+        model[i] = p
+    assert_exact(eng, model, queries, "after inserts")
+    # updates: move existing main points (shadow the main copy)
+    mv_ids = np.array([1, 50, 200])
+    mv_pts = rng.uniform(-100, 100, (3, DIM)).astype(np.float32)
+    eng.upsert(mv_ids, mv_pts)
+    for i, p in zip(mv_ids.tolist(), mv_pts):
+        model[i] = p
+    assert_exact(eng, model, queries, "after moves")
+    # deletes across both tiers: a main id and a delta id
+    eng.delete(np.array([3, int(new_ids[0])]))
+    model.pop(3), model.pop(int(new_ids[0]))
+    assert_exact(eng, model, queries, "after mixed deletes")
+    # delete a moved id: both its delta copy and its masked main slot die
+    eng.delete(np.array([1]))
+    model.pop(1)
+    assert_exact(eng, model, queries, "after deleting a moved id")
+    eng.close()
+
+
+def test_tombstone_at_k_boundary(base_points, queries):
+    """Delete exactly the k-th hit of a query row: the masked slot's
+    replacement (the true (k+1)-th point) must surface — the correction
+    path, not just masking."""
+    eng = fresh_engine(base_points)
+    model = {i: base_points[i].copy() for i in range(N)}
+    before = _counter("kdtree_mutable_corrections_total")
+    d2, ids, _ = eng.knn_batch(queries)
+    victim = int(ids[0, K - 1])     # row 0's k-th hit
+    eng.delete(np.array([victim]))
+    model.pop(victim)
+    assert_exact(eng, model, queries, "k-th hit deleted")
+    assert _counter("kdtree_mutable_corrections_total") > before
+    # and the 1st hit too — the strongest boundary
+    d2, ids, _ = eng.knn_batch(queries)
+    victim = int(ids[0, 0])
+    eng.delete(np.array([victim]))
+    model.pop(victim)
+    assert_exact(eng, model, queries, "1st hit deleted")
+    eng.close()
+
+
+def test_fallback_path_exact_over_surviving(base_points, queries):
+    """The brute-force degradation path (deadline/oversized answers)
+    must apply the same overlay: masked main + delta, merged."""
+    eng = fresh_engine(base_points)
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(1)
+    ins = rng.uniform(-100, 100, (5, DIM)).astype(np.float32)
+    eng.upsert(np.arange(N, N + 5), ins)
+    for i, p in zip(range(N, N + 5), ins):
+        model[i] = p
+    eng.delete(np.array([0, 7]))
+    model.pop(0), model.pop(7)
+    d2, ids = eng.fallback_knn(queries, K)
+    od2, oids = oracle_answer(model, queries)
+    np.testing.assert_array_equal(ids, oids)
+    np.testing.assert_array_equal(d2, od2)
+    eng.close()
+
+
+def test_write_validation():
+    eng = fresh_engine(np.arange(30.0).reshape(10, 3).astype(np.float32))
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.upsert(np.array([1, 1]), np.zeros((2, 3), np.float32))
+    with pytest.raises(ValueError, match="int32"):
+        eng.upsert(np.array([2**31]), np.zeros((1, 3), np.float32))
+    with pytest.raises(ValueError, match=">= 0|\\[0,"):
+        eng.delete(np.array([-1]))
+    with pytest.raises(ValueError, match="3-D"):
+        eng.upsert(np.array([1]), np.zeros((1, 2), np.float32))
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.delete(np.array([1]))
+
+
+# ---------------------------------------------------------------------------
+# epoch rebuild
+# ---------------------------------------------------------------------------
+
+
+def test_delta_overflow_triggers_rebuild_exactly_once(base_points,
+                                                      queries):
+    eng = fresh_engine(base_points, max_delta_rows=16)
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(2)
+    before = _counter("kdtree_mutable_rebuilds_total")
+    ids = np.arange(N, N + 16)
+    pts = rng.uniform(-100, 100, (16, DIM)).astype(np.float32)
+    eng.upsert(ids, pts)   # backlog 16 >= threshold 16: trigger
+    for i, p in zip(ids.tolist(), pts):
+        model[i] = p
+    deadline = time.monotonic() + 120
+    while eng.epoch < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng.epoch == 1, eng.stats()
+    st = eng.stats()
+    assert st["delta_rows"] == 0 and st["tombstones"] == 0
+    assert st["n"] == N + 16
+    assert _counter("kdtree_mutable_rebuilds_total") == before + 1
+    assert_exact(eng, model, queries, "post-swap")
+    # one more write below the threshold: no second rebuild
+    eng.upsert(np.array([N + 100]),
+               rng.uniform(-100, 100, (1, DIM)).astype(np.float32))
+    time.sleep(0.3)
+    assert eng.epoch == 1
+    assert _counter("kdtree_mutable_rebuilds_total") == before + 1
+    eng.close()
+
+
+def test_writes_during_rebuild_replay_onto_new_epoch(base_points,
+                                                     queries):
+    """The journal: writes landing while the compaction runs apply live
+    AND survive the swap — nothing lost, nothing doubled."""
+    eng = fresh_engine(base_points, max_delta_rows=8)
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(3)
+    # slow the compaction down so the mid-rebuild writes land in the
+    # journal deterministically
+    orig = eng._compact
+    gate = threading.Event()
+
+    def slow_compact(*a, **kw):
+        gate.wait(timeout=30)
+        return orig(*a, **kw)
+
+    eng._compact = slow_compact
+    ids = np.arange(N, N + 8)
+    pts = rng.uniform(-100, 100, (8, DIM)).astype(np.float32)
+    eng.upsert(ids, pts)   # triggers; compaction parked on the gate
+    for i, p in zip(ids.tolist(), pts):
+        model[i] = p
+    assert eng.stats()["rebuilding"]
+    # mid-rebuild traffic: an insert and a delete
+    eng.upsert(np.array([N + 50]),
+               np.array([[55.0, 55.0, 55.0]], np.float32))
+    model[N + 50] = np.array([55.0, 55.0, 55.0], np.float32)
+    eng.delete(np.array([int(ids[0]), 9]))
+    model.pop(int(ids[0])), model.pop(9)
+    assert_exact(eng, model, queries, "mid-rebuild (live overlay)")
+    gate.set()
+    deadline = time.monotonic() + 120
+    while eng.epoch < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng.epoch == 1
+    assert_exact(eng, model, queries, "post-swap with journal replay")
+    st = eng.stats()
+    # the replayed writes live on as the new epoch's overlay: the N+50
+    # insert is a delta row, and BOTH deletes are tombstones — ids[0]
+    # was compacted into the new main, so its delete masks the new copy
+    assert st["delta_rows"] == 1 and st["tombstones"] == 2
+    eng.close()
+
+
+def test_epoch_swap_under_concurrent_queries_every_answer_exact(
+    base_points, queries,
+):
+    """Queries hammering across the swap: every single answer —
+    pre-swap overlay, post-swap fresh tree, and anything in between —
+    must be byte-identical to the oracle; every call must answer
+    exactly once (no drops, no doubles)."""
+    eng = fresh_engine(base_points)
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(4)
+    ids = np.arange(N, N + 12)
+    pts = rng.uniform(-100, 100, (12, DIM)).astype(np.float32)
+    eng.upsert(ids, pts)
+    for i, p in zip(ids.tolist(), pts):
+        model[i] = p
+    eng.delete(np.array([5, 6]))
+    model.pop(5), model.pop(6)
+    od2, oids = oracle_answer(model, queries)
+    # next write triggers: the backlog already equals the new threshold
+    eng.max_delta_rows = eng.stats()["delta_rows"] + \
+        eng.stats()["tombstones"]
+    orig = eng._compact
+
+    def slow_compact(*a, **kw):
+        time.sleep(0.4)   # guarantee queries overlap the rebuild window
+        return orig(*a, **kw)
+
+    eng._compact = slow_compact
+    stop = threading.Event()
+    failures: list = []
+    counts = [0, 0, 0]
+
+    def qworker(slot):
+        while not stop.is_set():
+            d2, rids, _ = eng.knn_batch(queries)
+            if not (np.array_equal(d2, od2) and np.array_equal(rids,
+                                                               oids)):
+                failures.append((slot, rids.tolist()))
+                return
+            counts[slot] += 1
+
+    threads = [threading.Thread(target=qworker, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    # the trigger is a content-no-op: re-upsert an existing delta id
+    # with its existing coordinates — backlog crosses, answers don't
+    eng.upsert(ids[:1], pts[:1])
+    deadline = time.monotonic() + 120
+    while eng.epoch < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.2)   # a little post-swap traffic too
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not failures, failures[:1]
+    assert eng.epoch == 1
+    assert all(c > 0 for c in counts), counts
+    assert_exact(eng, model, queries, "steady state after swap")
+    eng.close()
+
+
+def test_churn_counts_toward_backlog_and_compacts(base_points, queries):
+    """Upsert-then-delete churn leaves dropped slots the buffer never
+    reuses; they must count toward the backlog so a compaction reclaims
+    them — otherwise capacity doubles forever while delta_rows reads 0."""
+    eng = fresh_engine(base_points, max_delta_rows=16)
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(8)
+    for i in range(8):   # 8 upsert+delete pairs of delta-only ids
+        gid = N + 1000 + i
+        eng.upsert(np.array([gid]),
+                   rng.uniform(-100, 100, (1, DIM)).astype(np.float32))
+        eng.delete(np.array([gid]))
+    st = eng.stats()
+    assert st["delta_rows"] == 0 and st["tombstones"] == 0
+    assert st["backlog"] == 8  # the holes ARE the backlog
+    for i in range(8):   # 8 more pairs cross the threshold
+        gid = N + 2000 + i
+        eng.upsert(np.array([gid]),
+                   rng.uniform(-100, 100, (1, DIM)).astype(np.float32))
+        eng.delete(np.array([gid]))
+    deadline = time.monotonic() + 120
+    while eng.epoch < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng.epoch == 1
+    st = eng.stats()
+    # the holes are reclaimed; the one leftover unit is the final
+    # pair's delete, which lands relative to the NEW epoch (its id was
+    # compacted into the new main, so the delete is now a tombstone)
+    assert st["delta_rows"] == 0 and st["backlog"] <= 1
+    assert eng._state.delta.holes == 0
+    assert_exact(eng, model, queries, "after churn compaction")
+    eng.close()
+
+
+def test_requested_k_survives_growth_past_bootstrap_size():
+    """A tiny bootstrap index must not pin k forever: the inner engine
+    clamps k to its n_real, but the CONFIGURED k governs every rebuilt
+    epoch — after growth, the full k serves."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import build_morton
+
+    seed = np.arange(15.0).reshape(5, 3).astype(np.float32)
+    eng = MutableEngine(ServeEngine(build_morton(jnp.asarray(seed)), 16),
+                        max_delta_rows=40, max_delta_frac=0.0,
+                        requested_k=16)
+    assert eng.k == 5  # bootstrap clamp
+    rng = np.random.default_rng(6)
+    eng.upsert(np.arange(5, 45),
+               rng.uniform(-100, 100, (40, 3)).astype(np.float32))
+    deadline = time.monotonic() + 120
+    while eng.epoch < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng.epoch == 1
+    assert eng.k == 16  # 45 points now — the configured k is back
+    eng.close()
+
+
+def test_delta_padding_never_leaks_a_real_id():
+    """A FULL delta buffer with one survivor: brute force can return the
+    init carry's -1 index for the empty tail of the top-k, and an
+    unguarded gid map would wrap it to the LAST slot's real id — a
+    phantom duplicate at distance inf."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import build_morton
+
+    seed = np.arange(30.0).reshape(10, 3).astype(np.float32)
+    eng = fresh_engine(seed)
+    cap = eng._state.delta.capacity
+    rng = np.random.default_rng(7)
+    ids = np.arange(100, 100 + cap)
+    eng.upsert(ids, rng.uniform(-100, 100, (cap, 3)).astype(np.float32))
+    assert eng._state.delta.capacity == cap  # full, not yet grown
+    eng.delete(ids[:-1])  # only the LAST slot stays live
+    snap = eng._snapshot()
+    d2, got = eng._delta_knn(np.zeros((8, 3), np.float32), snap, k=4)
+    keep = int(ids[-1])
+    assert got[0, 0] == keep and d2[0, 0] < np.inf
+    # the empty tail is honest padding — never the survivor's id again
+    assert got[0, 1:].tolist() == [-1, -1, -1]
+    assert np.isinf(d2[0, 1:]).all()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP: the serving write path
+# ---------------------------------------------------------------------------
+
+
+def _post(httpd, path, payload, timeout=120.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{httpd.server_address[1]}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(httpd, path, timeout=30.0):
+    url = f"http://127.0.0.1:{httpd.server_address[1]}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture
+def mutable_server(base_points):
+    state = lifecycle.build_state(points=base_points, k=K, max_batch=64,
+                                  max_delta_rows=32)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0)
+    httpd.start(warmup_buckets=[8])
+    yield httpd
+    httpd.stop()
+
+
+def test_http_upsert_query_delete_roundtrip(mutable_server):
+    httpd = mutable_server
+    sentinel = [500.0, 500.0, 500.0]
+    st, body = _post(httpd, "/v1/upsert",
+                     {"ids": [9000], "points": [sentinel]})
+    assert st == 200 and body["applied"] == 1 and body["op"] == "upsert"
+    assert body["delta_rows"] == 1 and body["epoch"] == 0
+    st, body = _post(httpd, "/v1/knn",
+                     {"queries": [[499.0, 499.0, 499.0]], "k": 1})
+    assert st == 200 and body["ids"][0][0] == 9000
+    st, body = _post(httpd, "/v1/delete", {"ids": [9000]})
+    assert st == 200 and body["applied"] == 1
+    st, body = _post(httpd, "/v1/knn",
+                     {"queries": [[499.0, 499.0, 499.0]], "k": 1})
+    assert st == 200 and body["ids"][0][0] != 9000
+    # healthz carries the mutable block the router and operators read
+    st, raw = _get(httpd, "/healthz")
+    hz = json.loads(raw)
+    assert hz["epoch"] == 0 and hz["id_offset"] == 0
+    assert hz["mutable"]["tombstones"] == 0  # delete of a delta-only id
+    assert hz["mutable"]["threshold"] == 32
+
+
+def test_http_write_validation_rejections(mutable_server):
+    httpd = mutable_server
+    cases = [
+        ("/v1/upsert", {"ids": [1]}),                       # no points
+        ("/v1/upsert", {"ids": [1], "points": [[1.0]]}),    # wrong dim
+        ("/v1/upsert", {"ids": "x", "points": []}),         # ids not list
+        ("/v1/upsert", {"ids": [1], "points": [[1e400, 0, 0]]}),
+        ("/v1/upsert", {"ids": [True], "points": [[1.0, 2.0, 3.0]]}),
+        ("/v1/delete", {"ids": []}),
+        ("/v1/delete", {"ids": [1, 1]}),                    # duplicates
+        # past int64: must be a 400, not a dead handler thread and a
+        # dropped connection (np.asarray raises OverflowError)
+        ("/v1/delete", {"ids": [2**63]}),
+        ("/v1/upsert", {"ids": [2**63], "points": [[1.0, 2.0, 3.0]]}),
+    ]
+    for path, payload in cases:
+        st, body = _post(httpd, path, payload)
+        assert st == 400, (path, payload, st, body)
+        assert "error" in body
+
+
+def test_http_write_on_warming_server_keeps_connection_in_sync(
+    base_points,
+):
+    """An early 503 (warming) must still CONSUME the request body: on a
+    keep-alive connection the unread JSON would otherwise be parsed as
+    the next request line — the retry the 503 itself invited would get
+    garbage instead of service."""
+    import http.client
+
+    state = lifecycle.build_state(points=base_points, k=K, max_batch=64)
+    httpd = srv.make_server(state, port=0)
+    accept = threading.Thread(target=httpd.serve_forever)
+    accept.start()
+    try:
+        assert not state.ready  # no warmup ran: every write 503s
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", httpd.server_address[1], timeout=30
+        )
+        try:
+            body = json.dumps({"ids": [9000],
+                               "points": [[1.0, 2.0, 3.0]]})
+            for _ in range(2):  # SAME connection, back to back
+                conn.request("POST", "/v1/upsert", body=body,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                assert resp.status == 503, payload
+                assert "warming" in payload["error"]
+        finally:
+            conn.close()
+    finally:
+        httpd.shutdown()
+        accept.join()
+        httpd.batcher.start()
+        httpd.batcher.stop()
+        httpd.server_close()
+
+
+def test_http_id_offset_writes_are_global(base_points):
+    """A sharded serve process owns [offset, ...): global write ids are
+    localized on the way in and answers come back global — the router's
+    merge depends on both."""
+    state = lifecycle.build_state(points=base_points, k=K, max_batch=64,
+                                  id_offset=1000, max_delta_rows=1 << 20)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0)
+    httpd.start(warmup_buckets=[8])
+    try:
+        st, body = _post(httpd, "/v1/upsert",
+                         {"ids": [50], "points": [[1.0, 2.0, 3.0]]})
+        assert st == 400 and "id_offset" in body["error"]
+        st, body = _post(httpd, "/v1/upsert",
+                         {"ids": [1000 + N + 5],
+                          "points": [[600.0, 600.0, 600.0]]})
+        assert st == 200 and body["applied"] == 1
+        st, body = _post(httpd, "/v1/knn",
+                         {"queries": [[600.0, 600.0, 600.0]], "k": 1})
+        assert st == 200 and body["ids"][0][0] == 1000 + N + 5
+        st, raw = _get(httpd, "/healthz")
+        assert json.loads(raw)["id_offset"] == 1000
+    finally:
+        httpd.stop()
+
+
+def test_mutation_e2e_under_concurrent_load(base_points, queries):
+    """The acceptance e2e: a live serve process under concurrent query
+    load absorbs upserts+deletes, crosses the delta threshold, rebuilds
+    and swaps an epoch — with zero failed responses, and every
+    post-swap answer byte-identical to a fresh-build oracle over the
+    surviving points."""
+    state = lifecycle.build_state(points=base_points, k=K, max_batch=64,
+                                  max_delta_rows=24)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0,
+                            queue_rows=4096)
+    httpd.start(warmup_buckets=[8])
+    model = {i: base_points[i].copy() for i in range(N)}
+    rng = np.random.default_rng(5)
+    stop = threading.Event()
+    bad: list = []
+    ok_counts = [0, 0, 0]
+    body = {"queries": queries[:4].tolist(), "k": K}
+
+    def client(slot):
+        while not stop.is_set():
+            st, resp = _post(httpd, "/v1/knn", body)
+            if st != 200:
+                bad.append((slot, st, resp))
+                return
+            ok_counts[slot] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        # write traffic: moves, deletes, inserts — crossing threshold 24
+        mv = np.array([10, 11, 12])
+        mvp = rng.uniform(-100, 100, (3, DIM)).astype(np.float32)
+        st, _ = _post(httpd, "/v1/upsert",
+                      {"ids": mv.tolist(), "points": mvp.tolist()})
+        assert st == 200
+        for i, p in zip(mv.tolist(), mvp):
+            model[i] = p
+        st, _ = _post(httpd, "/v1/delete", {"ids": [20, 21]})
+        assert st == 200
+        model.pop(20), model.pop(21)
+        ins = np.arange(N, N + 20)
+        insp = rng.uniform(-100, 100, (20, DIM)).astype(np.float32)
+        st, resp = _post(httpd, "/v1/upsert",
+                         {"ids": ins.tolist(), "points": insp.tolist()})
+        assert st == 200 and resp["rebuilding"], resp
+        for i, p in zip(ins.tolist(), insp):
+            model[i] = p
+        # wait for the swap, with queries still hammering
+        deadline = time.monotonic() + 120
+        epoch = 0
+        while time.monotonic() < deadline:
+            st, raw = _get(httpd, "/metrics")
+            for line in raw.splitlines():
+                if line.startswith("kdtree_epoch "):
+                    epoch = int(float(line.split(" ")[1]))
+            if epoch >= 1:
+                break
+            time.sleep(0.1)
+        assert epoch == 1, "epoch never swapped"
+        time.sleep(0.2)  # post-swap traffic under load too
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not bad, bad[:2]
+    assert all(c > 0 for c in ok_counts), ok_counts
+    # every post-swap answer byte-identical to the fresh-build oracle,
+    # through the same JSON transform the HTTP boundary applies
+    st, resp = _post(httpd, "/v1/knn",
+                     {"queries": queries.tolist(), "k": K})
+    assert st == 200 and resp["degraded"] is None
+    od2, oids = oracle_answer(model, np.asarray(queries))
+    assert resp["ids"] == oids.tolist()
+    assert resp["distances"] == np.sqrt(
+        od2.astype(np.float64)
+    ).tolist()
+    st, raw = _get(httpd, "/healthz")
+    hz = json.loads(raw)
+    assert hz["epoch"] == 1 and hz["mutable"]["delta_rows"] == 0
+    httpd.stop()

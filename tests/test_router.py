@@ -523,6 +523,218 @@ def test_healthz_below_quorum_503(shards):
 
 
 # ---------------------------------------------------------------------------
+# /metrics federation (one scrape for the fleet)
+# ---------------------------------------------------------------------------
+
+
+def _get_text(router, path, timeout=30.0):
+    url = f"http://127.0.0.1:{router.server_address[1]}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def test_metrics_federation_shard_labeled_and_family_grouped(shards):
+    with router_for(shards) as router:
+        status, text = _get_text(router, "/metrics?federate=1")
+        assert status == 200
+        lines = text.splitlines()
+        # every shard's serving families appear, shard-labeled
+        for i in range(N_SHARDS):
+            assert any(
+                ln.startswith(f'kdtree_serve_ready{{shard="{i}"}}')
+                for ln in lines
+            ), f"shard {i} series missing"
+            assert f'kdtree_router_federated_up{{shard="{i}"}} 1' in lines
+        # the router's own families ride along un-labeled
+        assert any(ln.startswith("kdtree_router_shards ")
+                   for ln in lines)
+        # format requirement: each family is ONE contiguous block —
+        # a # TYPE header may appear only once per family
+        seen = set()
+        for ln in lines:
+            if ln.startswith("# TYPE "):
+                name = ln.split(" ")[2]
+                assert name not in seen, f"family {name} split in two"
+                seen.add(name)
+        # shard-labeled histograms keep their inner labels too
+        assert any(
+            ln.startswith('kdtree_serve_request_seconds_bucket{shard="0",')
+            for ln in lines
+        )
+
+
+def test_metrics_federation_reports_dead_shard_not_scrape_failure(shards):
+    with router_for(shards) as router:
+        # point shard 2's table entry at a dead port: the scrape must
+        # still answer 200 and name the gap instead of failing
+        real_port = router.shards[2].port
+        router.shards[2].port = 1  # nothing listens there
+        status, text = _get_text(router, "/metrics?federate=1")
+        router.shards[2].port = real_port
+        assert status == 200
+        assert 'kdtree_router_federated_up{shard="2"} 0' in text
+        assert 'kdtree_router_federated_up{shard="0"} 1' in text
+        # the failure counted; it lands on the router's own exposition
+        status, text = _get_text(router, "/metrics")
+        assert 'kdtree_router_federate_errors_total{shard="2"}' in text
+
+
+def test_plain_metrics_unchanged_by_federation_flag(shards):
+    with router_for(shards) as router:
+        status, text = _get_text(router, "/metrics")
+        assert status == 200
+        # no synthetic federation families, no federation-injected
+        # shard labels (in-process shards share this registry, so the
+        # serve families themselves legitimately appear un-labeled)
+        assert "kdtree_router_federated_up" not in text
+        assert 'kdtree_serve_ready{shard="' not in text
+        assert any(ln.startswith("# TYPE kdtree_router_shards")
+                   for ln in text.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# write passthrough (mutable index): ids partition by owning shard
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def write_shards(points):
+    """A fresh 2-shard fleet for WRITE tests — the module-scoped
+    ``shards`` fixture must stay immutable (the oracle-identity tests
+    depend on its content)."""
+    servers, urls = [], []
+    for i in range(2):
+        sub = points[i * SHARD_N:(i + 1) * SHARD_N]
+        state = lifecycle.build_state(
+            points=sub, k=K, max_batch=64, id_offset=i * SHARD_N,
+            max_delta_rows=1 << 20,
+        )
+        httpd = srv.make_server(state, port=0)
+        httpd.start(warmup_buckets=[8])
+        servers.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+    yield servers, urls
+    for httpd in servers:
+        httpd.stop()
+
+
+@contextlib.contextmanager
+def write_router(urls, probe=True, **cfg):
+    defaults = dict(deadline_s=30.0, retries=1, backoff_base_s=0.01)
+    defaults.update(cfg)
+    router = rt.make_router(urls, config=rt.RouterConfig(**defaults))
+    router.start(health_loop=False)
+    try:
+        if probe:
+            for shard in router.shards:
+                router._probe_health(shard)
+        yield router
+    finally:
+        router.stop()
+
+
+def _post_path(router, path, payload, timeout=120.0):
+    url = f"http://127.0.0.1:{router.server_address[1]}{path}"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_route_write_partitions_by_id_range(write_shards):
+    servers, urls = write_shards
+    with write_router(urls) as router:
+        assert [s.id_offset for s in router.shards] == [0, SHARD_N]
+        # one request spanning both shards + a brand-new id (beyond
+        # every range → owned by the last shard)
+        ids = [5, SHARD_N + 7, 10 * SHARD_N]
+        pts = [[300.0, 300.0, 300.0], [310.0, 310.0, 310.0],
+               [320.0, 320.0, 320.0]]
+        status, body = _post_path(router, "/v1/upsert",
+                                  {"ids": ids, "points": pts})
+        assert status == 200 and body["applied"] == 3, body
+        assert set(body["shards"]) == {"0", "1"}
+        assert body["shards"]["0"]["applied"] == 1
+        assert body["shards"]["1"]["applied"] == 2
+        # the routed read sees all three, with GLOBAL ids
+        status, body = _post_path(router, "/v1/knn",
+                                  {"queries": [[305.0, 305.0, 305.0]],
+                                   "k": 3})
+        assert status == 200
+        assert sorted(body["ids"][0]) == sorted(ids)
+        # routed delete: only the owning shard applies it
+        status, body = _post_path(router, "/v1/delete",
+                                  {"ids": [SHARD_N + 7]})
+        assert status == 200 and body["applied"] == 1
+        assert list(body["shards"]) == ["1"]
+        status, body = _post_path(router, "/v1/knn",
+                                  {"queries": [[305.0, 305.0, 305.0]],
+                                   "k": 3})
+        assert SHARD_N + 7 not in body["ids"][0]
+
+
+def test_route_write_validation_and_unknown_ranges(write_shards):
+    servers, urls = write_shards
+    with write_router(urls, probe=False) as router:
+        # no health probe has run: id ranges unknown — refusing beats
+        # guessing a partition
+        status, body = _post_path(router, "/v1/upsert",
+                                  {"ids": [1],
+                                   "points": [[1.0, 2.0, 3.0]]})
+        assert status == 503 and "id ranges unknown" in body["error"]
+    with write_router(urls) as router:
+        status, body = _post_path(router, "/v1/upsert",
+                                  {"ids": [], "points": []})
+        assert status == 400
+        status, body = _post_path(router, "/v1/upsert", {"ids": [3]})
+        assert status == 400 and "points" in body["error"]
+        status, body = _post_path(router, "/v1/delete", {"ids": [1.5]})
+        assert status == 400
+        # duplicates must be rejected BEFORE partitioning: a dup
+        # spanning shards would be 400d by one shard after another
+        # already applied — a guaranteed half-write
+        status, body = _post_path(
+            router, "/v1/upsert",
+            {"ids": [5, 5, SHARD_N + 7],
+             "points": [[1.0, 2.0, 3.0]] * 3},
+        )
+        assert status == 400 and "duplicate" in body["error"]
+        assert "applied" not in body or body.get("applied") in (None, 0)
+        # a shard-side rejection (wrong dim) propagates as a clean 4xx
+        # when a single shard owns the whole request
+        status, body = _post_path(router, "/v1/upsert",
+                                  {"ids": [3], "points": [[1.0, 2.0]]})
+        assert status == 400, body
+        assert body["applied"] == 0
+
+
+def test_route_write_failed_shard_answers_502_partial_visible(
+    write_shards,
+):
+    servers, urls = write_shards
+    with write_router(urls) as router:
+        # kill shard 1's listener: a spanning write must answer 502
+        # with the per-shard outcome visible, never a silent half-write
+        real_port = router.shards[1].port
+        router.shards[1].port = 1
+        status, body = _post_path(
+            router, "/v1/upsert",
+            {"ids": [6, SHARD_N + 8],
+             "points": [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]},
+        )
+        assert status == 502, body
+        assert body["applied"] == 1  # shard 0's half DID apply
+        assert body["shards"]["0"]["applied"] == 1
+        assert "error" in body["shards"]["1"]
+        router.shards[1].port = real_port
+
+
+# ---------------------------------------------------------------------------
 # Retry-After honored
 # ---------------------------------------------------------------------------
 

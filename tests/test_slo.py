@@ -115,11 +115,10 @@ def test_page_requires_both_fast_windows():
 
 
 def test_sustained_burn_pages_and_sets_gauges(tmp_path, monkeypatch):
-    from kdtree_tpu.obs import flight
-
+    # (the conftest autouse fixture resets the flight recorder's
+    # per-reason dump rate limit, so this test no longer depends on
+    # collection order for its PAGE dump)
     monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
-    # the process recorder rate-limits per reason across tests
-    flight.recorder()._last_dump.pop("slo-shed-rate", None)
     reg = MetricsRegistry()
     h = _ring(reg, [
         (100.0, 0, 0), (104.0, 50, 50), (108.0, 100, 100),
@@ -135,11 +134,18 @@ def test_sustained_burn_pages_and_sets_gauges(tmp_path, monkeypatch):
     c = reg.snapshot()["counters"]
     assert c['kdtree_slo_transitions_total{slo="shed-rate",to="PAGE"}'] == 1.0
     # the PAGE transition dumped a flight ring NAMING the burning SLO,
-    # with the history companion alongside it
-    assert (tmp_path / "flight-slo-shed-rate.json").exists()
-    dump = json.loads((tmp_path / "flight-slo-shed-rate.json").read_text())
+    # with the history companion alongside it (async writer thread —
+    # poll for the pair)
+    dump_path = tmp_path / "flight-slo-shed-rate.json"
+    companion = tmp_path / "history-slo-shed-rate.json"
+    deadline = time.monotonic() + 30.0
+    while not (dump_path.exists() and companion.exists()) and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert dump_path.exists()
+    dump = json.loads(dump_path.read_text())
     assert dump["reason"] == "slo-shed-rate"
-    assert (tmp_path / "history-slo-shed-rate.json").exists()
+    assert companion.exists()
     # history carries the page mark
     assert eng.history.report()["marks"]["slo_page"]["count"] >= 1.0
 
@@ -240,13 +246,11 @@ def test_slo_chain_end_to_end_page_and_recover(tree, tmp_path, monkeypatch):
     burning SLO lands on disk — then recovery back to OK when the load
     stops. Windows are test-scale (seconds); the math is identical at
     the serving-scale defaults."""
-    from kdtree_tpu.obs import flight
     from kdtree_tpu.serve import lifecycle, server as srv
 
     monkeypatch.setenv("KDTREE_TPU_FLIGHT_DIR", str(tmp_path))
-    # the process recorder rate-limits per reason; an earlier unit test's
-    # PAGE dump within 5 s would otherwise swallow this one
-    flight.recorder()._last_dump.pop("slo-shed-rate", None)
+    # per-reason dump rate limiting is reset by the conftest autouse
+    # fixture — no manual pop needed, any collection order passes
     ring = hist.MetricHistory(capacity=256)
     spec = slo.SloSpec(
         name="shed-rate", objective="99% of requests admitted",
