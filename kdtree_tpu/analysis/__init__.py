@@ -23,7 +23,12 @@ Pieces:
   comments, per-file checker driving;
 - :mod:`~kdtree_tpu.analysis.baseline` — the committed
   grandfather file (CI fails only on findings NOT in it);
-- :mod:`~kdtree_tpu.analysis.reporting` — human and JSON output.
+- :mod:`~kdtree_tpu.analysis.reporting` — human and JSON output;
+- :mod:`~kdtree_tpu.analysis.lockwatch` — the RUNTIME half of the
+  KDT4xx concurrency rules: an opt-in (``KDTREE_TPU_LOCKWATCH=1``)
+  instrumented lock factory that records the acquisition-order graph,
+  fails fast on lock-order cycles, and dumps the graph as a JSON
+  artifact (docs/OBSERVABILITY.md "Concurrency sanitizer").
 """
 
 from __future__ import annotations

@@ -15,6 +15,7 @@ import ast
 from typing import Dict, Iterator, List, Optional, Set
 
 from kdtree_tpu.analysis.registry import (
+    CONCURRENCY,
     CORRECTNESS,
     HYGIENE,
     PERFORMANCE,
@@ -128,6 +129,49 @@ R_SUPPRESS = register(Rule(
     "a kdt-lint suppression must name a reason and known rule ids",
     "an unreasoned suppression is a finding with the evidence deleted; "
     "reviewers can't tell a justified sync from a silenced bug",
+))
+
+R_SIGNAL_LOCK = register(Rule(
+    "KDT401", "signal-unsafe-lock", CONCURRENCY,
+    "code reachable from a signal.signal handler must not acquire a "
+    "non-reentrant threading.Lock (use make_rlock / RLock for "
+    "handler-reachable state)",
+    "the SIGUSR2 flight-dump handler runs on the MAIN thread between any "
+    "two bytecodes — including inside record()'s critical section; a "
+    "plain Lock there deadlocked the whole serving process (PR 5), fixed "
+    "by an RLock",
+))
+
+R_IO_UNDER_LOCK = register(Rule(
+    "KDT402", "blocking-io-under-lock", CONCURRENCY,
+    "no blocking I/O (open / os.replace / json.dump / sockets / sleep) "
+    "inside a `with <lock>:` body or between .acquire()/.release() — "
+    "snapshot under the lock, write outside it",
+    "the breaker-open flight dump serialized file I/O inside the breaker "
+    "lock and stalled every concurrent allow() for its duration (PR 9); "
+    "the history companion of a grown registry took SECONDS to dump "
+    "inline on a serving thread (PR 10)",
+))
+
+R_FLAG_TOCTOU = register(Rule(
+    "KDT403", "bare-flag-shutdown-toctou", CONCURRENCY,
+    "a boolean attribute written by one method must not be polled in "
+    "another method's while-loop bare — gate on an Event, a Condition, "
+    "or the queue's closed-under-lock flag",
+    "the batch worker's exit gated on a separate stop flag set BEFORE "
+    "queue.close(): a request admitted in the gap waited out its full "
+    "timeout unserved (PR 4's TOCTOU, fixed by gating on queue.closed)",
+))
+
+R_THREAD_JOIN = register(Rule(
+    "KDT404", "nondaemon-thread-without-join", CONCURRENCY,
+    "a non-daemon threading.Thread must be joined somewhere in this "
+    "file (or marked daemon=True) — otherwise it silently outlives the "
+    "shutdown path",
+    "graceful drain is the serving contract (PR 4): every accepted "
+    "request is answered because stop() JOINS the batch worker and the "
+    "handler threads; a forgotten non-daemon thread wedges interpreter "
+    "exit (or, daemonized by accident, drops the work it carried)",
 ))
 
 
@@ -917,6 +961,508 @@ def check_dynamic_metric_name(ctx) -> Iterator[Finding]:
 # the leak signatures, a plain Name is the sanctioned bounded-enum idiom.
 _SLO_CTORS = {"SloSpec"}
 _HISTORY_SERIES_METHODS = {"mark"}
+
+
+# --------------------------------------------------------------------------
+# KDT4xx — concurrency discipline (shared lock-binding machinery)
+# --------------------------------------------------------------------------
+
+# constructors that bind a lock-like object, by leaf name. Reentrancy is
+# the KDT401 axis: an RLock is safe to re-enter from a signal handler, a
+# Lock is not — and a Condition's DEFAULT backing lock is an RLock (so
+# is make_condition's watched variant), so re-entering one cannot
+# deadlock either.
+_LOCK_CTORS = {
+    "Lock": False,
+    "make_lock": False,
+    "Condition": True,
+    "make_condition": True,
+    "RLock": True,
+    "make_rlock": True,
+}
+
+
+def _enclosing_class(node: ast.AST, parents) -> Optional[str]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = parents.get(cur)
+    return None
+
+
+def _lock_bindings(ctx) -> Dict[tuple, bool]:
+    """Lock-typed bindings in this file: ``("mod", name)`` for module
+    globals, ``("cls", Class, attr)`` for ``self.X`` assignments —
+    mapped to whether the lock is reentrant."""
+    out: Dict[tuple, bool] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        val = node.value
+        if not isinstance(val, ast.Call):
+            continue
+        leaf = call_name(val).split(".")[-1]
+        if leaf not in _LOCK_CTORS:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name):
+            out[("mod", tgt.id)] = _LOCK_CTORS[leaf]
+        elif (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            cls = _enclosing_class(node, ctx.parents)
+            if cls is not None:
+                out[("cls", cls, tgt.attr)] = _LOCK_CTORS[leaf]
+    return out
+
+
+def _resolve_lock(expr: ast.AST, enclosing_class: Optional[str],
+                  bindings: Dict[tuple, bool]) -> Optional[bool]:
+    """Reentrancy of the lock this expression names, or None when the
+    file gives no (unambiguous) answer — unknown stays quiet."""
+    if isinstance(expr, ast.Name):
+        return bindings.get(("mod", expr.id))
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        key = ("cls", enclosing_class, expr.attr)
+        if key in bindings:
+            return bindings[key]
+        # the attr in SOME class of this file: trust it only when every
+        # class that binds it agrees on reentrancy
+        kinds = {
+            v for k, v in bindings.items()
+            if k[0] == "cls" and k[2] == expr.attr
+        }
+        if len(kinds) == 1:
+            return kinds.pop()
+    return None
+
+
+def _is_lockish(expr: ast.AST, enclosing_class: Optional[str],
+                bindings: Dict[tuple, bool]) -> bool:
+    """KDT402's wider net: a known lock binding, or any name whose leaf
+    mentions 'lock' or 'cond' (module-level guards named by convention)."""
+    if _resolve_lock(expr, enclosing_class, bindings) is not None:
+        return True
+    leaf = dotted_name(expr).split(".")[-1].lower()
+    return "lock" in leaf or "cond" in leaf
+
+
+# --------------------------------------------------------------------------
+# KDT401 — signal-unsafe-lock
+# --------------------------------------------------------------------------
+
+
+def _handler_names(ctx) -> Set[str]:
+    """Function names registered as signal handlers in this file."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in ("signal.signal", "signal") or len(node.args) < 2:
+            continue
+        handler = node.args[1]
+        leaf = dotted_name(handler).split(".")[-1]
+        if leaf:
+            out.add(leaf)
+    return out
+
+
+def _called_leafs(func: ast.AST) -> Set[str]:
+    return {
+        call_name(n).split(".")[-1]
+        for n in ast.walk(func)
+        if isinstance(n, ast.Call) and call_name(n)
+    }
+
+
+@checker(R_SIGNAL_LOCK)
+def check_signal_unsafe_lock(ctx) -> Iterator[Finding]:
+    handlers = _handler_names(ctx)
+    if not handlers:
+        return
+    bindings = _lock_bindings(ctx)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in iter_funcs(ctx.tree):
+        by_name.setdefault(f.name, []).append(f)
+
+    # BFS over the per-file call graph (simple-name resolution: a
+    # syntactic walk can't type receivers, so any same-named def is
+    # considered reachable — predictable over-approximation, and the
+    # suppression mechanism handles the rare false positive)
+    reachable: List[ast.AST] = []
+    seen_names: Set[str] = set()
+    todo = list(handlers)
+    while todo:
+        name = todo.pop()
+        if name in seen_names:
+            continue
+        seen_names.add(name)
+        for func in by_name.get(name, []):
+            reachable.append(func)
+            todo.extend(_called_leafs(func) - seen_names)
+
+    flagged: Set[int] = set()
+    for func in reachable:
+        cls = _enclosing_class(func, ctx.parents)
+        for node in ast.walk(func):
+            expr = None
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    kind = _resolve_lock(item.context_expr, cls, bindings)
+                    if kind is False:
+                        expr = item.context_expr
+                        break
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                if _resolve_lock(node.func.value, cls, bindings) is False:
+                    expr = node.func.value
+            if expr is None or id(node) in flagged:
+                continue
+            flagged.add(id(node))
+            yield _mk(
+                R_SIGNAL_LOCK, ctx, node,
+                f"'{func_qualname(node, ctx.parents)}' is reachable from "
+                f"a signal handler ({', '.join(sorted(handlers))}) and "
+                f"acquires the non-reentrant lock "
+                f"'{dotted_name(expr)}'; a handler firing inside this "
+                "critical section deadlocks the main thread — make it "
+                "reentrant (make_rlock) or move the state off the "
+                "handler path",
+            )
+
+
+# --------------------------------------------------------------------------
+# KDT402 — blocking-io-under-lock
+# --------------------------------------------------------------------------
+
+# blocking calls by DOTTED name (module-qualified stdlib I/O)...
+_IO_DOTTED = {
+    "os.replace", "os.rename", "os.remove", "os.unlink", "os.fsync",
+    "os.makedirs", "shutil.rmtree", "shutil.copy", "shutil.copyfile",
+    "time.sleep", "json.dump", "pickle.dump",
+}
+# ...and by leaf name (builtins / ctors that hit the disk or network)
+_IO_LEAFS = {
+    "open", "urlopen", "create_connection", "HTTPConnection",
+    "HTTPSConnection",
+}
+
+
+def _is_io_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name in _IO_DOTTED:
+        return True
+    leaf = name.split(".")[-1]
+    return leaf in _IO_LEAFS and leaf == name  # bare builtin/imported name
+
+
+def _io_in_block(stmts: List[ast.stmt]) -> Iterator[ast.Call]:
+    """Candidate I/O calls anywhere under these statements. Callers
+    filter out calls sitting inside NESTED defs/lambdas (their bodies
+    run later, usually off the lock — the flight writer-thread pattern)
+    via :func:`_under_nested_def`."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and _is_io_call(sub):
+                yield sub
+
+
+def _under_nested_def(node: ast.AST, stop: ast.AST, parents) -> bool:
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+@checker(R_IO_UNDER_LOCK)
+def check_blocking_io_under_lock(ctx) -> Iterator[Finding]:
+    bindings = _lock_bindings(ctx)
+    flagged: Set[int] = set()
+
+    def emit(call: ast.Call, lockname: str) -> Iterator[Finding]:
+        if id(call) in flagged:
+            return
+        flagged.add(id(call))
+        yield _mk(
+            R_IO_UNDER_LOCK, ctx, call,
+            f"{call_name(call)}() blocks while '{lockname}' is held: "
+            "every thread contending on that lock stalls for the full "
+            "I/O duration — snapshot under the lock, write outside it "
+            "(the breaker reports and flight auto-dumps both moved out "
+            "for exactly this)",
+        )
+
+    # form 1: `with <lock>:` bodies
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        cls = _enclosing_class(node, ctx.parents)
+        locknames = [
+            dotted_name(item.context_expr)
+            for item in node.items
+            if _is_lockish(item.context_expr, cls, bindings)
+        ]
+        if not locknames:
+            continue
+        for call in _io_in_block(node.body):
+            if _under_nested_def(call, node, ctx.parents):
+                continue
+            yield from emit(call, locknames[0])
+
+    # form 2: .acquire() ... .release() spans — including the canonical
+    # `lock.acquire(); try: <I/O> finally: lock.release()` shape, so the
+    # walk recurses through compound statements carrying the held state
+    # in statement order (the finally's release must not retroactively
+    # clear the hold its own try body ran under)
+    def scan_span(body: List[ast.stmt],
+                  cls: Optional[str]) -> Iterator[Finding]:
+        held: List[Optional[str]] = [None]  # box: nonlocal-by-mutation
+
+        def upd_acquire(node: ast.AST) -> None:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "acquire"
+                    and _is_lockish(sub.func.value, cls, bindings)
+                ):
+                    held[0] = dotted_name(sub.func.value)
+
+        def upd_release(node: ast.AST) -> None:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "release"
+                    and held[0] is not None
+                    and dotted_name(sub.func.value) == held[0]
+                ):
+                    held[0] = None
+
+        def walk(stmts: List[ast.stmt]) -> Iterator[Finding]:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.Try):
+                    yield from walk(stmt.body)
+                    for handler in stmt.handlers:
+                        yield from walk(handler.body)
+                    yield from walk(stmt.orelse)
+                    yield from walk(stmt.finalbody)
+                    continue
+                if isinstance(stmt, (ast.If, ast.While, ast.For, ast.With)):
+                    for field in ("test", "iter", "items"):
+                        val = getattr(stmt, field, None)
+                        for header in (val if isinstance(val, list)
+                                       else [val] if val is not None else []):
+                            upd_acquire(header)
+                            if held[0] is not None:
+                                # `with open(...)` / I/O in an if-test is
+                                # still I/O under the held span
+                                for sub in ast.walk(header):
+                                    if isinstance(sub, ast.Call) \
+                                            and _is_io_call(sub):
+                                        yield from emit(sub, held[0])
+                    yield from walk(stmt.body)
+                    yield from walk(getattr(stmt, "orelse", []) or [])
+                    continue
+                # simple statement: an acquire takes effect before its
+                # own I/O is judged, a release only after
+                upd_acquire(stmt)
+                if held[0] is not None:
+                    for call in _io_in_block([stmt]):
+                        if not _under_nested_def(call, stmt, ctx.parents):
+                            yield from emit(call, held[0])
+                upd_release(stmt)
+
+        yield from walk(body)
+
+    for func in iter_funcs(ctx.tree):
+        yield from scan_span(
+            func.body, _enclosing_class(func, ctx.parents)
+        )
+
+
+# --------------------------------------------------------------------------
+# KDT403 — bare-flag-shutdown-toctou
+# --------------------------------------------------------------------------
+
+
+def _bare_self_attrs(test: ast.AST, parents) -> Iterator[ast.Attribute]:
+    """``self.X`` reads used as truth values in a while test — NOT the
+    receiver of a method call (``self._stop.is_set()`` is the sanctioned
+    Event idiom) and not an inner link of a longer attribute chain."""
+    for sub in ast.walk(test):
+        if not (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            continue
+        parent = parents.get(sub)
+        if isinstance(parent, ast.Attribute):
+            continue  # self.X.Y — X is a container, not the flag
+        if isinstance(parent, ast.Call) and parent.func is sub:
+            continue  # self.X() — a call, not a bare poll
+        yield sub
+
+
+@checker(R_FLAG_TOCTOU)
+def check_bare_flag_toctou(ctx) -> Iterator[Finding]:
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [
+            f for f in cls.body
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        bool_writes: Dict[str, Set[str]] = {}
+        non_bool: Set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    if isinstance(node.value, ast.Constant) and isinstance(
+                        node.value.value, bool
+                    ):
+                        bool_writes.setdefault(tgt.attr, set()).add(m.name)
+                    else:
+                        non_bool.add(tgt.attr)
+        if not bool_writes:
+            continue
+        for m in methods:
+            for node in ast.walk(m):
+                if not isinstance(node, ast.While):
+                    continue
+                # a poll that holds a lock across the read is gated
+                if any(
+                    isinstance(p, ast.With)
+                    for p in _ancestors(node, ctx.parents, stop=m)
+                ):
+                    continue
+                for attr in _bare_self_attrs(node.test, ctx.parents):
+                    name = attr.attr
+                    writers = bool_writes.get(name, set()) - {m.name}
+                    if not writers or name in non_bool:
+                        continue
+                    yield _mk(
+                        R_FLAG_TOCTOU, ctx, node,
+                        f"'{m.name}' polls bare flag 'self.{name}' "
+                        f"(written by {', '.join(sorted(writers))}) in "
+                        "its loop condition: the write and the poll are "
+                        "unordered, so a state change can slip between "
+                        "the check and the act (the PR 4 dropped-request "
+                        "TOCTOU) — gate on an Event / Condition / the "
+                        "queue's closed flag instead",
+                    )
+
+
+def _ancestors(node: ast.AST, parents, stop: ast.AST) -> Iterator[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        yield cur
+        cur = parents.get(cur)
+
+
+# --------------------------------------------------------------------------
+# KDT404 — nondaemon-thread-without-join
+# --------------------------------------------------------------------------
+
+
+def _thread_daemon_kwarg(call: ast.Call) -> Optional[bool]:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return None
+
+
+@checker(R_THREAD_JOIN)
+def check_nondaemon_thread_join(ctx) -> Iterator[Finding]:
+    # file-wide joins and daemon-attr assigns, by binding spelling
+    joins: Set[str] = set()
+    daemon_assigns: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            joins.add(dotted_name(node.func.value))
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon" \
+                        and isinstance(node.value, ast.Constant) \
+                        and node.value.value is True:
+                    daemon_assigns.add(dotted_name(tgt.value))
+
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) in ("threading.Thread", "Thread")):
+            continue
+        if _thread_daemon_kwarg(node) is True:
+            continue
+        parent = ctx.parents.get(node)
+        binding: Optional[str] = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            binding = dotted_name(parent.targets[0])
+        elif (
+            isinstance(parent, ast.Attribute)
+            and parent.attr == "start"
+            and isinstance(ctx.parents.get(parent), ast.Call)
+        ):
+            # threading.Thread(...).start(): unbound and unjoinable
+            yield _mk(
+                R_THREAD_JOIN, ctx, node,
+                "non-daemon Thread started without ever being bound: "
+                "nothing can join it, so it silently outlives the "
+                "shutdown path — bind it and join it in stop(), or mark "
+                "it daemon= with the reason it may be abandoned",
+            )
+            continue
+        if binding is None:
+            continue  # comprehension/argument forms: resolution is
+            # receiver-typed, stay quiet (predictable false negatives)
+        if binding in daemon_assigns or binding in joins:
+            continue
+        # a `self.X` binding joined through a local alias (`t = self.X;
+        # t.join()`) is covered when ANY name the attr flows to joins —
+        # approximate by bare-attr fallback before flagging
+        leaf_joined = any(j.split(".")[-1] == binding.split(".")[-1]
+                          for j in joins)
+        if leaf_joined:
+            continue
+        yield _mk(
+            R_THREAD_JOIN, ctx, node,
+            f"non-daemon Thread bound to '{binding}' is never joined in "
+            "this file: the shutdown path cannot drain it — join it in "
+            "stop()/close(), or mark it daemon= with the reason it may "
+            "be abandoned",
+        )
 
 
 @checker(R_SLO_NAME)

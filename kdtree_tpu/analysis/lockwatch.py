@@ -1,0 +1,623 @@
+"""Runtime lock-order sanitizer: the dynamic half of the KDT4xx rules.
+
+The static checkers (KDT401-KDT404, ``analysis/checkers.py``) catch the
+concurrency-discipline bug classes this repo actually shipped — the
+SIGUSR2 plain-Lock deadlock (PR 5), breaker file I/O stalling every
+``allow()`` (PR 9) — at the call sites a per-file AST walk can see. This
+module is the TSan-style backstop for everything it can't: an opt-in
+instrumented lock factory the serving stack constructs its locks
+through, recording per-thread acquisition stacks and the global
+acquisition-order graph at runtime, under the real tier-1 workload.
+
+Contract (mirrors the flight recorder's tiering):
+
+- **Off by default, zero overhead off.** With ``KDTREE_TPU_LOCKWATCH``
+  unset/0 the factories return plain ``threading.Lock``/``RLock``/
+  ``Condition`` objects — not wrappers, the stdlib types themselves —
+  so production hot paths pay nothing, not even an attribute hop.
+- **Cycles fail fast, always.** A lock-order inversion (thread A takes
+  X then Y, thread B takes Y then X) is a *structural* potential
+  deadlock: whether it fires depends only on scheduling luck. The
+  acquire that would close a cycle in the order graph raises
+  :class:`LockOrderError` immediately, naming the cycle — and so does
+  re-acquiring a non-reentrant lock the same thread already holds (the
+  PR 5 signal-handler deadlock, caught before it wedges). Deterministic
+  → raise.
+- **I/O-under-lock holds are recorded; strict mode raises.** A hold
+  that performed I/O (seen via ``sys.addaudithook`` — ``open``,
+  ``os.rename``/``replace``, sockets) and exceeded the configured
+  budget (``KDTREE_TPU_LOCKWATCH_HOLD_MS``, default 100) is the PR 9
+  breaker-dump class. It is *timing*-dependent, so by default it lands
+  in the artifact's ``violations`` list instead of failing a test run
+  on a slow CI disk; ``KDTREE_TPU_LOCKWATCH_STRICT=1`` upgrades it to a
+  :class:`LockHoldError` raised at the offending thread's next
+  blocking acquire — never from the release itself, which would
+  fire inside ``__exit__`` (masking the with-body's own exception)
+  or inside ``Condition.wait``'s release-save (corrupting the
+  waiter list).
+- **Artifact on exit.** The acquisition-order graph (nodes, edges with
+  first-acquisition stacks, cycles, hold violations) dumps as
+  ``lockwatch-graph-<pid>.json`` under ``KDTREE_TPU_LOCKWATCH_DIR``
+  (default cwd) at interpreter exit; CI uploads it and fails on any
+  recorded cycle. Schema: docs/OBSERVABILITY.md "Concurrency
+  sanitizer".
+
+Graph nodes are lock *names* (the factory argument — ``obs.flight.ring``,
+``route.breaker``), not instances: a registry with thousands of
+per-instrument locks stays one node per role, and the order contract is
+between roles anyway. Reentrant re-acquisition of the same instance adds
+no edge (that is what RLocks are for).
+
+Stdlib-only, like the rest of ``kdtree_tpu.analysis`` — and it must not
+import ``kdtree_tpu.obs`` (the obs modules construct their locks through
+here; an import back would cycle).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+LOCKWATCH_VERSION = 1
+ENV_ENABLE = "KDTREE_TPU_LOCKWATCH"
+ENV_DIR = "KDTREE_TPU_LOCKWATCH_DIR"
+ENV_HOLD_MS = "KDTREE_TPU_LOCKWATCH_HOLD_MS"
+ENV_STRICT = "KDTREE_TPU_LOCKWATCH_STRICT"
+DEFAULT_HOLD_BUDGET_MS = 100.0
+_STACK_LIMIT = 12  # frames kept per recorded edge/violation
+
+# audit events that mark the current thread's held locks as having done
+# I/O: file writes (open covers reads too — a read under a hot lock is
+# just as blocking), atomic-replace renames, and socket traffic. A
+# bounded prefix tuple, matched with str.startswith.
+_IO_AUDIT_PREFIXES = ("open", "os.rename", "os.remove", "os.unlink",
+                      "socket.", "urllib.")
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition would close a cycle in the global acquisition
+    -order graph (potential deadlock), or re-acquire a non-reentrant
+    lock its own thread already holds (certain deadlock)."""
+
+
+class LockHoldError(RuntimeError):
+    """Strict mode: a lock was held past the hold budget while the
+    holding thread performed I/O."""
+
+
+def enabled() -> bool:
+    """Whether the factories instrument (checked at lock CONSTRUCTION,
+    so a process decides once at startup; tests flip the env var before
+    building the object under test)."""
+    return os.environ.get(ENV_ENABLE, "").lower() in ("1", "true", "on")
+
+
+def hold_budget_s() -> float:
+    """The I/O-hold budget in seconds; <= 0 disables hold checking."""
+    raw = os.environ.get(ENV_HOLD_MS, "")
+    try:
+        ms = float(raw) if raw else DEFAULT_HOLD_BUDGET_MS
+    except ValueError:
+        ms = DEFAULT_HOLD_BUDGET_MS
+    return ms / 1e3
+
+
+def strict() -> bool:
+    return os.environ.get(ENV_STRICT, "").lower() in ("1", "true", "on")
+
+
+def artifact_dir() -> str:
+    return os.environ.get(ENV_DIR, "") or "."
+
+
+class _Held:
+    """One entry of a thread's held-lock stack."""
+
+    __slots__ = ("lock", "name", "t0", "did_io")
+
+    def __init__(self, lock: object, name: str) -> None:
+        self.lock = lock
+        self.name = name
+        self.t0 = time.monotonic()
+        self.did_io = False
+
+
+def _trim_stack() -> List[str]:
+    # drop the lockwatch-internal frames at the tail; keep the caller's
+    frames = traceback.extract_stack()[:-3]
+    return [f"{f.filename}:{f.lineno}:{f.name}"
+            for f in frames[-_STACK_LIMIT:]]
+
+
+class LockWatcher:
+    """The process-wide order graph + violation ledger.
+
+    Internals use an RLock: the SIGUSR2 handler may fire between any two
+    bytecodes of the main thread — including inside a watched lock's own
+    bookkeeping — and then acquire another watched lock (the flight
+    recorder's lesson, applied to the watcher itself). Held stacks are
+    per-thread (``threading.local``), touched lock-free.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._tls = threading.local()
+        # name -> acquisition count
+        self._locks: Dict[str, int] = {}
+        # (from, to) -> {"count": int, "stack": [...]}
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        # adjacency mirror of _edges for the cycle walk
+        self._adj: Dict[str, set] = {}
+        self._cycles: List[List[str]] = []
+        self._violations: List[dict] = []
+
+    # -- per-thread stack ---------------------------------------------------
+
+    def _stack(self) -> List[_Held]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def held_names(self) -> List[str]:
+        return [h.name for h in self._stack()]
+
+    # -- graph --------------------------------------------------------------
+
+    def _path_exists(self, src: str, dst: str) -> bool:
+        """DFS over the name graph (holding the watcher lock)."""
+        seen = set()
+        todo = [src]
+        while todo:
+            cur = todo.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            todo.extend(self._adj.get(cur, ()))
+        return False
+
+    def _cycle_chain(self, frm: str, to: str) -> List[str]:
+        """A concrete ``to -> ... -> frm`` witness path through the
+        existing edges (holding the watcher lock); with the new
+        ``frm -> to`` edge appended by the caller it closes the cycle."""
+        parent: Dict[str, str] = {}
+        todo = [to]
+        seen = {to}
+        while todo:
+            cur = todo.pop()
+            if cur == frm:
+                chain = [cur]
+                while chain[-1] != to:
+                    chain.append(parent[chain[-1]])
+                return list(reversed(chain))
+            for nxt in self._adj.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    parent[nxt] = cur
+                    todo.append(nxt)
+        return [to, frm]
+
+    def note_acquire_intent(self, lock: object, name: str,
+                            reentrant: bool) -> None:
+        """Bookkeeping BEFORE blocking on the real lock: self-deadlock
+        and order-cycle checks fail fast here, while the thread can
+        still raise instead of wedging."""
+        stack = self._stack()
+        for h in stack:
+            if h.lock is lock:
+                if reentrant:
+                    # a nested re-acquire of an owned RLock cannot block
+                    # and orders against NOTHING: minting edges from the
+                    # intervening held locks back to this one would read
+                    # a legal `with R: with A: with R:` as an inversion
+                    return
+                with self._lock:
+                    self._cycles.append([name, name])
+                self.dump()
+                raise LockOrderError(
+                    f"non-reentrant lock {name!r} re-acquired by the "
+                    "thread that already holds it — certain deadlock "
+                    "(the PR 5 signal-handler class; use make_rlock "
+                    "for handler-reachable state)"
+                )
+        held = [h.name for h in stack]
+        if not held:
+            return
+        cycle: Optional[List[str]] = None
+        with self._lock:
+            for frm in held:
+                if frm == name:
+                    continue  # same ROLE nested (distinct instances): legal
+                key = (frm, name)
+                edge = self._edges.get(key)
+                if edge is not None:
+                    edge["count"] += 1
+                    continue
+                # new edge: the only moment a cycle can appear
+                if self._path_exists(name, frm):
+                    chain = self._cycle_chain(frm, name)
+                    cycle = chain + [chain[0]]
+                    self._cycles.append(cycle)
+                self._edges[key] = {"count": 1, "stack": _trim_stack()}
+                self._adj.setdefault(frm, set()).add(name)
+        if cycle is not None:
+            self.dump()
+            raise LockOrderError(
+                "lock-order inversion (potential deadlock): "
+                + " -> ".join(cycle)
+                + f"; this thread holds {held} and is acquiring {name!r}"
+            )
+
+    def note_acquired(self, lock: object, name: str,
+                      reentrant: bool) -> None:
+        stack = self._stack()
+        if reentrant:
+            for h in stack:
+                if h.lock is lock:
+                    return  # nested re-acquire: one entry per instance
+        with self._lock:
+            self._locks[name] = self._locks.get(name, 0) + 1
+        stack.append(_Held(lock, name))
+
+    def note_release(self, lock: object, name: str,
+                     still_held: bool) -> None:
+        """Pop the entry (unless a reentrant lock is still held) and
+        evaluate the hold budget. In strict mode the
+        :class:`LockHoldError` is DEFERRED to the thread's next
+        blocking acquire: raising here would fire from ``__exit__``
+        (masking whatever in-flight exception the with-body raised) and
+        from ``Condition._release_save`` (leaving a ghost waiter that
+        swallows a future notify)."""
+        if still_held:
+            return
+        stack = self._stack()
+        entry = None
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is lock:
+                entry = stack.pop(i)
+                break
+        if entry is None:
+            return
+        budget = hold_budget_s()
+        if budget <= 0 or not entry.did_io:
+            return
+        held_s = time.monotonic() - entry.t0
+        if held_s <= budget:
+            return
+        violation = {
+            "lock": name,
+            "held_ms": round(held_s * 1e3, 3),
+            "budget_ms": round(budget * 1e3, 3),
+            "io": True,
+            "thread": threading.current_thread().name,
+            "stack": _trim_stack(),
+        }
+        with self._lock:
+            self._violations.append(violation)
+        if strict():
+            self._tls.pending_hold_error = LockHoldError(
+                f"lock {name!r} held {held_s * 1e3:.1f} ms (> budget "
+                f"{budget * 1e3:g} ms) while performing I/O — the PR 9 "
+                "breaker-dump class; move the I/O outside the lock"
+            )
+
+    def raise_pending(self) -> None:
+        """Raise (and consume) this thread's deferred strict-mode hold
+        error. Called ONLY from a user-initiated blocking acquire —
+        never from ``Condition._acquire_restore``'s internal re-acquire,
+        where raising would leave the condition lock un-reacquired (the
+        enclosing ``with`` then releases an un-owned lock, the count
+        corrupts, and a ghost waiter swallows the next notify)."""
+        pending = getattr(self._tls, "pending_hold_error", None)
+        if pending is not None:
+            self._tls.pending_hold_error = None
+            raise pending
+
+    def note_io(self) -> None:
+        """Audit-hook entry: the current thread performed I/O; taint
+        every lock it holds."""
+        for h in self._stack():
+            h.did_io = True
+
+    # -- reporting ----------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "lockwatch_version": LOCKWATCH_VERSION,
+                "generated_unix": time.time(),
+                "pid": os.getpid(),
+                "hold_budget_ms": hold_budget_s() * 1e3,
+                "strict": strict(),
+                "locks": dict(self._locks),
+                "edges": [
+                    {"from": frm, "to": to,
+                     "count": e["count"], "stack": e["stack"]}
+                    for (frm, to), e in sorted(self._edges.items())
+                ],
+                "cycles": [list(c) for c in self._cycles],
+                "violations": [dict(v) for v in self._violations],
+            }
+
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomic artifact write (tmp + ``os.replace``, the flight
+        recorder's contract). Never raises — the sanitizer must not
+        fail the run it watches with a disk error."""
+        try:
+            if path is None:
+                path = os.path.join(
+                    artifact_dir(), f"lockwatch-graph-{os.getpid()}.json"
+                )
+            rep = self.report()
+            tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                json.dump(rep, f, indent=2, sort_keys=True, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+    def cycles(self) -> List[List[str]]:
+        with self._lock:
+            return [list(c) for c in self._cycles]
+
+    def violations(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._violations]
+
+    def reset(self) -> None:
+        """Tests only: forget every edge/cycle/violation (held stacks
+        are per-thread and drain naturally; the CALLING thread's
+        pending strict-mode error is cleared too, so one test's
+        unconsumed violation cannot detonate in the next)."""
+        self._tls.pending_hold_error = None
+        with self._lock:
+            self._locks.clear()
+            self._edges.clear()
+            self._adj.clear()
+            self._cycles.clear()
+            self._violations.clear()
+
+    def export_state(self) -> dict:
+        """Tests only: a deep-enough copy of the graph/ledger for a
+        fixture to stash before reset() and merge_state() back after —
+        the watcher is process-wide, and an env-enabled tier-1 run's
+        accumulated evidence must survive the lockwatch tests' own
+        isolation (the atexit artifact is the CI gate's input)."""
+        with self._lock:
+            return {
+                "locks": dict(self._locks),
+                "edges": {k: dict(v) for k, v in self._edges.items()},
+                "adj": {k: set(v) for k, v in self._adj.items()},
+                "cycles": [list(c) for c in self._cycles],
+                "violations": [dict(v) for v in self._violations],
+            }
+
+    def merge_state(self, state: dict) -> None:
+        """Tests only: re-add an export_state() snapshot (counts sum,
+        edges/cycles/violations union)."""
+        with self._lock:
+            for name, n in state["locks"].items():
+                self._locks[name] = self._locks.get(name, 0) + n
+            for key, edge in state["edges"].items():
+                cur = self._edges.get(key)
+                if cur is None:
+                    self._edges[key] = dict(edge)
+                else:
+                    cur["count"] += edge["count"]
+            for frm, tos in state["adj"].items():
+                self._adj.setdefault(frm, set()).update(tos)
+            self._cycles.extend(state["cycles"])
+            self._violations.extend(state["violations"])
+
+
+class WatchedLock:
+    """A ``threading.Lock`` with order/hold bookkeeping. Duck-compatible
+    where the serving stack needs it: context manager, ``acquire``/
+    ``release``/``locked``, and usable as a ``threading.Condition``
+    backing lock."""
+
+    _reentrant = False
+
+    def __init__(self, name: str, watcher: "LockWatcher") -> None:
+        self.name = name
+        self._watcher = watcher
+        self._inner = self._make_inner()
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # the safe point for a deferred strict-mode hold error: a
+            # user-initiated acquire, never Condition's internal restore
+            self._watcher.raise_pending()
+        return self._acquire_quiet(blocking, timeout)
+
+    def _acquire_quiet(self, blocking: bool = True,
+                       timeout: float = -1) -> bool:
+        w = self._watcher
+        if blocking:
+            # only a BLOCKING acquire can deadlock; try-acquires are a
+            # legitimate ordering-free pattern (capture_active's probe)
+            w.note_acquire_intent(self, self.name, self._reentrant)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            w.note_acquired(self, self.name, self._reentrant)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._watcher.note_release(self, self.name, self._still_held())
+
+    def _still_held(self) -> bool:
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # artifacts/debug name the role
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class WatchedRLock(WatchedLock):
+    """Reentrant variant: nested re-acquires by the owning thread add no
+    edges and keep one held entry (released when the outermost release
+    drops the count to zero)."""
+
+    _reentrant = True
+
+    def __init__(self, name: str, watcher: "LockWatcher") -> None:
+        super().__init__(name, watcher)
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    def _acquire_quiet(self, blocking: bool = True,
+                       timeout: float = -1) -> bool:
+        ok = super()._acquire_quiet(blocking, timeout)
+        if ok:
+            self._owner = threading.get_ident()
+            self._count += 1
+        return ok
+
+    def release(self) -> None:
+        self._count -= 1
+        # read still-held BEFORE releasing the real lock: after release a
+        # contending thread can immediately re-acquire and bump _count,
+        # which would leave THIS thread's held entry stranded (and every
+        # later acquisition minting false edges off it)
+        still = self._count > 0
+        if not still:
+            self._owner = None
+        self._inner.release()
+        self._watcher.note_release(self, self.name, still)
+
+    def _still_held(self) -> bool:
+        return self._count > 0
+
+    # Condition integration: threading.Condition consults these when the
+    # backing lock provides them, and without them a wait() while the
+    # RLock is held RECURSIVELY would release one level and deadlock —
+    # the stdlib RLock ships the same three hooks for the same reason.
+
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _release_save(self) -> int:
+        n = self._count
+        for _ in range(n):
+            self.release()
+        return n
+
+    def _acquire_restore(self, n: int) -> None:
+        # the quiet path: a pending strict-mode error raising HERE would
+        # leave the condition lock un-reacquired behind wait()'s back
+        for _ in range(n):
+            self._acquire_quiet()
+
+
+_watcher: Optional[LockWatcher] = None
+_watcher_guard = threading.Lock()
+_hook_installed = False
+_atexit_registered = False
+
+
+def watcher() -> LockWatcher:
+    """The process watcher (created on first instrumented construction;
+    audit hook + atexit artifact registered alongside — an audit hook
+    cannot be removed, so it gates on this module's state)."""
+    global _watcher, _hook_installed, _atexit_registered
+    w = _watcher
+    if w is not None:
+        return w
+    with _watcher_guard:
+        if _watcher is None:
+            _watcher = LockWatcher()
+            if not _hook_installed:
+                _hook_installed = True
+                _install_audit_hook()
+            if not _atexit_registered:
+                _atexit_registered = True
+                atexit.register(_atexit_dump)
+        return _watcher
+
+
+def _install_audit_hook() -> None:
+    import sys
+
+    def _hook(event: str, args) -> None:
+        try:
+            w = _watcher
+            if w is not None and event.startswith(_IO_AUDIT_PREFIXES):
+                w.note_io()
+        except Exception:
+            pass  # an audit hook exception aborts the audited call
+
+    try:
+        sys.addaudithook(_hook)
+    except Exception:
+        pass
+
+
+def _atexit_dump() -> None:
+    w = _watcher
+    if w is not None:
+        w.dump()
+
+
+# -- the factories (what lock-constructing modules call) --------------------
+
+
+def make_lock(name: str):
+    """A non-reentrant mutex named ``name`` (dotted role, e.g.
+    ``"route.breaker"``). Plain ``threading.Lock()`` unless
+    ``KDTREE_TPU_LOCKWATCH=1``."""
+    if not enabled():
+        return threading.Lock()
+    return WatchedLock(name, watcher())
+
+
+def make_rlock(name: str):
+    """Reentrant variant — for state a signal handler may re-enter
+    (KDT401's fix)."""
+    if not enabled():
+        return threading.RLock()
+    return WatchedRLock(name, watcher())
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` whose backing mutex is watched. The
+    stdlib Condition defaults to an RLOCK, so the watched variant backs
+    onto :class:`WatchedRLock` — identical reentrancy semantics on and
+    off (the sanitizer observes, it must never change what deadlocks).
+    Condition drives the wrapper through ``acquire``/``release`` (and
+    the ``_release_save`` family), so waits keep the bookkeeping exact."""
+    if not enabled():
+        return threading.Condition()
+    return threading.Condition(make_rlock(name))
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write the artifact now (the atexit hook does this automatically);
+    None when lockwatch never instrumented anything."""
+    w = _watcher
+    if w is None:
+        return None
+    return w.dump(path)

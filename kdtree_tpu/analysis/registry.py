@@ -2,7 +2,8 @@
 
 Rule ids are stable API — suppression comments and baselines reference
 them — so they are never renumbered or reused. Bands by category:
-``KDT1xx`` correctness, ``KDT2xx`` performance, ``KDT3xx`` hygiene.
+``KDT1xx`` correctness, ``KDT2xx`` performance, ``KDT3xx`` hygiene,
+``KDT4xx`` concurrency.
 
 A checker is a function ``(ctx: FileContext) -> Iterable[Finding]``
 registered against one rule with :func:`checker`; the walker runs every
@@ -19,6 +20,7 @@ from typing import Callable, Dict, List, Optional
 CORRECTNESS = "correctness"
 PERFORMANCE = "performance"
 HYGIENE = "hygiene"
+CONCURRENCY = "concurrency"
 
 
 @dataclass(frozen=True)
@@ -31,7 +33,7 @@ class Rule:
 
     id: str
     name: str  # kebab-case slug, shown next to the id
-    category: str  # correctness | performance | hygiene
+    category: str  # correctness | performance | hygiene | concurrency
     summary: str
     origin: str
 

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.mutable.delta import MIN_CAPACITY, DeltaBuffer
 from kdtree_tpu.mutable.merge import in_sorted, merge_rows
 from kdtree_tpu.obs import flight
@@ -115,6 +116,21 @@ class _EpochState:
         return self.delta.rows + len(self.dead) + self.delta.holes
 
 
+def _pad_cols(
+    d2: np.ndarray, ids: np.ndarray, k: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Widen a (d2, ids) answer to ``k`` columns with the engines'
+    padding convention (+inf distance, -1 id). A no-op at full width —
+    the common case pays one shape compare."""
+    w = d2.shape[1]
+    if w >= k:
+        return d2[:, :k], ids[:, :k]
+    pad_d = np.full((d2.shape[0], k - w), np.inf, dtype=d2.dtype)
+    pad_i = np.full((ids.shape[0], k - w), -1, dtype=ids.dtype)
+    return (np.concatenate([d2, pad_d], axis=1),
+            np.concatenate([ids, pad_i], axis=1))
+
+
 class _Snapshot:
     """One query's consistent view of the epoch (plain references)."""
 
@@ -149,7 +165,7 @@ class MutableEngine:
         max_delta_frac: float = DEFAULT_MAX_DELTA_FRAC,
         requested_k: Optional[int] = None,
     ) -> None:
-        self._lock = threading.RLock()
+        self._lock = lockwatch.make_rlock("mutable.engine")
         # the CONFIGURED k, not inner.k: the bootstrap ServeEngine clamps
         # k to its n_real, and pinning that clamp as the forever-k would
         # cap every future epoch at the seed index's size (a 5-point
@@ -190,7 +206,27 @@ class MutableEngine:
 
     @property
     def k(self) -> int:
-        return self._state.inner.k
+        """The CONFIGURED k — stable across deletes and epoch swaps.
+
+        The bootstrap/epoch inner engines clamp their dispatch width to
+        their own ``n_real``; delegating that clamp here made ``k_max``
+        (the /v1/knn request cap) shrink whenever deletes pushed ``n``
+        below ``--k`` until a compaction (the PR 10 carried-forward
+        gotcha). The request contract now follows the configuration:
+        answers for k beyond the live point count pad with (+inf, -1),
+        exactly what a fresh undersized index answers."""
+        return self._k_cfg
+
+    @property
+    def k_effective(self) -> int:
+        """How many real (non-padding) neighbors a query can currently
+        get: min(configured k, live point count). Reported next to the
+        configured k in /healthz so an operator can tell a small index
+        from a shrunken contract."""
+        with self._lock:
+            st = self._state
+            live = st.n_main - len(st.dead) + st.delta.rows
+        return max(0, min(self._k_cfg, live))
 
     @property
     def epoch(self) -> int:
@@ -216,6 +252,10 @@ class MutableEngine:
         # event's epoch field exists to place each batch relative to a
         # swap, so it must name the answering generation exactly).
         self.last_answer_epoch = snap.epoch
+        # an epoch smaller than the configured k dispatches at its own
+        # clamped width; pad back up so the serving contract (k columns)
+        # holds regardless of the current epoch's size
+        d2, ids = _pad_cols(d2, ids, self._k_cfg)
         if snap.empty:
             return d2, ids, source
         return self._overlay(queries, d2, ids, snap) + (source,)
@@ -226,16 +266,18 @@ class MutableEngine:
         """The degradation path, mutable-aware: masked flat storage plus
         delta, merged — exact over the surviving points, like everything
         else."""
+        k = min(int(k), self._k_cfg)
         snap = self._snapshot()
         if snap.empty:
-            return snap.inner.fallback_knn(queries, k)
-        k = min(int(k), snap.inner.k)
+            d2, ids = snap.inner.fallback_knn(queries, k)
+            return _pad_cols(d2, ids, k)
         d2, ids = self._masked_main_knn(queries, snap, k)
         if snap.delta_rows:
             dd2, dids = self._delta_knn(queries, snap, k)
             d2 = np.concatenate([d2, dd2], axis=1)
             ids = np.concatenate([ids, dids], axis=1)
-        return merge_rows(d2, ids, k)
+        d2, ids = merge_rows(d2, ids, k)
+        return _pad_cols(d2, ids, k)
 
     # -- query overlay -------------------------------------------------------
 
@@ -276,6 +318,8 @@ class MutableEngine:
                 fd2 = np.concatenate([fd2, dd2[contaminated]], axis=1)
                 fids = np.concatenate([fids, dids[contaminated]], axis=1)
             cd2, cids = merge_rows(fd2, fids, kk)
+            # fewer surviving candidates than kk pad back to full width
+            cd2, cids = _pad_cols(cd2, cids, kk)
             d2[contaminated] = cd2
             ids[contaminated] = cids
         return d2, ids
@@ -603,6 +647,12 @@ class MutableEngine:
                 "backlog": st.backlog(),
                 "rebuilding": self._rebuilding,
                 "threshold": self.rebuild_threshold(st),
+                # configured vs effective k (docs/SERVING.md): the
+                # request cap never shrinks; the effective value says
+                # how many real neighbors exist to return right now
+                # (the property re-enters the RLock — one accounting)
+                "k_configured": self._k_cfg,
+                "k_effective": self.k_effective,
             }
 
     def close(self, timeout_s: float = 120.0) -> None:

@@ -47,9 +47,9 @@ conventions.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Optional
 
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -78,7 +78,7 @@ def set_enabled(value: Optional[bool]) -> None:
 
 
 _deferred: list = []
-_deferred_lock = threading.Lock()
+_deferred_lock = lockwatch.make_lock("obs.defer")
 _DEFER_CAP = 256
 
 

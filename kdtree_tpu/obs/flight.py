@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from kdtree_tpu.analysis import lockwatch
+
 DEFAULT_CAPACITY = 1024
 # one dump file per reason, overwritten (atomic replace): a sustained
 # incident refreshes its timeline instead of carpeting the disk
@@ -69,8 +71,9 @@ class FlightRecorder:
         # section. A plain Lock would deadlock the process right there;
         # with an RLock the handler's snapshot may at worst miss the one
         # event mid-append (reported via `dropped`), which is fine for
-        # an incident dump.
-        self._lock = threading.RLock()
+        # an incident dump. Constructed through the lockwatch factory so
+        # KDTREE_TPU_LOCKWATCH=1 runs re-prove exactly that property.
+        self._lock = lockwatch.make_rlock("obs.flight.ring")
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0  # monotone event id; dropped = seq - len(ring)
         self._last_dump: Dict[str, float] = {}  # reason -> monotonic time
@@ -193,7 +196,7 @@ class BurstDetector:
     def __init__(self, threshold: int = 10, window_s: float = 1.0) -> None:
         self.threshold = max(int(threshold), 1)
         self.window_s = float(window_s)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("obs.flight.burst")
         self._marks: collections.deque = collections.deque(
             maxlen=self.threshold
         )
@@ -297,6 +300,7 @@ def auto_dump(reason: str, force: bool = False) -> Optional[str]:
         if not _recorder.claim_dump(reason):
             return None
         path = os.path.join(d, f"flight-{_safe_reason(reason)}.json")
+        # kdt-lint: disable=KDT404 DELIBERATELY non-daemon and unjoined: a claimed incident dump must survive interpreter exit (daemon would drop it), and the thread is short-lived + self-terminating — see the docstring
         threading.Thread(target=_write_dump, args=(path, reason),
                          name="kdtree-flight-dump").start()
         return path

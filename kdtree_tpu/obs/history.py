@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kdtree_tpu.analysis import lockwatch
+
 HISTORY_VERSION = 1
 DEFAULT_CAPACITY = 512
 DEFAULT_PERIOD_S = 1.0
@@ -103,7 +105,7 @@ class MetricHistory:
         # the main thread between any two bytecodes — including inside
         # record()'s critical section. A plain Lock would deadlock the
         # process right there.
-        self._lock = threading.RLock()
+        self._lock = lockwatch.make_rlock("obs.history.ring")
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
         self._marks: Dict[str, Dict[str, float]] = {}

@@ -28,15 +28,15 @@ events from an earlier install).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs.registry import MetricsRegistry, get_registry
 
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
-_install_lock = threading.Lock()
+_install_lock = lockwatch.make_lock("obs.jaxrt.install")
 _installed = False
 _registry_override: Optional[MetricsRegistry] = None
 

@@ -25,10 +25,10 @@ from __future__ import annotations
 import contextlib
 import glob
 import os
-import threading
 import time
 from typing import Iterator, Optional
 
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs.registry import get_registry
 
 
@@ -36,7 +36,7 @@ class CaptureBusyError(RuntimeError):
     """A capture window is already open in this process."""
 
 
-_capture_lock = threading.Lock()
+_capture_lock = lockwatch.make_lock("obs.profile.capture")
 
 
 def capture_active() -> bool:
@@ -100,6 +100,7 @@ def capture(log_dir: str) -> Iterator[CaptureResult]:
     result = CaptureResult(log_dir)
     reg = get_registry()
     try:
+        # kdt-lint: disable=KDT402 the capture lock IS held across the whole capture window by design (one capture at a time, process-wide); this once-per-capture mkdir is noise against that multi-second hold, and contenders get a crisp 409 via the non-blocking acquire above, never a stall
         os.makedirs(log_dir, exist_ok=True)
         jax.profiler.start_trace(log_dir)
         flight.record("profile.capture_start", log_dir=log_dir)

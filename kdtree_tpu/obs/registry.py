@@ -22,8 +22,9 @@ Design constraints, in order:
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from kdtree_tpu.analysis import lockwatch
 
 LabelItems = Tuple[Tuple[str, str], ...]
 
@@ -56,7 +57,7 @@ class Counter:
     __slots__ = ("_lock", "_value")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("obs.counter")
         self._value = 0.0
 
     def inc(self, amount: float = 1.0) -> None:
@@ -77,7 +78,7 @@ class Gauge:
     __slots__ = ("_lock", "_value")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("obs.gauge")
         self._value = 0.0
 
     def set(self, value: float) -> None:
@@ -107,7 +108,7 @@ class Histogram:
         if not buckets:
             raise ValueError("histogram needs at least one bucket bound")
         self.uppers: Tuple[float, ...] = tuple(sorted(float(b) for b in buckets))
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("obs.histogram")
         self._counts = [0] * (len(self.uppers) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
@@ -158,7 +159,7 @@ class MetricsRegistry:
     """Named, labeled instruments with kind-consistency enforcement."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("obs.registry")
         self._kinds: Dict[str, str] = {}
         self._metrics: Dict[str, Dict[LabelItems, object]] = {}
 

@@ -39,10 +39,10 @@ metric-label identity: they must be static strings from a bounded set
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import history as hist_mod
 from kdtree_tpu.obs.registry import get_registry
 
@@ -268,7 +268,7 @@ class SloEngine:
             history if history is not None else hist_mod.get_history()
         )
         self._reg = registry or get_registry()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("obs.slo.engine")
         self._states: Dict[str, int] = {}
         self._last: Dict[str, dict] = {}
 
@@ -389,7 +389,7 @@ class SloEngine:
 
 
 _engine: Optional[SloEngine] = None
-_engine_lock = threading.Lock()
+_engine_lock = lockwatch.make_lock("obs.slo.default")
 
 
 def get_engine() -> SloEngine:

@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import flight
 
 # a shed or two is normal backpressure; this many sheds inside one second
@@ -116,7 +117,7 @@ class AdmissionQueue:
         self.max_rows = int(max_rows)
         self._items: deque = deque()
         self._rows = 0
-        self._cond = threading.Condition()
+        self._cond = lockwatch.make_condition("serve.admission")
         self._closed = False
         # recent worker pops as (monotonic time, rows): the measured
         # drain rate behind the 429 Retry-After header

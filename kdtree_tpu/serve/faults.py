@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import flight
 
 # a hang must still be bounded: an injected fault that outlives its test
@@ -179,7 +180,7 @@ class FaultSet:
     """
 
     def __init__(self, spec: str = "") -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("serve.faults")
         self._faults: List[Fault] = parse_spec(spec)
         # replaced (never just .set()) on clear: a NEW spec arms with a
         # fresh un-set event while threads parked on the OLD one release

@@ -70,6 +70,7 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from kdtree_tpu import obs
+from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import flight
 from kdtree_tpu.serve.server import (
     GracefulHTTPServer,
@@ -138,7 +139,7 @@ class CircuitBreaker:
         self.failures = int(failures)
         self.reset_s = float(reset_s)
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("route.breaker")
         self._state = CLOSED
         self._consecutive = 0
         self._opened_at = 0.0
@@ -227,7 +228,7 @@ class ShardState:
         self.port = parsed.port or 80
         self.breaker = breaker
         self.hedge_min_s = float(hedge_min_s)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.make_lock("route.shard")
         self._lat: List[float] = []
         self.healthy = True          # optimistic until the first probe
         self.health_detail: dict = {}
@@ -631,7 +632,7 @@ class Router(GracefulHTTPServer):
                              outcome="timeout")
         result: dict = {}
         conns: dict = {}
-        cond = threading.Condition()
+        cond = lockwatch.make_condition("route.hedge")
         reg = obs.get_registry()
 
         def run(tag: str) -> None:

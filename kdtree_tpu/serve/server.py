@@ -284,6 +284,11 @@ class KnnRequestHandler(JsonRequestHandler):
                     mut = state.engine.stats()
                     body["mutable"] = mut
                     body["epoch"] = mut["epoch"]
+                    if "k_effective" in mut:
+                        # k_max is the CONFIGURED request cap (stable
+                        # across deletes and epoch swaps); k_effective
+                        # says how many real neighbors currently exist
+                        body["k_effective"] = mut["k_effective"]
                 if state.slo_engine is not None:
                     # SLO verdict rides along without gating readiness:
                     # a burning p99 wants traffic drained elsewhere, not

@@ -1143,13 +1143,25 @@ def cmd_lint(args) -> None:
     from kdtree_tpu.analysis import baseline as bl
     from kdtree_tpu.analysis import reporting, run_lint
 
-    paths = args.paths or ["kdtree_tpu"]
+    # --root makes the run cwd-independent (the PR 3 NOTE papercut:
+    # lint only worked from the repo root): default paths, the relative
+    # baseline, and finding relpaths all resolve against it
+    root = os.path.abspath(args.root) if args.root else os.getcwd()
+    if args.root and not os.path.isdir(root):
+        print(f"cannot lint: --root {args.root} is not a directory",
+              file=sys.stderr)
+        sys.exit(2)
+    paths = args.paths or [os.path.join(root, "kdtree_tpu")]
+    paths = [p if os.path.isabs(p) else os.path.join(root, p)
+             for p in paths]
+    baseline_path = (args.baseline if os.path.isabs(args.baseline)
+                     else os.path.join(root, args.baseline))
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         print(f"cannot lint: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         sys.exit(2)
-    result = run_lint(paths)
+    result = run_lint(paths, root=root)
     if result.errors and not result.findings:
         # un-parseable inputs with nothing else to report: that is a
         # usage-shaped failure, not a lint verdict
@@ -1157,14 +1169,15 @@ def cmd_lint(args) -> None:
             print(f"error: {err}", file=sys.stderr)
         sys.exit(2)
     if args.update_baseline:
-        count = bl.save(args.baseline, result.findings)
+        count = bl.save(baseline_path, result.findings)
         print(f"wrote {len(result.findings)} finding(s) "
-              f"({count} fingerprint(s)) to {args.baseline}")
+              f"({count} fingerprint(s)) to {baseline_path}")
         return
     try:
-        base = bl.load(args.baseline)
+        base = bl.load(baseline_path)
     except (OSError, ValueError) as e:
-        print(f"cannot read baseline {args.baseline}: {e}", file=sys.stderr)
+        print(f"cannot read baseline {baseline_path}: {e}",
+              file=sys.stderr)
         sys.exit(2)
     new = bl.partition(result.findings, base)
     render = (reporting.render_json if args.format == "json"
@@ -1601,7 +1614,12 @@ def main(argv=None) -> None:
              "in the baseline",
     )
     li.add_argument("paths", nargs="*", metavar="PATH",
-                    help="files/directories to lint (default: kdtree_tpu)")
+                    help="files/directories to lint (default: kdtree_tpu "
+                         "under --root)")
+    li.add_argument("--root", default=None, metavar="DIR",
+                    help="repo root: default paths, the relative "
+                         "--baseline, and finding paths resolve against "
+                         "it (default: cwd) — lint works from anywhere")
     li.add_argument("--format", choices=["human", "json"], default="human",
                     help="json is the machine report CI uploads")
     li.add_argument("--baseline", default="lint_baseline.json",

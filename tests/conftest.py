@@ -32,6 +32,15 @@ if "KDTREE_TPU_FLIGHT_DIR" not in os.environ:
         prefix="kdtree-tpu-flight-"
     )
 
+# And the lock-order sanitizer's graph artifacts (docs/OBSERVABILITY.md
+# "Concurrency sanitizer"): when CI runs tier-1 under
+# KDTREE_TPU_LOCKWATCH=1 it sets the dir explicitly so it can assert
+# zero cycles afterwards; a dev run without one must not litter cwd.
+if "KDTREE_TPU_LOCKWATCH_DIR" not in os.environ:
+    os.environ["KDTREE_TPU_LOCKWATCH_DIR"] = tempfile.mkdtemp(
+        prefix="kdtree-tpu-lockwatch-"
+    )
+
 import pytest
 
 # Lane split (VERDICT r4 weak #7): the full suite needs xdist on a small

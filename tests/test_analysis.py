@@ -674,3 +674,340 @@ def test_kdt107_suppressible_with_reason(tmp_path):
     ), relpath="serve/mod.py")
     assert rules_of(res) == []
     assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# KDT401 signal-unsafe-lock
+# ---------------------------------------------------------------------------
+
+# the PR 5 deadlock, as source text: a SIGUSR2 dump handler reaching a
+# ring guarded by a NON-reentrant lock
+_SIGNAL_RING = (
+    "import signal\n"
+    "import threading\n"
+    "class Ring:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.{ctor}()\n"
+    "    def record(self):\n"
+    "        with self._lock:\n"
+    "            pass\n"
+    "    def dump(self):\n"
+    "        with self._lock:\n"
+    "            return 1\n"
+    "ring = Ring()\n"
+    "def _on_sigusr2(signum, frame):\n"
+    "    ring.dump()\n"
+    "signal.signal(signal.SIGUSR2, _on_sigusr2)\n"
+)
+
+
+def test_kdt401_flags_plain_lock_reachable_from_handler(tmp_path):
+    res = lint_snippet(tmp_path, _SIGNAL_RING.format(ctor="Lock"),
+                       relpath="obs/mod.py")
+    # record() and dump() are both handler-reachable by name resolution;
+    # at least the handler's own dump() path must be flagged
+    assert set(rules_of(res)) == {"KDT401"}
+    assert any("non-reentrant" in f.message for f in res.findings)
+
+
+def test_kdt401_clean_with_rlock(tmp_path):
+    res = lint_snippet(tmp_path, _SIGNAL_RING.format(ctor="RLock"),
+                       relpath="obs/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt401_lockwatch_factory_kinds(tmp_path):
+    # the factory spellings carry the same reentrancy semantics
+    src = (
+        "import signal\n"
+        "from kdtree_tpu.analysis import lockwatch\n"
+        "_lock = lockwatch.{ctor}('x')\n"
+        "def _on_sig(signum, frame):\n"
+        "    with _lock:\n"
+        "        pass\n"
+        "signal.signal(signal.SIGUSR2, _on_sig)\n"
+    )
+    res = lint_snippet(tmp_path, src.format(ctor="make_lock"),
+                       relpath="obs/mod.py")
+    assert rules_of(res) == ["KDT401"]
+    res = lint_snippet(tmp_path, src.format(ctor="make_rlock"),
+                       relpath="obs/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt401_acquire_call_form_and_suppression(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import signal\n"
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def _on_sig(signum, frame):\n"
+        "    _lock.acquire()  "
+        "# kdt-lint: disable=KDT401 handler masked during this section\n"
+        "    _lock.release()\n"
+        "signal.signal(signal.SIGUSR2, _on_sig)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# KDT402 blocking-io-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_kdt402_flags_dump_inside_breaker_lock(tmp_path):
+    # the PR 9 bug, as source text: the open-transition dump serialized
+    # file I/O inside the breaker lock
+    res = lint_snippet(tmp_path, (
+        "import json\n"
+        "import os\n"
+        "import threading\n"
+        "class Breaker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def record_failure(self, path, ring):\n"
+        "        with self._lock:\n"
+        "            with open(path, 'w') as f:\n"
+        "                json.dump(ring, f)\n"
+        "            os.replace(path, path + '.done')\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT402", "KDT402", "KDT402"]
+    assert "blocks while" in res.findings[0].message
+
+
+def test_kdt402_flags_acquire_release_span(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def flush(path, line):\n"
+        "    _lock.acquire()\n"
+        "    open(path, 'a').write(line)\n"
+        "    _lock.release()\n"
+        "    open(path, 'a').write(line)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == ["KDT402"]  # only the held-span write
+
+
+def test_kdt402_flags_acquire_try_finally_release(tmp_path):
+    # THE canonical span idiom: acquire, try-body I/O, finally-release.
+    # The finally's release must not retroactively clear the hold its
+    # own try body ran under (the miss that let the PR 9 shape through)
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def flush(path, line):\n"
+        "    _lock.acquire()\n"
+        "    try:\n"
+        "        open(path, 'a').write(line)\n"
+        "    finally:\n"
+        "        _lock.release()\n"
+        "    open(path, 'a').write(line)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == ["KDT402"]  # the try-body write, held
+    assert res.findings[0].line == 6
+
+
+def test_kdt402_flags_with_open_header_in_held_span(tmp_path):
+    # `with open(...)` is the idiomatic spelling of the dump-under-lock
+    # shape; the I/O lives in the With HEADER, not a simple statement
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def flush(path, line):\n"
+        "    _lock.acquire()\n"
+        "    try:\n"
+        "        with open(path, 'a') as f:\n"
+        "            f.write(line)\n"
+        "    finally:\n"
+        "        _lock.release()\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == ["KDT402"]
+    assert res.findings[0].line == 6
+
+
+def test_kdt402_clean_snapshot_then_write_outside(tmp_path):
+    # the sanctioned pattern: copy under the lock, I/O outside — and a
+    # nested def (the flight background-writer shape) runs later, off
+    # the lock, so it stays quiet too
+    res = lint_snippet(tmp_path, (
+        "import json\n"
+        "import threading\n"
+        "class Ring:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._ring = []\n"
+        "    def dump(self, path):\n"
+        "        with self._lock:\n"
+        "            snap = list(self._ring)\n"
+        "            def _writer():\n"
+        "                with open(path, 'w') as f:\n"
+        "                    json.dump(snap, f)\n"
+        "        with open(path, 'w') as f:\n"
+        "            json.dump(snap, f)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt402_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def flush(path, line):\n"
+        "    with _lock:\n"
+        "        # kdt-lint: disable=KDT402 the lock IS the single-writer file discipline\n"
+        "        open(path, 'a').write(line)\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# KDT403 bare-flag-shutdown-toctou
+# ---------------------------------------------------------------------------
+
+
+def test_kdt403_flags_bare_stop_flag_poll(tmp_path):
+    # the PR 4 bug shape: a stop flag set by one method, polled bare in
+    # the worker loop of another
+    res = lint_snippet(tmp_path, (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._running = True\n"
+        "    def stop(self):\n"
+        "        self._running = False\n"
+        "    def _loop(self):\n"
+        "        while self._running:\n"
+        "            self.step()\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT403"]
+    assert "_running" in res.findings[0].message
+    assert "stop" in res.findings[0].message
+
+
+def test_kdt403_clean_with_event_and_queue_gate(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._stop = threading.Event()\n"
+        "    def stop(self):\n"
+        "        self._stop.set()\n"
+        "    def _loop(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            self.step()\n"
+        "    def _drain(self):\n"
+        "        while True:\n"
+        "            if self.queue.closed and self.queue.rows == 0:\n"
+        "                return\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt403_same_method_loop_is_not_a_toctou(tmp_path):
+    # a flag written and polled by the SAME method is single-threaded
+    # control flow, not a cross-thread race
+    res = lint_snippet(tmp_path, (
+        "class Retry:\n"
+        "    def run(self):\n"
+        "        self._more = True\n"
+        "        while self._more:\n"
+        "            self._more = self.step()\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# KDT404 nondaemon-thread-without-join
+# ---------------------------------------------------------------------------
+
+
+def test_kdt404_flags_unbound_and_unjoined_threads(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._worker = threading.Thread(target=self.run)\n"
+        "        self._worker.start()\n"
+        "        threading.Thread(target=self.run).start()\n"
+        "    def run(self):\n"
+        "        pass\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT404", "KDT404"]
+
+
+def test_kdt404_clean_when_joined_or_daemon(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._worker = threading.Thread(target=self.run)\n"
+        "        self._worker.start()\n"
+        "        self._bg = threading.Thread(target=self.run, daemon=True)\n"
+        "        self._bg.start()\n"
+        "        self._late = threading.Thread(target=self.run)\n"
+        "        self._late.daemon = True\n"
+        "        self._late.start()\n"
+        "    def stop(self):\n"
+        "        self._worker.join()\n"
+        "    def run(self):\n"
+        "        pass\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt404_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "import threading\n"
+        "def fire(fn):\n"
+        "    # kdt-lint: disable=KDT404 short-lived writer; non-daemon so the dump survives exit\n"
+        "    threading.Thread(target=fn).start()\n"
+    ), relpath="obs/mod.py")
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# lint --root (the PR 3 cwd papercut)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_lint_root_resolves_paths_and_baseline(tmp_path, capsys,
+                                                   monkeypatch):
+    """--root makes lint cwd-independent: default paths and the
+    relative baseline resolve against the given root, so the same
+    command works from anywhere (CI checkouts, editor cwds)."""
+    import os
+
+    root = tmp_path / "repo"
+    (root / "kdtree_tpu").mkdir(parents=True)
+    (root / "kdtree_tpu" / "mod.py").write_text(_VIOLATION)
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)
+
+    # default path (kdtree_tpu) + default baseline both under --root
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "--root", str(root)])
+    assert exc.value.code == 1
+    assert "KDT301" in capsys.readouterr().out
+
+    cli.main(["lint", "--root", str(root), "--update-baseline"])
+    capsys.readouterr()
+    assert os.path.exists(root / "lint_baseline.json")
+    assert not os.path.exists(elsewhere / "lint_baseline.json")
+
+    # grandfathered now — and the finding paths are root-relative, so
+    # the baseline matches no matter where the command runs from
+    cli.main(["lint", "--root", str(root)])
+    assert "0 NEW" in capsys.readouterr().out
+
+    monkeypatch.chdir(tmp_path)
+    cli.main(["lint", "--root", str(root)])
+    assert "0 NEW" in capsys.readouterr().out
+
+
+def test_cli_lint_root_must_be_a_directory(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "--root", str(tmp_path / "nope")])
+    assert exc.value.code == 2

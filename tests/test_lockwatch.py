@@ -1,0 +1,464 @@
+"""The runtime lock-order sanitizer (kdtree_tpu/analysis/lockwatch.py).
+
+Unit coverage for the watcher semantics (order graph, cycle fail-fast,
+reentrancy, hold budget, artifact schema), plus the two HISTORICAL
+deadlocks re-pinned under ``KDTREE_TPU_LOCKWATCH=1`` — the satellite
+contract of ISSUE 11:
+
+- SIGUSR2 firing inside ``FlightRecorder.record()``'s critical section
+  (the PR 5 deadlock; the RLock fix must hold under instrumentation);
+- a breaker transition concurrent with ``allow()`` (the PR 9 stall; the
+  transition's file I/O must run OUTSIDE the breaker lock, which the
+  hold-budget tracking now proves mechanically).
+
+No jax API anywhere (package import aside): tier-1-cheap.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from kdtree_tpu.analysis import lockwatch
+
+
+@pytest.fixture
+def watched(monkeypatch, tmp_path):
+    """Lockwatch ON with an isolated artifact dir and a fresh graph.
+
+    The watcher is a process singleton shared with an env-enabled
+    tier-1 run, and its atexit artifact is the CI gate's input — so the
+    pre-test graph is stashed and MERGED BACK after, rather than wiped:
+    evidence (edges, hold violations) accumulated by every other test
+    must survive this file's isolation."""
+    monkeypatch.setenv(lockwatch.ENV_ENABLE, "1")
+    monkeypatch.setenv(lockwatch.ENV_DIR, str(tmp_path))
+    monkeypatch.delenv(lockwatch.ENV_STRICT, raising=False)
+    w = lockwatch.watcher()
+    saved = w.export_state()
+    w.reset()
+    yield w
+    w.reset()
+    w.merge_state(saved)
+
+
+# ---------------------------------------------------------------------------
+# factory semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_factories_return_plain_stdlib(monkeypatch):
+    monkeypatch.delenv(lockwatch.ENV_ENABLE, raising=False)
+    assert type(lockwatch.make_lock("x")) is type(threading.Lock())
+    assert isinstance(lockwatch.make_rlock("x"), type(threading.RLock()))
+    assert isinstance(lockwatch.make_condition("x"), threading.Condition)
+
+
+def test_enabled_factories_instrument(watched):
+    lk = lockwatch.make_lock("t.lock")
+    assert isinstance(lk, lockwatch.WatchedLock)
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+    rk = lockwatch.make_rlock("t.rlock")
+    assert isinstance(rk, lockwatch.WatchedRLock)
+
+
+def test_order_graph_records_edges_and_counts(watched):
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = watched.report()
+    edges = {(e["from"], e["to"]): e for e in rep["edges"]}
+    assert edges[("t.a", "t.b")]["count"] == 3
+    assert edges[("t.a", "t.b")]["stack"]  # provenance for the artifact
+    assert rep["cycles"] == []
+
+
+def test_lock_order_inversion_raises_and_records(watched):
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+    with a:
+        with b:
+            pass
+    err = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except lockwatch.LockOrderError as e:
+            err.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    assert err, "the inverted acquisition must fail fast"
+    assert "t.a" in str(err[0]) and "t.b" in str(err[0])
+    assert watched.cycles()  # recorded for the artifact/CI gate
+
+
+def test_nonreentrant_self_reacquire_raises(watched):
+    # the PR 5 deadlock in miniature: same thread, same plain lock —
+    # without the sanitizer this blocks forever, with it it raises
+    lk = lockwatch.make_lock("t.self")
+    lk.acquire()
+    with pytest.raises(lockwatch.LockOrderError, match="re-acquired"):
+        lk.acquire()
+    lk.release()
+
+
+def test_rlock_reentrancy_is_clean(watched):
+    rk = lockwatch.make_rlock("t.ring")
+    with rk:
+        with rk:
+            with rk:
+                pass
+    assert watched.cycles() == []
+    # one held entry per instance: no self-edges were minted
+    assert all(e["from"] != e["to"] for e in watched.report()["edges"])
+
+
+def test_nested_rlock_reacquire_with_intervening_lock_is_clean(watched):
+    # `with R: with A: with R:` cannot deadlock (the thread owns R) and
+    # orders against nothing — the re-acquire must mint NO reversed
+    # A -> R edge against the real R -> A one (which would read as an
+    # inversion and fail the CI gate on a legal pattern)
+    r = lockwatch.make_rlock("t.outer")
+    a = lockwatch.make_lock("t.mid")
+    with r:
+        with a:
+            with r:
+                pass
+    assert watched.cycles() == []
+    edges = {(e["from"], e["to"]) for e in watched.report()["edges"]}
+    assert ("t.mid", "t.outer") not in edges
+
+
+def test_same_name_different_instances_do_not_false_cycle(watched):
+    # two locks sharing a ROLE (e.g. two shards' route.shard) nested is
+    # not an inversion of the role against itself
+    a1 = lockwatch.make_lock("t.shard")
+    a2 = lockwatch.make_lock("t.shard")
+    with a1:
+        with a2:
+            pass
+    assert watched.cycles() == []
+
+
+def test_io_hold_past_budget_is_recorded(watched, monkeypatch, tmp_path):
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "1")
+    lk = lockwatch.make_lock("t.io")
+    with lk:
+        (tmp_path / "x").write_text("x")  # audit: open -> did_io
+        time.sleep(0.01)
+    v = [x for x in watched.violations() if x["lock"] == "t.io"]
+    assert v and v[0]["held_ms"] > 1.0 and v[0]["io"] is True
+
+
+def test_io_free_hold_is_not_a_violation(watched, monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "1")
+    lk = lockwatch.make_lock("t.cpu")
+    with lk:
+        time.sleep(0.01)  # long hold, but no I/O: compute is legal
+    assert not [x for x in watched.violations() if x["lock"] == "t.cpu"]
+
+
+def test_strict_mode_raises_deferred_at_next_acquire(watched, monkeypatch,
+                                                     tmp_path):
+    # the strict raise is DEFERRED to the thread's next blocking
+    # acquire: raising from release would fire inside __exit__ (masking
+    # the with-body's own exception) or inside Condition.wait's
+    # release-save (corrupting the waiter list)
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "1")
+    monkeypatch.setenv(lockwatch.ENV_STRICT, "1")
+    lk = lockwatch.make_lock("t.strict")
+    with lk:  # must exit cleanly even though the hold violates
+        (tmp_path / "y").write_text("y")
+        time.sleep(0.01)
+    assert not lk.locked()
+    with pytest.raises(lockwatch.LockHoldError, match="while performing"):
+        lk.acquire()
+    # the pending error is consumed: the retry proceeds normally
+    with lk:
+        pass
+
+
+def test_strict_mode_does_not_mask_with_body_exception(watched,
+                                                       monkeypatch,
+                                                       tmp_path):
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "1")
+    monkeypatch.setenv(lockwatch.ENV_STRICT, "1")
+    lk = lockwatch.make_lock("t.strict2")
+    with pytest.raises(ValueError, match="the real failure"):
+        with lk:
+            (tmp_path / "z").write_text("z")
+            time.sleep(0.01)
+            raise ValueError("the real failure")
+    # the hold violation still surfaces — at the next acquire
+    with pytest.raises(lockwatch.LockHoldError):
+        lk.acquire()
+
+
+def test_condition_wait_notify_roundtrip(watched):
+    cond = lockwatch.make_condition("t.cond")
+    got = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=10)
+            got.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=10)
+    assert got == [1]
+    assert watched.cycles() == []
+
+
+def test_strict_mode_survives_condition_wait(watched, monkeypatch,
+                                             tmp_path):
+    # a hold violation noticed by wait()'s release-save must NOT raise
+    # from the internal re-acquire (that would leave the condition lock
+    # un-owned behind wait's back, corrupt the count, and ghost the
+    # waiter) — it defers to the thread's next user-initiated acquire
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "1")
+    monkeypatch.setenv(lockwatch.ENV_STRICT, "1")
+    cond = lockwatch.make_condition("t.strictcond")
+    with cond:
+        (tmp_path / "w").write_text("w")
+        time.sleep(0.01)
+        cond.wait(timeout=0.05)  # release-save sees the violation
+        assert cond._lock._count == 1  # depth restored, not corrupted
+    with pytest.raises(lockwatch.LockHoldError):
+        cond.acquire()
+
+
+def test_condition_wait_releases_recursive_holds(watched):
+    # the stdlib Condition defaults to an RLock; the watched variant
+    # must match — a wait() while the lock is held RECURSIVELY releases
+    # every level (via _release_save) so the notifier can get in, then
+    # restores the full depth
+    cond = lockwatch.make_condition("t.rcond")
+    got = []
+
+    def waiter():
+        cond.acquire()
+        cond.acquire()  # recursive hold
+        cond.wait(timeout=10)
+        got.append(cond._lock._count)  # depth restored after wait
+        cond.release()
+        cond.release()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:  # acquirable because wait released BOTH levels
+        cond.notify_all()
+    t.join(timeout=10)
+    assert got == [2]
+    assert watched.cycles() == []
+
+
+def test_rlock_release_race_leaves_no_stranded_entries(watched):
+    # regression for the release-race: still-held must be read BEFORE
+    # the inner release, or a contender re-acquiring in the gap strands
+    # the releasing thread's held entry — whose ghost then mints a
+    # false "t.race -> t.probe*" edge from that thread's next acquire
+    shared = lockwatch.make_rlock("t.race")
+    probes = [lockwatch.make_lock(f"t.probe{i}") for i in range(2)]
+
+    def churn(i):
+        for _ in range(2000):
+            with shared:
+                pass
+        with probes[i]:  # held stack must be empty by now
+            pass
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    bad = [e for e in watched.report()["edges"] if e["from"] == "t.race"]
+    assert not bad, f"stranded held entry minted false edges: {bad}"
+
+
+def test_dump_artifact_schema(watched, tmp_path):
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+    with a:
+        with b:
+            pass
+    path = lockwatch.dump(str(tmp_path / "graph.json"))
+    doc = json.load(open(path))
+    assert doc["lockwatch_version"] == lockwatch.LOCKWATCH_VERSION
+    assert doc["pid"] == os.getpid()
+    assert doc["locks"]["t.a"] >= 1
+    assert {"from": "t.a", "to": "t.b"}.items() <= doc["edges"][0].items()
+    assert doc["cycles"] == [] and isinstance(doc["violations"], list)
+
+
+def test_default_dump_path_is_pid_suffixed(watched, tmp_path):
+    lk = lockwatch.make_lock("t.a")
+    with lk:  # never leave a held entry stranded on the main thread:
+        pass  # it would mint false edges into the process artifact
+    path = lockwatch.dump()
+    assert path == str(tmp_path / f"lockwatch-graph-{os.getpid()}.json")
+    assert json.load(open(path))["locks"]
+
+
+# ---------------------------------------------------------------------------
+# regression: SIGUSR2 inside FlightRecorder.record()'s critical section
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR2"),
+                    reason="platform has no SIGUSR2")
+def test_sigusr2_inside_record_critical_section_under_lockwatch(
+    watched, tmp_path,
+):
+    """The PR 5 deadlock, re-pinned under the sanitizer: the handler
+    fires while the MAIN thread sits inside the ring's critical section
+    and dumps the ring — the reentrant acquire must succeed (no wedge,
+    no LockOrderError) and the dump must be parseable. The plain-Lock
+    variant of this exact shape is the KDT401 true-positive fixture in
+    tests/test_analysis.py."""
+    from kdtree_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=64)  # built with lockwatch ON
+    assert isinstance(rec._lock, lockwatch.WatchedRLock)
+    for i in range(5):
+        rec.record("warmup", i=i)
+    dump_path = tmp_path / "flight-sig.json"
+    fired = []
+
+    def _on_sig(signum, frame):
+        fired.append(rec.dump(str(dump_path), reason="in-critical-section"))
+
+    old = signal.signal(signal.SIGUSR2, _on_sig)
+    try:
+        with rec._lock:  # the middle of record()'s critical section
+            os.kill(os.getpid(), signal.SIGUSR2)
+            # the handler runs between bytecodes of THIS loop, while
+            # the lock is held — give it a bytecode to land on
+            for _ in range(1000):
+                if fired:
+                    break
+    finally:
+        signal.signal(signal.SIGUSR2, old)
+    assert fired, "handler never ran"
+    doc = json.load(open(dump_path))
+    assert doc["reason"] == "in-critical-section"
+    assert len(doc["events"]) == 5
+    assert watched.cycles() == [], "handler reentry must not read as a cycle"
+
+
+# ---------------------------------------------------------------------------
+# regression: breaker transition concurrent with allow()
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_transition_io_runs_outside_lock_under_lockwatch(
+    watched, monkeypatch, tmp_path,
+):
+    """The PR 9 stall, re-pinned mechanically: the open-transition
+    reporter writes a file (slow, past the hold budget) while other
+    threads hammer allow(). The hold-budget tracking must see ZERO
+    I/O-under-lock violations on route.breaker — proof the reporter
+    runs outside the lock — and no ordering cycles. The under-the-lock
+    variant is the KDT402 true-positive fixture in
+    tests/test_analysis.py."""
+    from kdtree_tpu.serve.router import CircuitBreaker
+
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "5")
+    dump_file = tmp_path / "breaker-dump.json"
+
+    def slow_reporter(old, new):
+        dump_file.write_text(json.dumps({"from": old, "to": new}))
+        time.sleep(0.02)  # well past the 5 ms budget
+
+    br = CircuitBreaker(failures=2, reset_s=0.05,
+                        on_transition=slow_reporter)
+    assert isinstance(br._lock, lockwatch.WatchedLock)
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                br.allow()
+        except Exception as e:  # LockOrderError included
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(6):  # closed -> open -> half-open -> ... churn
+            br.record_failure()
+            br.record_failure()
+            time.sleep(0.06)
+            br.allow()
+            br.record_success()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    assert dump_file.exists()  # transitions really did file I/O
+    assert not [v for v in watched.violations()
+                if v["lock"] == "route.breaker"], (
+        "transition I/O leaked inside the breaker lock"
+    )
+    assert watched.cycles() == []
+
+
+# ---------------------------------------------------------------------------
+# the product stack under the sanitizer
+# ---------------------------------------------------------------------------
+
+
+def test_admission_queue_under_lockwatch(watched):
+    """The admission queue's Condition is watched end to end: submit /
+    pop_wait across threads, flight events recorded under the held
+    condition — the serve.admission -> obs.* edges must be acyclic."""
+    import numpy as np
+
+    from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
+
+    q = AdmissionQueue(max_rows=8)
+    popped = []
+
+    def worker():
+        while True:
+            req = q.pop_wait(2.0)
+            if req is None:
+                return
+            popped.append(req)
+            req.fulfill(None, None)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    reqs = [PendingRequest(np.zeros((1, 3), np.float32), 1)
+            for _ in range(4)]
+    for r in reqs:
+        q.submit(r)
+    for r in reqs:
+        assert r.event.wait(5)
+    q.close()
+    t.join(timeout=10)
+    assert len(popped) == 4
+    assert watched.cycles() == []

@@ -405,9 +405,10 @@ def test_churn_counts_toward_backlog_and_compacts(base_points, queries):
 
 
 def test_requested_k_survives_growth_past_bootstrap_size():
-    """A tiny bootstrap index must not pin k forever: the inner engine
-    clamps k to its n_real, but the CONFIGURED k governs every rebuilt
-    epoch — after growth, the full k serves."""
+    """A tiny bootstrap index must not pin k forever: the CONFIGURED k
+    is the request contract from the first batch (small epochs pad with
+    (inf, -1)), k_effective reports what actually exists, and a rebuilt
+    epoch over enough points serves the full k with no padding."""
     import jax.numpy as jnp
 
     from kdtree_tpu.ops.morton import build_morton
@@ -416,7 +417,13 @@ def test_requested_k_survives_growth_past_bootstrap_size():
     eng = MutableEngine(ServeEngine(build_morton(jnp.asarray(seed)), 16),
                         max_delta_rows=40, max_delta_frac=0.0,
                         requested_k=16)
-    assert eng.k == 5  # bootstrap clamp
+    # the configured k IS the contract — no bootstrap clamp on the cap
+    assert eng.k == 16
+    assert eng.k_effective == 5  # only 5 live points to return yet
+    q = np.zeros((1, 3), dtype=np.float32)
+    d2, ids, _ = eng.knn_batch(q)
+    assert d2.shape == (1, 16) and ids.shape == (1, 16)
+    assert (ids[:, 5:] == -1).all() and np.isinf(d2[:, 5:]).all()
     rng = np.random.default_rng(6)
     eng.upsert(np.arange(5, 45),
                rng.uniform(-100, 100, (40, 3)).astype(np.float32))
@@ -424,8 +431,54 @@ def test_requested_k_survives_growth_past_bootstrap_size():
     while eng.epoch < 1 and time.monotonic() < deadline:
         time.sleep(0.05)
     assert eng.epoch == 1
-    assert eng.k == 16  # 45 points now — the configured k is back
+    assert eng.k == 16 and eng.k_effective == 16  # 45 points now
+    d2, ids, _ = eng.knn_batch(q)
+    assert (ids >= 0).all()  # full k of real neighbors, no padding
     eng.close()
+
+
+def test_configured_k_survives_deletes_below_k(base_points, queries):
+    """The PR 10 carried-forward gotcha, pinned: deletes pushing the
+    live count below --k must not shrink k_max (the /v1/knn request
+    cap) — neither before NOR after the compaction. Answers pad with
+    (inf, -1), and healthz-visible stats report configured vs
+    effective k."""
+    pts = base_points[:8]
+    eng = fresh_engine(pts)  # K = 4 configured
+    assert eng.k == K and eng.k_effective == K
+    eng.delete(np.arange(6))  # 2 survivors < K
+    assert eng.k == K, "k_max shrank under deletes"
+    assert eng.k_effective == 2
+    st = eng.stats()
+    assert st["k_configured"] == K and st["k_effective"] == 2
+    d2, ids, _ = eng.knn_batch(queries)
+    assert ids.shape[1] == K
+    assert (ids[:, 2:] == -1).all() and np.isinf(d2[:, 2:]).all()
+    # the two real hits are exact vs the rebuild oracle over survivors
+    model = {i: pts[i] for i in (6, 7)}
+    od2, oids = oracle_answer(model, queries, k=2)
+    np.testing.assert_array_equal(ids[:, :2], oids)
+    np.testing.assert_array_equal(d2[:, :2], od2)
+    # the degradation path obeys the same contract
+    fd2, fids = eng.fallback_knn(queries, K)
+    np.testing.assert_array_equal(fids, ids)
+    np.testing.assert_array_equal(fd2, d2)
+    eng.close()
+
+    # across a compaction: a tighter threshold forces the rebuild; the
+    # epoch over 2 survivors still answers the configured k, padded
+    eng2 = fresh_engine(pts, max_delta_rows=4)
+    eng2.delete(np.arange(6))  # backlog 6 >= 4 -> rebuild
+    deadline = time.monotonic() + 120
+    while eng2.epoch < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng2.epoch == 1
+    assert eng2.k == K, "k_max shrank across the epoch swap"
+    assert eng2.k_effective == 2
+    d2b, idsb, _ = eng2.knn_batch(queries)
+    np.testing.assert_array_equal(idsb, ids)
+    np.testing.assert_array_equal(d2b, d2)
+    eng2.close()
 
 
 def test_delta_padding_never_leaks_a_real_id():
