@@ -24,7 +24,11 @@ another pair sidecar.
 A telemetry sidecar (full metrics/span report, docs/OBSERVABILITY.md) is
 written to $KDTREE_TPU_METRICS_OUT (default ./bench_telemetry.json;
 "none" disables telemetry entirely — the A/B partner for the <2%
-metrics-overhead acceptance check). The sidecar also carries a "profile"
+metrics-overhead acceptance check). The sidecar format is shared with
+`kdtree-tpu loadgen`, whose sidecars additionally carry a versioned
+"capacity" block (latency-vs-offered-load curve + knee rate); `kdtree-tpu
+trend` reads both kinds in one series — this bench's headline compares
+across rounds, capacity compares between capacity-bearing runs. The sidecar also carries a "profile"
 block (device busy_frac + per-dispatch busy/lag medians from a short
 in-bench jax.profiler capture of the tiled-query shape, docs/TUNING.md
 "Raw speed") so the >90% busy_frac target is a mechanical regression

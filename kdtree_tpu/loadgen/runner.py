@@ -1,0 +1,536 @@
+"""The open-loop driver: replay a schedule, emit a capacity block.
+
+The runner takes a precomputed :class:`~kdtree_tpu.loadgen.schedule.
+Schedule` and a live target (a ``kdtree-tpu serve`` shard or a
+``route`` front) and does exactly three things:
+
+1. **Dispatch on schedule.** A scheduler walks the arrivals and hands
+   each one to a worker pool *at its intended time* — it never waits
+   for a response. The pool is sized by ``max_inflight``; if every
+   worker is busy the arrival queues client-side, and because latency
+   is measured from the **intended** send time, that wait is charged to
+   the measurement, not hidden from it (the report carries the send-lag
+   p99 so a client-saturated run is self-describing).
+2. **Classify.** Each response lands in its step's accumulator:
+   ok / shed (429) / degraded / partial / error (5xx, protocol) /
+   timeout, plus the intended-latency sample. Goodput is 200-answers
+   per second of step time.
+3. **Summarize.** Per step: client-side p50/p95/p99 intended latency,
+   goodput, shed/degraded/partial/error fractions. Across steps: the
+   **knee** — the highest offered rate whose step met the latency SLO
+   at the configured quantile with an acceptable bad fraction. A final
+   ``/metrics`` scrape folds the server's own write-path evidence
+   (``kdtree_write_latency_ms``, the epoch-rebuild p99 delta, the
+   epoch counter) into the block, so one artifact carries both sides
+   of the run.
+
+Every request carries ``X-Loadgen-Rate`` (the step's offered rate) —
+the serving process mirrors it into a gauge and a flight event, so an
+SLO PAGE that fires mid-run names the offered rate in its incident
+dump. Step transitions and the knee verdict land in this process's own
+flight ring too.
+
+Stdlib + numpy only — no jax; the client must not perturb the machine
+it measures.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+import numpy as np
+
+from kdtree_tpu.obs import flight
+
+CAPACITY_VERSION = 1
+DEFAULT_SLO_MS = 250.0  # matches the request-p99-latency serving SLO
+DEFAULT_SLO_QUANTILE = 0.99
+DEFAULT_MAX_BAD_FRAC = 0.05
+DEFAULT_MAX_INFLIGHT = 64
+DEFAULT_TIMEOUT_S = 10.0
+
+__all__ = ["discover", "run_load", "compute_knee", "scrape_server_block",
+           "CAPACITY_VERSION"]
+
+
+def _host_port(target: str) -> Tuple[str, int]:
+    parsed = urlparse(target if "//" in target else f"http://{target}")
+    if not parsed.hostname or not parsed.port:
+        raise ValueError(
+            f"target {target!r} must be http://host:port"
+        )
+    return parsed.hostname, parsed.port
+
+
+def _request(
+    target: str, method: str, path: str, body: Optional[dict],
+    timeout_s: float, headers: Optional[Dict[str, str]] = None,
+) -> Tuple[int, Optional[dict]]:
+    """One one-shot HTTP exchange; (status, parsed JSON | None). Raises
+    OSError/http.client.HTTPException on transport failure — the caller
+    decides whether that is an outcome or a fatal. Used by the control
+    plane (discovery); the measured load path uses per-worker
+    keep-alive connections (:class:`_WorkerConn`)."""
+    host, port = _host_port(target)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        payload = None if body is None else json.dumps(body).encode()
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request(method, path, body=payload, headers=hdrs)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            parsed = json.loads(raw) if raw else None
+        except ValueError:
+            parsed = None
+        return resp.status, parsed
+    finally:
+        conn.close()
+
+
+# reuse a worker's keep-alive connection only while comfortably inside
+# the serve handlers' 5 s idle socket timeout: a connection the server
+# already closed would turn the first request after an idle spell into
+# a spurious connection-reset "error" in the measurement
+_CONN_IDLE_REUSE_S = 2.0
+
+
+class _WorkerConn:
+    """One worker thread's persistent HTTP connection to the target.
+
+    The measured path must not pay a TCP handshake per request (at
+    sustained ladder rates that both depresses the measured quantiles —
+    the knee would partly measure the generator — and churns one
+    ephemeral port per request). Stale or failed connections are closed
+    and reopened; a request that failed on the wire is NOT retried —
+    the failure is the measurement."""
+
+    __slots__ = ("host", "port", "timeout_s", "conn", "last")
+
+    def __init__(self, target: str, timeout_s: float) -> None:
+        self.host, self.port = _host_port(target)
+        self.timeout_s = timeout_s
+        self.conn = None
+        self.last = 0.0
+
+    def request(self, path: str, body: dict,
+                headers: Dict[str, str]) -> Tuple[int, Optional[dict]]:
+        now = time.monotonic()
+        if self.conn is None or now - self.last > _CONN_IDLE_REUSE_S:
+            self.close()
+            self.conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers)
+        try:
+            self.conn.request("POST", path, body=json.dumps(body).encode(),
+                              headers=hdrs)
+            resp = self.conn.getresponse()
+            raw = resp.read()
+        except BaseException:
+            self.close()  # never leave a half-read connection for reuse
+            raise
+        self.last = time.monotonic()
+        if resp.will_close:
+            self.close()
+        try:
+            parsed = json.loads(raw) if raw else None
+        except ValueError:
+            parsed = None
+        return resp.status, parsed
+
+    def close(self) -> None:
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:
+                pass
+            self.conn = None
+
+
+def discover(
+    target: str, timeout_s: float = 5.0, retries: int = 60,
+    retry_sleep_s: float = 0.5,
+) -> Dict:
+    """Read the target's ``/healthz`` until it answers ready and derive
+    the schedule facts: ``dim``, total ``n``, ``k_max``, and
+    ``write_base`` (the first id fresh upserts can mint without
+    colliding with served rows). Handles both shapes: a shard's flat
+    body and the router's aggregated ``shards`` breakdown (per-shard
+    detail = that shard's own healthz body)."""
+    last = None
+    for _ in range(max(int(retries), 1)):
+        try:
+            status, body = _request(target, "GET", "/healthz", None,
+                                    timeout_s)
+        except (OSError, http.client.HTTPException) as e:
+            last = repr(e)
+            time.sleep(retry_sleep_s)
+            continue
+        if status == 200 and isinstance(body, dict):
+            if "dim" in body:
+                off = int(body.get("id_offset", 0))
+                n = int(body.get("n", 0))
+                return {
+                    "dim": int(body["dim"]),
+                    "n": n,
+                    "k_max": int(body.get("k_max", 1)),
+                    "write_base": off + n,
+                }
+            if "shards" in body:
+                dims, kmaxs, bases, total = [], [], [0], 0
+                for s in body["shards"]:
+                    detail = s.get("detail") or {}
+                    if "dim" in detail:
+                        dims.append(int(detail["dim"]))
+                        kmaxs.append(int(detail.get("k_max", 1)))
+                        total += int(detail.get("n", 0))
+                        bases.append(int(detail.get("id_offset", 0))
+                                     + int(detail.get("n", 0)))
+                if dims:
+                    return {
+                        "dim": dims[0],
+                        "n": total,
+                        "k_max": min(kmaxs),
+                        "write_base": max(bases),
+                    }
+        last = f"healthz answered {status}"
+        time.sleep(retry_sleep_s)
+    raise RuntimeError(
+        f"target {target} never reported ready: {last}"
+    )
+
+
+# --------------------------------------------------------------------------
+# per-step accounting
+# --------------------------------------------------------------------------
+
+
+class _StepAcc:
+    """One rate step's outcome ledger (appended under the runner lock —
+    the lock guards list/int updates only, never I/O)."""
+
+    __slots__ = ("rate", "intended", "sent", "latencies_ms",
+                 "send_lag_ms", "counts")
+
+    def __init__(self, rate: float) -> None:
+        self.rate = float(rate)
+        self.intended = 0
+        self.sent = 0
+        self.latencies_ms: List[float] = []
+        self.send_lag_ms: List[float] = []
+        self.counts = {
+            "ok": 0, "shed": 0, "degraded": 0, "partial": 0,
+            "errors": 0, "timeouts": 0, "writes_ok": 0,
+        }
+
+
+def _classify(op: str, status: int, body: Optional[dict]) -> List[str]:
+    """Outcome tags for one completed exchange (a 200 can be both ok
+    and degraded/partial — the fractions are independent signals)."""
+    if status == 429:
+        return ["shed"]
+    if status != 200:
+        return ["errors"]
+    tags = ["ok"]
+    if op != "query":
+        tags.append("writes_ok")
+        return tags
+    degraded = (body or {}).get("degraded")
+    if isinstance(degraded, str):
+        tags.append("partial" if degraded.startswith("partial")
+                    else "degraded")
+    return tags
+
+
+def _quantiles_ms(vals: List[float]) -> Dict[str, Optional[float]]:
+    if not vals:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    arr = np.asarray(vals, dtype=np.float64)
+    p50, p95, p99 = np.percentile(arr, [50.0, 95.0, 99.0])
+    return {"p50_ms": round(float(p50), 3), "p95_ms": round(float(p95), 3),
+            "p99_ms": round(float(p99), 3)}
+
+
+def compute_knee(
+    steps: List[dict],
+    slo_ms: float = DEFAULT_SLO_MS,
+    slo_quantile: float = DEFAULT_SLO_QUANTILE,
+    max_bad_frac: float = DEFAULT_MAX_BAD_FRAC,
+) -> float:
+    """The capacity verdict: the highest offered rate whose step met
+    the SLO — quantile latency within ``slo_ms`` AND
+    (shed + errors + timeouts) / sent within ``max_bad_frac``. 0.0 when
+    no step qualified (the service has no measured capacity at this
+    ladder — itself a finding, not an absence of data).
+
+    Only the quantiles the steps actually report are judgeable; an
+    unsupported value must be an error, not a silent fall-back to p99
+    that contradicts the ``slo_quantile`` the artifact publishes."""
+    qkey = {0.5: "p50_ms", 0.95: "p95_ms", 0.99: "p99_ms"}.get(
+        round(float(slo_quantile), 4)
+    )
+    if qkey is None:
+        raise ValueError(
+            f"slo_quantile must be one of 0.5 / 0.95 / 0.99 (the "
+            f"reported step quantiles), got {slo_quantile}"
+        )
+    knee = 0.0
+    for s in steps:
+        if not s.get("sent"):
+            continue
+        lat = s.get(qkey)
+        if lat is None or lat > slo_ms:
+            continue
+        if s.get("bad_frac", 1.0) > max_bad_frac:
+            continue
+        knee = max(knee, float(s["rate"]))
+    return knee
+
+
+# --------------------------------------------------------------------------
+# server-side evidence scrape
+# --------------------------------------------------------------------------
+
+
+def _parse_prom_lines(text: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _sum_series(parsed: Dict[str, float], family: str,
+                must_contain: str = "") -> Optional[float]:
+    """Sum every series of ``family`` whose key contains
+    ``must_contain`` (matches across extra labels — a federated router
+    scrape adds ``shard=...``)."""
+    vals = [
+        v for k, v in parsed.items()
+        if (k == family or k.startswith(family + "{"))
+        and must_contain in k
+    ]
+    return sum(vals) if vals else None
+
+
+def scrape_server_block(target: str,
+                        timeout_s: float = 5.0) -> Optional[Dict]:
+    """One ``/metrics`` scrape distilled to the write-path evidence the
+    capacity block publishes: per-op ``kdtree_write_latency_ms``
+    count/mean, the epoch-rebuild p99 delta, and the epoch. Falls back
+    to the router's federated scrape when the plain exposition has no
+    write families (the shards hold them). None when the scrape failed
+    — the client-side curve stands on its own."""
+    for path in ("/metrics", "/metrics?federate=1"):
+        try:
+            host, port = _host_port(target)
+            conn = http.client.HTTPConnection(host, port,
+                                              timeout=timeout_s)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                status, text = resp.status, resp.read().decode(
+                    "utf-8", "replace")
+            finally:
+                conn.close()
+            if status != 200:
+                return None
+            parsed = _parse_prom_lines(text)
+            writes = {}
+            for op in ("upsert", "delete"):
+                count = _sum_series(parsed, "kdtree_write_latency_ms_count",
+                                    f'op="{op}"')
+                total = _sum_series(parsed, "kdtree_write_latency_ms_sum",
+                                    f'op="{op}"')
+                if count:
+                    writes[op] = {
+                        "count": int(count),
+                        "mean_ms": round((total or 0.0) / count, 3),
+                    }
+            if not writes and path == "/metrics":
+                continue  # router front: the shards hold the families
+            delta = _sum_series(parsed,
+                                "kdtree_mutable_rebuild_p99_delta_ms")
+            epoch = _sum_series(parsed, "kdtree_epoch")
+            return {
+                "write_latency_ms": writes,
+                "rebuild_p99_delta_ms": (None if delta is None
+                                         else round(delta, 3)),
+                "epoch": None if epoch is None else int(epoch),
+            }
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
+    return None
+
+
+# --------------------------------------------------------------------------
+# the runner
+# --------------------------------------------------------------------------
+
+
+def run_load(
+    target: str,
+    schedule,
+    k: int = 4,
+    slo_ms: float = DEFAULT_SLO_MS,
+    slo_quantile: float = DEFAULT_SLO_QUANTILE,
+    max_bad_frac: float = DEFAULT_MAX_BAD_FRAC,
+    max_inflight: int = DEFAULT_MAX_INFLIGHT,
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+    scrape: bool = True,
+    on_step=None,
+) -> Dict:
+    """Replay ``schedule`` against ``target``; return the full report
+    (see the module docstring for the measurement contract). ``on_step``
+    is an optional callback ``(step_index, rate)`` fired at each ladder
+    transition — the CLI's progress line."""
+    accs = [_StepAcc(r) for r in schedule.rates]
+    for a in schedule.arrivals:
+        accs[a.step].intended += 1
+    lock = threading.Lock()
+    work: "queue.Queue" = queue.Queue()
+    t0 = time.monotonic()
+
+    def record(arrival, intended: float, tags: List[str],
+               done: float, actual_send: float) -> None:
+        acc = accs[arrival.step]
+        with lock:
+            acc.sent += 1
+            acc.latencies_ms.append((done - intended) * 1e3)
+            acc.send_lag_ms.append(
+                max(actual_send - intended, 0.0) * 1e3)
+            for tag in tags:
+                acc.counts[tag] += 1
+
+    def do_request(conn: _WorkerConn, arrival, intended: float,
+                   seq: int) -> None:
+        actual_send = time.monotonic()
+        headers = {
+            "X-Loadgen-Rate": f"{schedule.rates[arrival.step]:g}",
+            # unique per arrival: an incident dump must correlate ONE
+            # slow exchange to its server-side span, not a whole step
+            "X-Request-Id": f"lg{schedule.seed}-{arrival.step}-{seq}",
+        }
+        if arrival.op == "query":
+            path, body = "/v1/knn", {
+                "queries": [arrival.point.tolist()], "k": int(k)}
+        elif arrival.op == "upsert":
+            path, body = "/v1/upsert", {
+                "ids": [int(arrival.gid)],
+                "points": [arrival.point.tolist()]}
+        else:
+            path, body = "/v1/delete", {"ids": [int(arrival.gid)]}
+        try:
+            status, resp = conn.request(path, body, headers)
+            tags = _classify(arrival.op, status, resp)
+        except TimeoutError:
+            # socket.timeout IS TimeoutError: the request outlived its
+            # client budget — the open-loop analog of a deadline miss
+            tags = ["timeouts"]
+        except (http.client.HTTPException, OSError):
+            tags = ["errors"]
+        record(arrival, intended, tags, time.monotonic(), actual_send)
+
+    def worker() -> None:
+        conn = _WorkerConn(target, timeout_s)
+        try:
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                do_request(conn, *item)
+        finally:
+            conn.close()
+
+    n_workers = max(int(max_inflight), 1)
+    threads = [
+        threading.Thread(target=worker, name=f"kdtree-loadgen-{i}")
+        for i in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+
+    current_step = -1
+    try:
+        for seq, arrival in enumerate(schedule.arrivals):
+            if arrival.step != current_step:
+                current_step = arrival.step
+                rate = schedule.rates[current_step]
+                flight.record("loadgen.step", step=current_step,
+                              rate=rate, target=target)
+                if on_step is not None:
+                    on_step(current_step, rate)
+            intended = t0 + arrival.t
+            delay = intended - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            # enqueue and move on: the schedule NEVER waits for a
+            # response — that is the open-loop contract
+            work.put((arrival, intended, seq))
+    finally:
+        for _ in threads:
+            work.put(None)
+        for t in threads:
+            t.join()
+
+    steps = []
+    for acc in accs:
+        sent = acc.sent
+        bad = (acc.counts["shed"] + acc.counts["errors"]
+               + acc.counts["timeouts"])
+        row = {
+            "rate": acc.rate,
+            "seconds": schedule.step_seconds,
+            "intended": acc.intended,
+            "sent": sent,
+            "goodput_rps": round(acc.counts["ok"]
+                                 / schedule.step_seconds, 3),
+            "bad_frac": round(bad / sent, 5) if sent else None,
+            "shed_frac": round(acc.counts["shed"] / sent, 5)
+            if sent else None,
+            "degraded_frac": round(acc.counts["degraded"] / sent, 5)
+            if sent else None,
+            "partial_frac": round(acc.counts["partial"] / sent, 5)
+            if sent else None,
+            **{key: acc.counts[key] for key in
+               ("ok", "shed", "degraded", "partial", "errors",
+                "timeouts", "writes_ok")},
+            **_quantiles_ms(acc.latencies_ms),
+            "send_lag_p99_ms": _quantiles_ms(acc.send_lag_ms)["p99_ms"],
+        }
+        steps.append(row)
+    knee = compute_knee(steps, slo_ms=slo_ms, slo_quantile=slo_quantile,
+                        max_bad_frac=max_bad_frac)
+    server_block = scrape_server_block(target) if scrape else None
+    capacity = {
+        "capacity_version": CAPACITY_VERSION,
+        "offered_unit": "req/s",
+        "slo_ms": float(slo_ms),
+        "slo_quantile": float(slo_quantile),
+        "max_bad_frac": float(max_bad_frac),
+        "knee_rate": knee,
+        "steps": steps,
+        "server": server_block,
+    }
+    flight.record("loadgen.knee", knee_rate=knee, slo_ms=float(slo_ms),
+                  steps=len(steps), target=target)
+    return {
+        "loadgen_version": 1,
+        "target": target,
+        "schedule": schedule.describe(),
+        "k": int(k),
+        "capacity": capacity,
+    }

@@ -38,6 +38,7 @@ thread outside the lock (lint rule KDT201 covers this package).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -53,6 +54,51 @@ DEFAULT_MAX_DELTA_ROWS = 4096
 DEFAULT_MAX_DELTA_FRAC = 0.25
 MAX_ID = 2**31  # local ids must fit the engines' int32 gid storage
 _CORRECTION_MIN_BUCKET = 8  # pow2 pad floor for the re-answer dispatch
+# tombstone-scatter index widths: mask batches pad up to the next rung
+# (repeating a position — the scatter is idempotent), so the write path
+# cycles FOUR compiled shapes instead of one per distinct id count, and
+# every rung is pre-warmed OFF the engine lock (construction / rebuild
+# thread). Before this, the first masked write paid a cold XLA compile
+# (~432 ms measured) INSIDE the write lock — the KDT402-class hold the
+# PR 11 lockwatch artifact surfaced.
+_MASK_PAD_BUCKETS = (8, 64, 512, 4096)
+# the serve-latency family the rebuild-impact join reads from the
+# history ring (one definition so the joiner and its test agree)
+_REQUEST_LATENCY_KEY = 'kdtree_serve_request_seconds{phase="total"}'
+
+
+def _mask_bucket(n: int) -> int:
+    for b in _MASK_PAD_BUCKETS:
+        if n <= b:
+            return b
+    return _pow2_ceil(n)
+
+
+def rebuild_impact(
+    history, t0_unix: float, t1_unix: float, quantile: float = 0.99,
+    hist_key: str = _REQUEST_LATENCY_KEY,
+) -> Optional[Dict]:
+    """Epoch-rebuild impact on serving latency, joined through the
+    metric-history ring: the request-latency ``quantile`` over the
+    rebuild window ``[t0, t1]`` minus the same-width window immediately
+    before it. None when either window lacks data (no sampler, no
+    traffic, or a rebuild faster than two sample periods) — an absent
+    measurement must read as absent, not as zero impact."""
+    dur = float(t1_unix) - float(t0_unix)
+    if dur <= 0:
+        return None
+    during = history.quantile(hist_key, quantile, window_s=dur,
+                              now=t1_unix)
+    before = history.quantile(hist_key, quantile, window_s=dur,
+                              now=t0_unix)
+    if during is None or before is None:
+        return None
+    return {
+        "p99_before_ms": round(before * 1e3, 3),
+        "p99_during_ms": round(during * 1e3, 3),
+        "p99_delta_ms": round((during - before) * 1e3, 3),
+        "window_s": round(dur, 3),
+    }
 
 
 class _EpochState:
@@ -81,6 +127,9 @@ class _EpochState:
         order = np.argsort(flat_gid[valid], kind="stable")
         self.gid_sorted = flat_gid[valid][order].astype(np.int64)
         self.gid_pos = np.nonzero(valid)[0][order]
+        # both construction sites (engine bootstrap, rebuild thread) run
+        # OFF the engine lock — exactly where the scatter compiles belong
+        self.warm_write_dispatch()
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
         """Flat positions of main-tree ids (-1 where absent)."""
@@ -95,14 +144,43 @@ class _EpochState:
         """Tombstone flat rows in place on the device copy: +inf
         coordinates (never selected while real candidates remain) and
         -1 ids (the padding id every downstream mask already drops).
-        Async dispatch — no sync, safe under the engine lock."""
+        Async dispatch — no sync, safe under the engine lock.
+
+        The index vector pads up to a ``_MASK_PAD_BUCKETS`` rung by
+        repeating the first position (writing the same padding values
+        to the same row twice is a no-op), so the scatter cycles a
+        handful of compiled shapes — all pre-warmed off the lock by
+        :meth:`warm_write_dispatch` — instead of compiling a fresh
+        program (under the write lock!) for every distinct id count."""
         if not positions:
             return
         import jax.numpy as jnp
 
-        idx = jnp.asarray(np.array(positions, dtype=np.int32))  # kdt-lint: disable=KDT201 positions is a host-built int list (no device value); this packs it for the async .at[].set dispatch
+        arr = np.array(positions, dtype=np.int32)  # kdt-lint: disable=KDT201 positions is a host-built int list (no device value); packing it for the padded async scatter dispatch
+        bucket = _mask_bucket(arr.size)
+        if bucket > arr.size:
+            arr = np.concatenate(
+                [arr, np.full(bucket - arr.size, arr[0], dtype=np.int32)]
+            )
+        idx = jnp.asarray(arr)  # kdt-lint: disable=KDT201 positions is a host-built int list (no device value); this packs it for the async .at[].set dispatch
         self.masked_pts = self.masked_pts.at[idx].set(jnp.inf)
         self.masked_gid = self.masked_gid.at[idx].set(-1)
+
+    def warm_write_dispatch(self) -> None:
+        """Compile every mask-scatter shape this epoch can dispatch —
+        called from construction (bootstrap: main thread, pre-serving)
+        and from the rebuild thread (new epochs), both OFF the engine
+        lock. ``.at[].set`` results are discarded: warming must not
+        tombstone anything, and the functional update makes that free.
+        The write path then holds the lock for an async dispatch, never
+        a compile (the hold-budget contract the lockwatch-backed
+        regression test pins)."""
+        import jax.numpy as jnp
+
+        for bucket in _MASK_PAD_BUCKETS:
+            idx = jnp.asarray(np.zeros(bucket, dtype=np.int32))  # kdt-lint: disable=KDT201 host-built warmup index vector, off the lock and off the hot path
+            self.masked_pts.at[idx].set(jnp.inf)
+            self.masked_gid.at[idx].set(-1)
 
     def refresh_dead(self) -> None:
         self.dead_sorted = np.array(sorted(self.dead), dtype=np.int64)  # kdt-lint: disable=KDT201 self.dead is a host-side python set of ids, not a device value
@@ -197,6 +275,9 @@ class MutableEngine:
         self._g_tomb = reg.gauge("kdtree_mutable_tombstones")
         self._g_headroom = reg.gauge("kdtree_mutable_delta_headroom")
         self._update_gauges(self._state)
+        # construction runs before serving and outside the lock: the
+        # right moment to compile the overlay's correction dispatch
+        self._warm_overlay(self._state)
 
     # -- ServeEngine-compatible surface -------------------------------------
 
@@ -547,6 +628,7 @@ class MutableEngine:
 
     def _rebuild_worker(self, old: _EpochState, delta_pts: np.ndarray,
                         delta_ids: np.ndarray, dead: set) -> None:
+        t0_unix = time.time()
         try:
             with obs.span("mutable.rebuild", sync=False, epoch=old.epoch,
                           delta_rows=int(delta_ids.size),
@@ -570,6 +652,11 @@ class MutableEngine:
                         delta_rows=new_st.delta.rows,
                         tombstones=len(new_st.dead),
                     )
+            # rebuild-overlap serving impact, joined through the history
+            # ring AFTER the swap (off the lock, on this thread): how
+            # much did p99 move in windows overlapping the rebuild span?
+            self._note_rebuild_impact(old.epoch, new_st.epoch, t0_unix,
+                                      time.time())
             with self._lock:
                 # journal replay may have re-crossed the threshold (a
                 # write flood during the rebuild); evaluate once more
@@ -615,8 +702,59 @@ class MutableEngine:
         )
         new_inner = ServeEngine(new_tree, self._k_cfg)
         self._prewarm(new_inner)
-        return _EpochState(new_inner, epoch=old.epoch + 1,
-                           min_cap=self._min_cap)
+        new_st = _EpochState(new_inner, epoch=old.epoch + 1,
+                             min_cap=self._min_cap)
+        # overlay correction shapes compile HERE (rebuild thread, no
+        # lock), not on the first post-swap contaminated query
+        self._warm_overlay(new_st)
+        return new_st
+
+    def _warm_overlay(self, st: _EpochState) -> None:
+        """Compile the overlay's correction dispatch (the masked-storage
+        brute-force re-answer at its minimum pow2 bucket) off the
+        serving path. Results are discarded — this exists so the first
+        contaminated query after a delete, and the first write's mask
+        scatter (see :meth:`_EpochState.warm_write_dispatch`), run warm.
+        Never raises: warming observes the epoch, it must not fail its
+        construction."""
+        try:
+            import jax.numpy as jnp
+
+            from kdtree_tpu.ops import bruteforce
+
+            dim = st.inner.tree.dim
+            q = np.zeros((_CORRECTION_MIN_BUCKET, dim), dtype=np.float32)
+            kk = max(1, min(self._k_cfg, int(st.masked_pts.shape[0])))
+            bruteforce.knn(st.masked_pts, jnp.asarray(q), k=kk)
+        except Exception:
+            pass
+
+    def _note_rebuild_impact(self, old_epoch: int, new_epoch: int,
+                             t0_unix: float, t1_unix: float) -> None:
+        """Publish the rebuild window's p99 delta (gauge + flight event)
+        — runs on the rebuild thread, never raises (the measurement
+        observes the swap; it must not undo one that already landed)."""
+        try:
+            from kdtree_tpu.obs import history as obs_history
+
+            impact = rebuild_impact(obs_history.get_history(), t0_unix,
+                                    t1_unix)
+            if impact is not None:
+                # registered LAZILY, only once a delta was measured: a
+                # gauge that exports 0 before any rebuild ever ran would
+                # read as "measured, no impact" on every scrape
+                obs.get_registry().gauge(
+                    "kdtree_mutable_rebuild_p99_delta_ms"
+                ).set(impact["p99_delta_ms"])
+            flight.record(
+                "mutable.rebuild_impact", epoch=new_epoch,
+                previous_epoch=old_epoch,
+                duration_ms=round((t1_unix - t0_unix) * 1e3, 3),
+                **(impact if impact is not None
+                   else {"p99_delta_ms": None}),
+            )
+        except Exception:
+            pass
 
     def _prewarm(self, inner) -> None:
         """Compile the new epoch's batch shapes BEFORE the swap (same

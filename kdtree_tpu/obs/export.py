@@ -255,6 +255,16 @@ METRIC_HELP = {
     "kdtree_mutable_corrections_total":
         "query rows re-answered over masked flat storage because a "
         "tombstoned id sat inside their main top-k",
+    "kdtree_write_latency_ms":
+        "mutable-index write apply latency by op (upsert/delete), "
+        "engine-lock wait included — the load harness's write-path "
+        "timing",
+    "kdtree_mutable_rebuild_p99_delta_ms":
+        "request-p99 delta (ms) of the last epoch-rebuild window vs "
+        "the same-width window before it (history-ring join)",
+    "kdtree_loadgen_offered_rate":
+        "open-loop offered rate (req/s) the load generator most "
+        "recently declared via X-Loadgen-Rate",
     # SLOs + metric history (docs/OBSERVABILITY.md "SLOs & burn rates")
     "kdtree_slo_state":
         "SLO state by spec: 0 OK, 1 WARN, 2 PAGE (multi-window burn rate)",
@@ -370,6 +380,47 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _capacity_lines(cap: Dict) -> list:
+    """Human rendering of a loadgen ``capacity`` block (shared by
+    ``stats`` and ``stats --diff`` so the two views cannot drift)."""
+    out = ["== capacity (open-loop load harness) =="]
+    knee = cap.get("knee_rate")
+    knee_s = "?" if knee is None else f"{knee:g}"
+    out.append(
+        f"knee rate:           {knee_s} req/s  "
+        f"(p{int(cap.get('slo_quantile', 0.99) * 100)} <= "
+        f"{cap.get('slo_ms', 0):g} ms, bad <= "
+        f"{cap.get('max_bad_frac', 0):.0%})"
+    )
+    steps = cap.get("steps") or []
+    if steps:
+        out.append(f"{'rate':>8s}  {'sent':>6s}  {'goodput':>8s}  "
+                   f"{'p50':>8s}  {'p95':>8s}  {'p99':>8s}  "
+                   f"{'shed':>6s}  {'bad':>6s}")
+        for s in steps:
+            def ms(key, s=s):
+                v = s.get(key)
+                return f"{v:.1f}ms" if v is not None else "-"
+
+            out.append(
+                f"{s.get('rate', 0):>8g}  {s.get('sent', 0):>6d}  "
+                f"{s.get('goodput_rps', 0):>8g}  {ms('p50_ms'):>8s}  "
+                f"{ms('p95_ms'):>8s}  {ms('p99_ms'):>8s}  "
+                f"{(s.get('shed_frac') or 0):>6.1%}  "
+                f"{(s.get('bad_frac') or 0):>6.1%}"
+            )
+    server = cap.get("server")
+    if server:
+        for op, stats in (server.get("write_latency_ms") or {}).items():
+            out.append(f"write {op:<7s}       n={stats['count']} "
+                       f"mean={stats['mean_ms']:g}ms")
+        delta = server.get("rebuild_p99_delta_ms")
+        if delta is not None:
+            out.append(f"rebuild p99 delta:   {delta:+g} ms "
+                       f"(epoch {server.get('epoch')})")
+    return out
+
+
 def render_report(rep: Dict) -> str:
     """Human-readable rendering of a report dict (the ``stats``
     subcommand). Leads with the run facts that decide whether the numbers
@@ -433,6 +484,10 @@ def render_report(rep: Dict) -> str:
         width = max(len(k) for k in g)
         for key in sorted(g):
             out.append(f"{key:<{width}}  {g[key]:g}")
+
+    if isinstance(rep.get("capacity"), dict):
+        out.append("")
+        out.extend(_capacity_lines(rep["capacity"]))
 
     hists = {
         k: v for k, v in rep.get("histograms", {}).items()
@@ -563,4 +618,33 @@ def render_report_diff(old: Dict, new: Dict) -> str:
         width = max(len(k) for k, _, _ in moved)
         for key, ov, nv in moved:
             out.append(f"{key:{width}s}  {ov:14g}  {nv:14g}")
+
+    ocap, ncap = old.get("capacity"), new.get("capacity")
+    if isinstance(ocap, dict) or isinstance(ncap, dict):
+        out.append("")
+        out.append("== capacity (knee + per-rate p99) ==")
+        oknee = (ocap or {}).get("knee_rate")
+        nknee = (ncap or {}).get("knee_rate")
+        delta = (_fmt_delta(oknee, nknee)
+                 if oknee is not None and nknee is not None
+                 else ("gone" if oknee is not None else "new"))
+        out.append(
+            f"{'knee rate (req/s)':20s}  "
+            f"{oknee if oknee is not None else float('nan'):>14g}  "
+            f"{nknee if nknee is not None else float('nan'):>14g}  "
+            f"{delta}"
+        )
+        osteps = {s.get("rate"): s for s in (ocap or {}).get("steps") or []}
+        nsteps = {s.get("rate"): s for s in (ncap or {}).get("steps") or []}
+        for rate in sorted(set(osteps) | set(nsteps)):
+            op99 = (osteps.get(rate) or {}).get("p99_ms")
+            np99 = (nsteps.get(rate) or {}).get("p99_ms")
+            delta = (_fmt_delta(op99, np99)
+                     if op99 is not None and np99 is not None else "")
+            out.append(
+                f"{f'p99 @ {rate:g} req/s':20s}  "
+                f"{op99 if op99 is not None else float('nan'):>12.1f}ms  "
+                f"{np99 if np99 is not None else float('nan'):>12.1f}ms  "
+                f"{delta}"
+            )
     return "\n".join(out) + "\n"

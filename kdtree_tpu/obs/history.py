@@ -164,13 +164,19 @@ class MetricHistory:
         self, window_s: Optional[float] = None, now: Optional[float] = None,
     ) -> List[dict]:
         """Copy of the ring, oldest first; ``window_s`` keeps only
-        samples with ``ts >= now - window_s``."""
+        samples with ``now - window_s <= ts <= now``. The upper bound
+        matters for retrospective windows (the rebuild-impact join asks
+        for "the window ENDING at t0" after t1 has already been
+        sampled): without it, every windowed query silently extended to
+        the newest sample and a "before the incident" window included
+        the incident."""
         with self._lock:
             out = list(self._ring)
         if window_s is None:
             return out
-        cutoff = (time.time() if now is None else now) - float(window_s)
-        return [s for s in out if s["ts"] >= cutoff]
+        end = time.time() if now is None else float(now)
+        cutoff = end - float(window_s)
+        return [s for s in out if cutoff <= s["ts"] <= end]
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -14,7 +14,14 @@ series of bench artifacts and flags exactly that class of silent decay:
   bench.py's contract since r2), extra metrics only where their
   platform-stripped names match;
 - **recompile-growth**: a timed section's ``recompiles`` count growing
-  (a warm steady state must hold it flat — growth means shape churn).
+  (a warm steady state must hold it flat — growth means shape churn);
+- **capacity-drop**: the load harness's knee rate (the highest offered
+  rate still meeting the latency SLO — ``kdtree-tpu loadgen``,
+  docs/OBSERVABILITY.md "Load harness & capacity curves") falling
+  beyond the band vs the *previous capacity-bearing* run. Capacity
+  blocks are schema-versioned and optional: a series mixing plain
+  bench sidecars with loadgen reports compares capacity only where it
+  was measured — old artifacts parse exactly as before.
 
 The noise band is fitted from ``--pair`` runs when any input carries a
 ``pair_first`` block (two same-process passes bound the run-to-run
@@ -48,6 +55,7 @@ DEFAULT_BAND = 0.5  # container CPU noise is +-40% (bench.py --pair docs)
 _PLATFORM_TOKENS = {"cpu", "tpu", "gpu", "axon", "cuda", "rocm", "metal"}
 _RATE_UNITS = {"pts/s", "q/s"}
 HEADLINE_KEY = "headline"
+KNOWN_CAPACITY_VERSIONS = (1,)
 
 
 # --------------------------------------------------------------------------
@@ -139,12 +147,38 @@ def _from_headline(headline: dict, label: str, path: str) -> dict:
         "metrics": metrics,
         "pair_spread": None,
         "passes": 1,
+        "capacity": None,
     }
     pair = headline.get("pair_first")
     if isinstance(pair, dict):
         run["pair_spread"] = _pair_spread(headline, pair)
         run["passes"] = 2
     return run
+
+
+def _capacity_facts(cap) -> Optional[dict]:
+    """Distill a ``capacity`` block to what the trend scan compares.
+    Tolerant by design: None for absent/unversioned/unknown-version
+    blocks (a future schema must degrade to 'not comparable', never to
+    a crash on old trend code)."""
+    if not isinstance(cap, dict):
+        return None
+    if cap.get("capacity_version") not in KNOWN_CAPACITY_VERSIONS:
+        return None
+    knee = cap.get("knee_rate")
+    try:
+        knee = None if knee is None else float(knee)
+    except (TypeError, ValueError):
+        return None
+    steps = []
+    for s in cap.get("steps") or []:
+        if not isinstance(s, dict) or "rate" not in s:
+            continue
+        steps.append({"rate": float(s["rate"]),
+                      "p99_ms": s.get("p99_ms"),
+                      "goodput_rps": s.get("goodput_rps")})
+    return {"knee_rate": knee, "steps": steps,
+            "slo_ms": cap.get("slo_ms")}
 
 
 def load_run(path: str) -> dict:
@@ -161,7 +195,8 @@ def load_run(path: str) -> dict:
             label = f"r{data['n']:02d}"
         return _from_headline(data["parsed"], label, path)
     if "headline" in data and "counters" in data:
-        # telemetry sidecar: headline block + top-level run facts
+        # telemetry sidecar: headline block + top-level run facts (a
+        # loadgen sidecar additionally carries a capacity block)
         head = dict(data["headline"])
         head.setdefault("platform", data.get("platform"))
         head.setdefault("degraded", data.get("degraded"))
@@ -169,12 +204,29 @@ def load_run(path: str) -> dict:
             head["pair_first"] = data["pair_first"]
         run = _from_headline(head, label, path)
         run["passes"] = int(data.get("passes", run["passes"]) or 1)
+        run["capacity"] = _capacity_facts(data.get("capacity"))
         return run
     if "metric" in data and "value" in data:
         return _from_headline(data, label, path)
+    if isinstance(data.get("capacity"), dict):
+        # a standalone loadgen report (or a sidecar from a run with no
+        # bench headline): capacity-only — it has no cross-round
+        # throughput series, only the curve. An unknown future
+        # capacity_version still parses (capacity = not comparable);
+        # forward-compat must degrade to silence, never to a crash.
+        return {
+            "label": label,
+            "path": path,
+            "platform": "unknown",
+            "degraded": False,
+            "metrics": {},
+            "pair_spread": None,
+            "passes": 1,
+            "capacity": _capacity_facts(data["capacity"]),
+        }
     raise ValueError(
-        f"{path}: not a bench headline, driver BENCH_r*.json, or bench "
-        "telemetry sidecar"
+        f"{path}: not a bench headline, driver BENCH_r*.json, bench "
+        "telemetry sidecar, or loadgen capacity report"
     )
 
 
@@ -249,6 +301,27 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     f"recompiles in the timed section grew {pr:g} -> "
                     f"{cr:g} (a warm steady state holds this flat)",
                 ))
+    # capacity blocks compare against the PREVIOUS capacity-bearing run
+    # (not strictly-consecutive: a series legitimately interleaves plain
+    # bench sidecars, which carry no curve, with loadgen reports)
+    prev_cap = None
+    for cur in runs:
+        cap = cur.get("capacity")
+        if not cap:
+            continue
+        if prev_cap is not None:
+            pknee = prev_cap[1].get("knee_rate")
+            cknee = cap.get("knee_rate")
+            if pknee and pknee > 0 and cknee is not None and \
+                    (pknee - cknee) / pknee > used:
+                findings.append(_finding(
+                    "capacity-drop", "capacity:knee", prev_cap[0], cur,
+                    f"knee rate {pknee:g} -> {cknee:g} req/s "
+                    f"{_fmt_delta(pknee, cknee)} (band {used:.0%}): the "
+                    "service meets its latency SLO at a lower offered "
+                    "load than it used to",
+                ))
+        prev_cap = (cur, cap)
     return findings, used
 
 
@@ -299,12 +372,25 @@ def render_human(runs: List[dict], findings: List[dict],
     out.append("== runs ==")
     width = max(len(r["label"]) for r in runs)
     for r in runs:
-        head = r["metrics"][HEADLINE_KEY]
+        head = r["metrics"].get(HEADLINE_KEY)
         deg = (f"  DEGRADED: {r['degraded']}" if r["degraded"] else "")
         pair = "  (pair)" if r.get("pair_spread") is not None else ""
+        cap = r.get("capacity")
+        if head is not None:
+            value = f"{head['value']:>14g} {head['unit']}"
+        elif cap is not None:
+            knee = cap.get("knee_rate")
+            value = (f"{'knee ':>9s}{knee:>5g} req/s" if knee is not None
+                     else f"{'capacity (no knee)':>14s}")
+        else:
+            value = f"{'-':>14s}"
+        capnote = ""
+        if head is not None and cap is not None and \
+                cap.get("knee_rate") is not None:
+            capnote = f"  (knee {cap['knee_rate']:g} req/s)"
         out.append(
             f"{r['label']:<{width}}  {r['platform']:<8}"
-            f"{head['value']:>14g} {head['unit']}{pair}{deg}"
+            f"{value}{capnote}{pair}{deg}"
         )
     out.append("")
     new_fps = {f["fingerprint"] for f in new}
@@ -330,9 +416,18 @@ def render_json(runs: List[dict], findings: List[dict],
                 "label": r["label"],
                 "platform": r["platform"],
                 "degraded": r["degraded"],
-                "headline_value": r["metrics"][HEADLINE_KEY]["value"],
-                "headline_unit": r["metrics"][HEADLINE_KEY]["unit"],
+                "headline_value": (
+                    r["metrics"][HEADLINE_KEY]["value"]
+                    if HEADLINE_KEY in r["metrics"] else None
+                ),
+                "headline_unit": (
+                    r["metrics"][HEADLINE_KEY]["unit"]
+                    if HEADLINE_KEY in r["metrics"] else None
+                ),
                 "passes": r["passes"],
+                "capacity_knee": (
+                    (r.get("capacity") or {}).get("knee_rate")
+                ),
             }
             for r in runs
         ],

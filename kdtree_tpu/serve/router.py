@@ -407,6 +407,10 @@ class RouterHandler(JsonRequestHandler):
         if path not in ("/v1/knn", "/v1/upsert", "/v1/delete"):
             self._send_json(404, {"error": f"no such path: {path}"})
             return
+        # the router is an SLO-paging front a loadgen run can target:
+        # mirror the declared offered rate here too, so a router-side
+        # PAGE dump names it (shared helper on JsonRequestHandler)
+        self._note_offered_rate()
         trace = _trace_id(self.headers)
         try:
             length = int(self.headers.get("Content-Length", ""))
@@ -491,6 +495,9 @@ class Router(GracefulHTTPServer):
         self._health_thread: Optional[threading.Thread] = None
         self._sampler = None
         self._stopping = threading.Event()
+        # the most recent X-Loadgen-Rate a client declared (see
+        # JsonRequestHandler._note_offered_rate)
+        self.loadgen_rate: Optional[float] = None
 
     # -- telemetry plumbing --------------------------------------------------
 

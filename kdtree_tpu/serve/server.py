@@ -92,6 +92,15 @@ MAX_PROFILE_SECONDS = 60.0  # /debug/profile window cap
 DEFAULT_PROFILE_SECONDS = 3.0
 MAX_WRITE_IDS = 4096  # rows per upsert/delete request (split larger)
 
+# write-path apply latency buckets (milliseconds): healthy masked-write
+# applies sit in the sub-10ms range; the 250-1000ms tail is where a cold
+# compile under the write lock used to hide (docs/OBSERVABILITY.md
+# "Load harness & capacity curves")
+_WRITE_LATENCY_BUCKETS_MS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0,
+)
+
 _TRACE_ID_BAD = re.compile(r"[^A-Za-z0-9._-]")
 
 
@@ -173,6 +182,30 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         """``GET /debug/flight``: the live ring, no file involved — same
         payload shape as a SIGUSR2 dump so one reader handles both."""
         self._send_json(200, flight.recorder().report("debug-endpoint"))
+
+    def _note_offered_rate(self) -> None:
+        """Mirror the load generator's ``X-Loadgen-Rate`` header into a
+        gauge + (on change) a flight event, so an SLO PAGE that fires
+        mid-run names the offered rate in its incident dump — the
+        loadgen/ring integration half of docs/OBSERVABILITY.md "Load
+        harness & capacity curves". Shared by the shard server AND the
+        router (both are SLO-paging fronts a loadgen run can target).
+        One header read per request; nothing happens for ordinary
+        traffic."""
+        raw = self.headers.get("X-Loadgen-Rate")
+        if not raw:
+            return
+        try:
+            rate = float(raw)
+        except ValueError:
+            return
+        if rate != getattr(self.server, "loadgen_rate", None):
+            # benign last-writer-wins race: the gauge and the ring both
+            # want "the rate the client most recently declared"
+            self.server.loadgen_rate = rate
+            obs.get_registry().gauge("kdtree_loadgen_offered_rate").set(
+                rate)
+            flight.record("loadgen.rate", rate=rate)
 
     def _read_json_object(self, max_bytes: int = MAX_BODY_BYTES):
         """Read + parse one JSON-object request body, or None with the
@@ -327,6 +360,7 @@ class KnnRequestHandler(JsonRequestHandler):
 
     def do_POST(self) -> None:
         path = self.path.split("?", 1)[0]
+        self._note_offered_rate()
         if path == "/debug/profile":
             self._do_debug_profile()
             return
@@ -563,6 +597,9 @@ class KnnRequestHandler(JsonRequestHandler):
                 self._send_json(400, {"error": "points contain non-finite "
                                                "values"})
                 return
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             if op == "upsert":
                 res = engine.upsert(local, points)
@@ -574,6 +611,12 @@ class KnnRequestHandler(JsonRequestHandler):
         except RuntimeError as e:
             self._send_json(503, {"error": str(e), "trace_id": trace})
             return
+        # the write path is TIMED (PR 10's open note: mutation throughput
+        # was measured only for correctness): apply duration includes the
+        # engine-lock wait, so lock-held compiles and rebuild-swap
+        # contention show up here, not only in a profiler capture
+        apply_ms = (_time.perf_counter() - t0) * 1e3
+        self.server.write_latency[op].observe(apply_ms)
         flight.record("serve.write", op=op, trace=trace,
                       ids=len(ids), applied=res["applied"],
                       delta_rows=res["delta_rows"], epoch=res["epoch"])
@@ -738,6 +781,18 @@ class KnnServer(GracefulHTTPServer):
         )
         self._sampler: Optional[obs_history.Sampler] = None
         self._serve_thread: Optional[threading.Thread] = None
+        # write-path apply latency, by op — bound once (registry lookups
+        # are two dict hits, but writes can arrive at load-harness rates)
+        reg = obs.get_registry()
+        self.write_latency = {
+            op: reg.histogram("kdtree_write_latency_ms",
+                              buckets=_WRITE_LATENCY_BUCKETS_MS,
+                              labels={"op": op})
+            for op in ("upsert", "delete")
+        }
+        # the most recent X-Loadgen-Rate a client declared (None until a
+        # load-harness run shows up); see _note_offered_rate
+        self.loadgen_rate: Optional[float] = None
 
     def _slo_tick(self) -> None:
         eng = self.state.slo_engine

@@ -1019,6 +1019,98 @@ def cmd_route(args) -> None:
     print("drained; bye", file=sys.stderr)
 
 
+def cmd_loadgen(args) -> None:
+    """Open-loop production load harness (docs/OBSERVABILITY.md "Load
+    harness & capacity curves"): drive a live serve/route process with
+    seeded Poisson arrivals at a rate ladder and a query/upsert/delete
+    mix, measure latency from INTENDED send times (coordinated omission
+    cannot hide queueing), and emit a capacity block — per-step
+    quantiles, goodput, shed/degraded fractions, and the knee rate —
+    that ``kdtree-tpu trend`` diffs across rounds."""
+    from kdtree_tpu.loadgen import runner as lg_runner
+    from kdtree_tpu.loadgen import schedule as lg_schedule
+    from kdtree_tpu.obs.export import _capacity_lines
+
+    try:
+        rates = [float(x) for x in args.rates.split(",") if x.strip()]
+    except ValueError:
+        print(f"--rates must be a comma-separated number list, got "
+              f"{args.rates!r}", file=sys.stderr)
+        sys.exit(1)
+    if not rates or any(r <= 0 for r in rates):
+        print(f"--rates values must be positive, got {args.rates!r}",
+              file=sys.stderr)
+        sys.exit(1)
+    try:
+        mix = lg_schedule.parse_mix(args.mix)
+    except ValueError as e:
+        print(f"bad --mix: {e}", file=sys.stderr)
+        sys.exit(1)
+    if round(args.slo_quantile, 4) not in (0.5, 0.95, 0.99):
+        # fail BEFORE the sweep runs: the knee must be judged at a
+        # quantile the steps actually report, never silently at p99
+        print(f"--slo-quantile must be 0.5, 0.95, or 0.99 (the reported "
+              f"step quantiles), got {args.slo_quantile}",
+              file=sys.stderr)
+        sys.exit(1)
+    try:
+        facts = lg_runner.discover(args.target,
+                                   retries=args.ready_retries)
+    except (RuntimeError, ValueError) as e:
+        print(f"cannot reach target: {e}", file=sys.stderr)
+        sys.exit(1)
+    dim = args.dim if args.dim is not None else facts["dim"]
+    k = min(args.k, facts["k_max"])
+    write_base = (args.write_base if args.write_base is not None
+                  else facts["write_base"])
+    try:
+        sched = lg_schedule.build_schedule(
+            rates, args.step_seconds, args.seed, dim, mix=mix,
+            regions=args.regions, zipf_s=args.zipf_s, shape=args.shape,
+            diurnal_amp=args.diurnal_amp, write_base=write_base,
+        )
+    except ValueError as e:
+        print(f"cannot build schedule: {e}", file=sys.stderr)
+        sys.exit(1)
+    desc = sched.describe()
+    print(f"loadgen: target {args.target} (n={facts['n']}, dim={dim}, "
+          f"k={k}); {desc['arrivals']} arrivals over "
+          f"{sched.duration_s:g}s, mix {desc['ops']}, seed {args.seed}",
+          file=sys.stderr)
+
+    def on_step(step, rate):
+        print(f"  step {step}: offering {rate:g} req/s for "
+              f"{args.step_seconds:g}s", file=sys.stderr)
+
+    report = lg_runner.run_load(
+        args.target, sched, k=k, slo_ms=args.slo_ms,
+        slo_quantile=args.slo_quantile, max_bad_frac=args.max_bad_frac,
+        max_inflight=args.max_inflight, timeout_s=args.timeout_ms / 1e3,
+        on_step=on_step,
+    )
+    cap = report["capacity"]
+    if args.out:
+        import os
+
+        tmp = f"{args.out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.out)
+        print(f"capacity report written to {args.out}", file=sys.stderr)
+    # the telemetry sidecar (--metrics-out) carries the same capacity
+    # block, so one artifact is a self-contained trend input
+    args._telemetry_extra = {"capacity": cap}
+    print("\n".join(_capacity_lines(cap)), file=sys.stderr)
+    print(json.dumps({
+        "knee_rate": cap["knee_rate"],
+        "slo_ms": cap["slo_ms"],
+        "steps": len(cap["steps"]),
+        "arrivals": desc["arrivals"],
+        "out": args.out,
+    }))
+
+
 def _load_report(path: str) -> dict:
     """Load + validate one --metrics-out telemetry report (shared by
     ``stats`` and ``stats --diff`` so both reject garbage identically)."""
@@ -1512,6 +1604,75 @@ def main(argv=None) -> None:
                     help="per-shard /healthz poll period for ejection")
     ro.set_defaults(fn=cmd_route)
 
+    lg = sub.add_parser(
+        "loadgen",
+        help="open-loop production load harness: seeded Poisson "
+             "arrivals at a rate ladder with a query/upsert/delete "
+             "mix against a live serve/route process; emits a "
+             "capacity block (latency-vs-offered-load curve + knee) "
+             "the trend gate diffs (docs/OBSERVABILITY.md)",
+    )
+    lg.add_argument("--target", required=True, metavar="URL",
+                    help="base url of a live serve or route process "
+                         "(http://host:port)")
+    lg.add_argument("--rates", required=True, metavar="R1,R2,...",
+                    help="offered-rate ladder in requests/sec, one "
+                         "capacity curve point per step")
+    lg.add_argument("--step-seconds", type=float, default=10.0,
+                    help="how long each ladder step offers its rate")
+    lg.add_argument("--mix", default="query:0.9,upsert:0.08,delete:0.02",
+                    help="op mix weights (normalized); deletes target "
+                         "ids upserted earlier in the schedule")
+    lg.add_argument("--seed", type=int, default=42,
+                    help="schedule seed: same seed = identical arrival "
+                         "times, ops, and payloads")
+    lg.add_argument("--k", type=int, default=4,
+                    help="neighbors per query (clamped to the target's "
+                         "k_max)")
+    lg.add_argument("--shape", choices=["steps", "diurnal"],
+                    default="steps",
+                    help="steps = flat rate per rung; diurnal = "
+                         "sinusoidally modulated within each rung "
+                         "(Lewis-Shedler thinning, still seeded)")
+    lg.add_argument("--diurnal-amp", type=float, default=0.3,
+                    help="diurnal modulation amplitude in [0, 1)")
+    lg.add_argument("--regions", type=int, default=64,
+                    help="spatial regions the Zipf query skew draws "
+                         "over")
+    lg.add_argument("--zipf-s", type=float, default=1.1,
+                    help="Zipf exponent of the region skew (higher = "
+                         "hotter hot spots)")
+    lg.add_argument("--slo-ms", type=float, default=250.0,
+                    help="latency SLO bound the knee is judged against "
+                         "(matches the serving request-p99 SLO)")
+    lg.add_argument("--slo-quantile", type=float, default=0.99,
+                    help="which intended-latency quantile must meet "
+                         "--slo-ms (0.5/0.95/0.99)")
+    lg.add_argument("--max-bad-frac", type=float, default=0.05,
+                    help="max (shed+error+timeout)/sent fraction a "
+                         "step may have and still count toward the "
+                         "knee")
+    lg.add_argument("--max-inflight", type=int, default=64,
+                    help="client worker pool size; arrivals beyond it "
+                         "queue client-side but latency is measured "
+                         "from INTENDED send time either way")
+    lg.add_argument("--timeout-ms", type=float, default=10000.0,
+                    help="per-request client timeout")
+    lg.add_argument("--dim", type=int, default=None,
+                    help="query dimensionality (default: discovered "
+                         "from the target's /healthz)")
+    lg.add_argument("--write-base", type=int, default=None,
+                    help="first id upserts mint (default: past the "
+                         "target's served id range, from /healthz)")
+    lg.add_argument("--ready-retries", type=int, default=60,
+                    help="how many times to poll /healthz for "
+                         "readiness before giving up")
+    lg.add_argument("--out", default="loadgen_report.json",
+                    metavar="FILE",
+                    help="standalone capacity report artifact (a "
+                         "kdtree-tpu trend input); '' disables")
+    lg.set_defaults(fn=cmd_loadgen)
+
     st = sub.add_parser(
         "stats", help="render a --metrics-out telemetry report "
                       "(--diff OLD NEW compares two)"
@@ -1677,7 +1838,10 @@ def main(argv=None) -> None:
             from kdtree_tpu import obs
 
             try:
-                obs.finalize()
+                # a subcommand can attach top-level report facts (e.g.
+                # loadgen's capacity block) by setting _telemetry_extra
+                obs.finalize(extra=getattr(args, "_telemetry_extra",
+                                           None))
             except OSError as e:
                 print(f"cannot write telemetry report {metrics_out}: {e}",
                       file=sys.stderr)

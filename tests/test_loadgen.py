@@ -1,0 +1,371 @@
+"""Load harness (kdtree_tpu/loadgen/, docs/OBSERVABILITY.md "Load
+harness & capacity curves").
+
+The contract under test is open-loop honesty: the schedule is a pure
+function of the seed (same seed = identical arrivals, ops, payloads),
+arrivals fire on schedule no matter how slowly the service answers
+(coordinated omission structurally impossible), latency is measured
+from intended send times, and the capacity block's knee verdict moves
+when — and only when — the service genuinely slows. The e2e half pins
+the acceptance flow: a live serve process, a mixed read/write ladder,
+server-side write-latency evidence in the block, and a latency fault
+measurably lowering the knee while the schedule stays byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kdtree_tpu.loadgen import build_schedule
+from kdtree_tpu.loadgen import runner as lg_runner
+from kdtree_tpu.loadgen.schedule import MixSpec, parse_mix
+
+# ---------------------------------------------------------------------------
+# schedule determinism (satellite: same seed => identical schedule)
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_same_schedule_different_seed_differs():
+    a = build_schedule([20, 40], 1.0, 7, 3)
+    b = build_schedule([20, 40], 1.0, 7, 3)
+    assert a.keys() == b.keys()
+    c = build_schedule([20, 40], 1.0, 8, 3)
+    assert a.keys() != c.keys()
+
+
+def test_diurnal_shape_is_seeded_and_modulated():
+    a = build_schedule([200], 2.0, 5, 2, shape="diurnal",
+                       diurnal_amp=0.5)
+    b = build_schedule([200], 2.0, 5, 2, shape="diurnal",
+                       diurnal_amp=0.5)
+    assert a.keys() == b.keys()
+    # first half-period modulates UP (sin > 0), second half DOWN — the
+    # arrival mass must tilt toward the first half
+    first = sum(1 for ar in a.arrivals if ar.t < 1.0)
+    assert first > len(a.arrivals) - first
+
+
+def test_mix_fractions_and_zipf_skew():
+    sched = build_schedule([500], 4.0, 3, 3,
+                           mix=MixSpec(0.7, 0.2, 0.1), regions=16)
+    ops = Counter(ar.op for ar in sched.arrivals)
+    total = sum(ops.values())
+    assert abs(ops["query"] / total - 0.7) < 0.1
+    assert ops["upsert"] > ops["delete"] > 0
+    # Zipf skew: the hottest region must absorb far more than the
+    # uniform share of queries (regions=16 -> uniform ~6%)
+    centers = np.random.default_rng(3).random((16, 3))
+    hits = Counter()
+    for ar in sched.arrivals:
+        if ar.op == "query":
+            hits[int(np.argmin(
+                np.linalg.norm(centers - ar.point, axis=1)))] += 1
+    top = max(hits.values())
+    assert top / sum(hits.values()) > 0.15
+
+
+def test_deletes_only_target_earlier_upserts():
+    sched = build_schedule([300], 4.0, 9, 3,
+                           mix=MixSpec(0.4, 0.3, 0.3), write_base=1000)
+    live = set()
+    deletes = 0
+    for ar in sched.arrivals:
+        if ar.op == "upsert":
+            assert ar.gid >= 1000
+            live.add(ar.gid)
+        elif ar.op == "delete":
+            deletes += 1
+            assert ar.gid in live, "delete targets an id never upserted"
+            live.remove(ar.gid)
+    assert deletes > 0
+
+
+def test_schedule_and_mix_validation():
+    with pytest.raises(ValueError):
+        build_schedule([], 1.0, 1, 3)
+    with pytest.raises(ValueError):
+        build_schedule([10, -1], 1.0, 1, 3)
+    with pytest.raises(ValueError):
+        build_schedule([10], 1.0, 1, 3, shape="sawtooth")
+    with pytest.raises(ValueError):
+        parse_mix("query:0.5,upsrt:0.5")
+    with pytest.raises(ValueError):
+        parse_mix("query:nope")
+    with pytest.raises(ValueError):
+        MixSpec(0.0, 0.0, 0.0)
+    m = parse_mix("query:3,upsert:1")
+    assert abs(m.query - 0.75) < 1e-12 and m.delete == 0.0
+
+
+# ---------------------------------------------------------------------------
+# knee + scrape units
+# ---------------------------------------------------------------------------
+
+
+def test_compute_knee_picks_highest_passing_step():
+    steps = [
+        {"rate": 10, "sent": 20, "p50_ms": 20.0, "p99_ms": 50.0,
+         "bad_frac": 0.0},
+        {"rate": 20, "sent": 40, "p50_ms": 40.0, "p99_ms": 100.0,
+         "bad_frac": 0.01},
+        {"rate": 40, "sent": 80, "p50_ms": 300.0, "p99_ms": 400.0,
+         "bad_frac": 0.0},
+        {"rate": 80, "sent": 80, "p50_ms": 30.0, "p99_ms": 60.0,
+         "bad_frac": 0.5},
+    ]
+    assert lg_runner.compute_knee(steps, slo_ms=250) == 20.0
+    # every step violating -> measured zero capacity, not "no data"
+    assert lg_runner.compute_knee(steps, slo_ms=15) == 0.0
+    # the quantile knob selects which latency column is judged
+    assert lg_runner.compute_knee(steps, slo_ms=250,
+                                  slo_quantile=0.5) == 20.0
+
+
+def test_prom_scrape_parsing_sums_across_extra_labels():
+    text = "\n".join([
+        "# HELP kdtree_write_latency_ms x",
+        "# TYPE kdtree_write_latency_ms histogram",
+        'kdtree_write_latency_ms_count{op="upsert"} 5',
+        'kdtree_write_latency_ms_sum{op="upsert"} 10.0',
+        'kdtree_write_latency_ms_count{op="upsert",shard="1"} 3',
+        'kdtree_write_latency_ms_sum{op="upsert",shard="1"} 6.0',
+        "kdtree_epoch 2",
+    ])
+    parsed = lg_runner._parse_prom_lines(text)
+    assert lg_runner._sum_series(
+        parsed, "kdtree_write_latency_ms_count", 'op="upsert"') == 8
+    assert lg_runner._sum_series(parsed, "kdtree_epoch") == 2
+    assert lg_runner._sum_series(parsed, "kdtree_missing") is None
+
+
+# ---------------------------------------------------------------------------
+# open-loop independence against a scripted stub (no jax)
+# ---------------------------------------------------------------------------
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    sleep_s = 0.0
+    status = 200
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _answer(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/healthz"):
+            self._answer(200, {"status": "ok", "n": 100, "dim": 3,
+                               "k_max": 4, "id_offset": 0})
+        else:
+            self._answer(200, {})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        if type(self).sleep_s:
+            time.sleep(type(self).sleep_s)
+        code = type(self).status
+        if code != 200:
+            self._answer(code, {"error": "scripted"})
+            return
+        if self.path == "/v1/knn":
+            self._answer(200, {"ids": [[0]], "distances": [[0.0]],
+                               "degraded": None})
+        else:
+            self._answer(200, {"applied": 1})
+
+
+def _stub_server(sleep_s=0.0, status=200):
+    class Handler(_StubHandler):
+        pass
+
+    Handler.sleep_s = sleep_s
+    Handler.status = status
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_open_loop_arrivals_fire_on_schedule_despite_slow_service():
+    """The coordinated-omission pin: a service 150 ms slow per request
+    must neither delay the arrival schedule (send lag stays small) nor
+    hide its queueing (intended latency carries the full 150 ms+)."""
+    httpd, target = _stub_server(sleep_s=0.15)
+    try:
+        sched = build_schedule([20], 1.5, 11, 3, mix=MixSpec(1, 0, 0))
+        ref = build_schedule([20], 1.5, 11, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, max_inflight=32,
+                                 timeout_s=5.0, scrape=False)
+        # the schedule the runner replayed is the one built BEFORE the
+        # run — response latency cannot have touched it
+        assert sched.keys() == ref.keys()
+        step = rep["capacity"]["steps"][0]
+        assert step["sent"] == step["intended"] > 0
+        assert step["p50_ms"] >= 150.0
+        assert step["send_lag_p99_ms"] < 120.0, step
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_runner_classifies_shed_and_errors():
+    httpd, target = _stub_server(status=429)
+    try:
+        sched = build_schedule([30], 1.0, 2, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=False)
+        step = rep["capacity"]["steps"][0]
+        assert step["shed"] == step["sent"] > 0
+        assert rep["capacity"]["knee_rate"] == 0.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    httpd, target = _stub_server(status=500)
+    try:
+        sched = build_schedule([30], 1.0, 2, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=False)
+        step = rep["capacity"]["steps"][0]
+        assert step["errors"] == step["sent"] > 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_discover_reads_shard_and_router_shapes():
+    httpd, target = _stub_server()
+    try:
+        facts = lg_runner.discover(target, retries=3)
+        assert facts == {"dim": 3, "n": 100, "k_max": 4,
+                         "write_base": 100}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    class RouterStub(_StubHandler):
+        def do_GET(self):
+            self._answer(200, {"status": "ok", "shards": [
+                {"detail": {"dim": 3, "n": 50, "k_max": 4,
+                            "id_offset": 0}},
+                {"detail": {"dim": 3, "n": 70, "k_max": 8,
+                            "id_offset": 50}},
+            ]})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), RouterStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        facts = lg_runner.discover(
+            f"http://127.0.0.1:{httpd.server_address[1]}", retries=3)
+        assert facts == {"dim": 3, "n": 120, "k_max": 4,
+                         "write_base": 120}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: a real serve process, mixed load, fault-injected slowdown
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+    from kdtree_tpu.ops.morton import build_morton
+    from kdtree_tpu.serve import lifecycle, server as srv
+    from kdtree_tpu.serve.faults import FaultSet
+
+    tree = build_morton(generate_points_rowwise(7, 3, 4096))
+    state = lifecycle.build_state(tree=tree, k=4, max_batch=64,
+                                  max_delta_rows=1 << 20)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0,
+                            faults=FaultSet(""))
+    httpd.start(warmup_buckets=[8])
+    yield httpd
+    httpd.stop()
+
+
+def _target(httpd):
+    return f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_e2e_capacity_block_with_write_mix_and_fault_knee_drop(
+        live_server, tmp_path):
+    """The acceptance flow in-process: healthy ladder -> well-formed
+    capacity block with server-side write evidence and a knee; then the
+    SAME seed re-run under an injected latency fault -> identical
+    schedule (open loop), measurably lower knee, and `trend` flags a
+    NEW capacity-drop against the two reports."""
+    from kdtree_tpu import obs
+    from kdtree_tpu.obs import flight, trend as tr
+
+    target = _target(live_server)
+    facts = lg_runner.discover(target, retries=10)
+    assert facts["dim"] == 3 and facts["write_base"] >= 4096
+
+    def one_run(tag):
+        sched = build_schedule(
+            [25, 50, 100], 1.6, 13, facts["dim"],
+            mix=MixSpec(0.8, 0.15, 0.05),
+            write_base=facts["write_base"],
+        )
+        rep = lg_runner.run_load(target, sched, k=2, slo_ms=250.0,
+                                 timeout_s=10.0)
+        path = tmp_path / f"loadgen_{tag}.json"
+        path.write_text(json.dumps(rep))
+        return sched, rep, str(path)
+
+    sched_ok, rep_ok, path_ok = one_run("healthy")
+    cap = rep_ok["capacity"]
+    assert cap["capacity_version"] == 1
+    assert len(cap["steps"]) == 3
+    assert cap["knee_rate"] > 0.0
+    for step in cap["steps"]:
+        assert step["sent"] > 0
+        assert step["p99_ms"] is not None
+        assert step["writes_ok"] > 0 or step["rate"] == 25.0
+    # server-side write-path evidence made it into the block
+    server = cap["server"]
+    assert server is not None
+    assert server["write_latency_ms"]["upsert"]["count"] > 0
+    # the offered rate threaded through to the serving process: gauge
+    # set + a change-gated flight event per step (the SLO-PAGE dump
+    # names the offered rate through exactly this pair)
+    assert obs.get_registry().snapshot()["gauges"][
+        "kdtree_loadgen_offered_rate"] == 100.0
+    # the ring is bounded (older per-request events fall off under a
+    # few hundred requests), so assert presence, not per-step counts
+    kinds = Counter(e["type"] for e in flight.recorder().snapshot())
+    assert kinds["loadgen.knee"] >= 1
+    assert kinds["loadgen.rate"] >= 1 or kinds["loadgen.step"] >= 1
+
+    # inject the slowdown (fault layer latency clause), same seed
+    live_server.faults.set_spec("knn=latency:300")
+    try:
+        sched_slow, rep_slow, path_slow = one_run("slow")
+    finally:
+        live_server.faults.clear()
+    assert sched_slow.keys() == sched_ok.keys(), \
+        "response latency leaked into the arrival schedule"
+    assert rep_slow["capacity"]["knee_rate"] < cap["knee_rate"]
+
+    runs = [tr.load_run(path_ok), tr.load_run(path_slow)]
+    findings, _ = tr.analyze(runs, band=0.3)
+    rules = {f["rule"] for f in findings}
+    assert "capacity-drop" in rules, findings
+    # the committed baseline knows nothing about these labels -> NEW
+    base = tr.load_baseline("trend_baseline.json")
+    assert any(f["rule"] == "capacity-drop"
+               for f in tr.partition(findings, base))

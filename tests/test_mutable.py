@@ -732,3 +732,215 @@ def test_mutation_e2e_under_concurrent_load(base_points, queries):
     hz = json.loads(raw)
     assert hz["epoch"] == 1 and hz["mutable"]["delta_rows"] == 0
     httpd.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: the write path is timed, and its lock never holds a compile
+# ---------------------------------------------------------------------------
+
+
+def _hist_count(key):
+    snap = obs.get_registry().snapshot()["histograms"].get(key)
+    return 0 if snap is None else int(snap["count"])
+
+
+def test_http_writes_record_latency_histogram(mutable_server):
+    """kdtree_write_latency_ms{op=...} must grow with every applied
+    write — the load harness's server-side write-path evidence."""
+    up0 = _hist_count('kdtree_write_latency_ms{op="upsert"}')
+    de0 = _hist_count('kdtree_write_latency_ms{op="delete"}')
+    st, _ = _post(mutable_server, "/v1/upsert",
+                  {"ids": [9100], "points": [[7.0, 7.0, 7.0]]})
+    assert st == 200
+    st, _ = _post(mutable_server, "/v1/delete", {"ids": [9100]})
+    assert st == 200
+    assert _hist_count('kdtree_write_latency_ms{op="upsert"}') == up0 + 1
+    assert _hist_count('kdtree_write_latency_ms{op="delete"}') == de0 + 1
+    # and the family is on the live scrape (the loadgen runner's source)
+    st, raw = _get(mutable_server, "/metrics")
+    assert st == 200
+    assert 'kdtree_write_latency_ms_count{op="upsert"}' in raw
+
+
+def test_offered_rate_header_mirrors_into_gauge_and_ring(mutable_server):
+    """X-Loadgen-Rate -> gauge + change-gated flight event: the pair
+    that lets an SLO-PAGE dump name the offered rate mid-run."""
+    from kdtree_tpu.obs import flight
+
+    def post_with_rate(rate):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{mutable_server.server_address[1]}/v1/knn",
+            data=json.dumps({"queries": [[0.5, 0.5, 0.5]], "k": 1}
+                            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Loadgen-Rate": str(rate)},
+        )
+        with urllib.request.urlopen(req, timeout=60.0) as resp:
+            assert resp.status == 200
+
+    post_with_rate(37.5)
+    post_with_rate(37.5)  # unchanged: must NOT mint a second event
+    post_with_rate(75.0)
+    gauges = obs.get_registry().snapshot()["gauges"]
+    assert gauges["kdtree_loadgen_offered_rate"] == 75.0
+    rates = [e["rate"] for e in flight.recorder().snapshot()
+             if e["type"] == "loadgen.rate"]
+    assert rates.count(37.5) == 1 and rates.count(75.0) == 1
+
+
+def test_mask_bucket_ladder_and_padding_exactness(base_points, queries):
+    """Mask scatters pad to the pow2 rung by repeating a position —
+    idempotent, so answers stay byte-identical to the oracle while the
+    write path cycles exactly len(_MASK_PAD_BUCKETS) compiled shapes."""
+    from kdtree_tpu.mutable.engine import _MASK_PAD_BUCKETS, _mask_bucket
+
+    assert _mask_bucket(1) == _MASK_PAD_BUCKETS[0]
+    assert _mask_bucket(8) == 8
+    assert _mask_bucket(9) == 64
+    assert _mask_bucket(4096) == 4096
+    assert _mask_bucket(5000) == 8192  # pow2 fallback past the ladder
+    eng = fresh_engine(base_points)
+    model = {i: base_points[i] for i in range(N)}
+    # 3 masked positions pad to 8 with a repeated index: exactness must
+    # survive the duplicate scatter rows
+    ids = np.array([3, 5, 9])
+    eng.delete(ids)
+    for i in ids.tolist():
+        model.pop(i)
+    assert_exact(eng, model, queries, "padded mask scatter")
+    eng.close()
+
+
+def test_write_lock_hold_budget_met_under_lockwatch(monkeypatch,
+                                                    tmp_path):
+    """The PR 11 artifact's real finding, closed: the FIRST masked
+    write on a fresh engine used to compile the tombstone scatter
+    (~432 ms) INSIDE the write lock. The scatter shapes are now padded
+    to a fixed ladder and pre-warmed off the lock, so under the
+    runtime sanitizer a cold engine's first masked writes must leave
+    ZERO hold violations on mutable.engine. A distinct index size
+    keeps the scatter shape cold for this process — the compile
+    genuinely happens here, just not under the lock."""
+    from kdtree_tpu.analysis import lockwatch
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    monkeypatch.setenv(lockwatch.ENV_ENABLE, "1")
+    monkeypatch.setenv(lockwatch.ENV_DIR, str(tmp_path))
+    monkeypatch.setenv(lockwatch.ENV_HOLD_MS, "100")
+    monkeypatch.delenv(lockwatch.ENV_STRICT, raising=False)
+    w = lockwatch.watcher()
+    saved = w.export_state()
+    w.reset()
+    try:
+        pts = np.asarray(generate_points_rowwise(SEED, DIM, 300))
+        eng = fresh_engine(pts)
+        model = {i: pts[i] for i in range(300)}
+        qs = np.asarray(generate_points_rowwise(12, DIM, 4),
+                        dtype=np.float32)
+        # the historical trigger: upsert-of-existing-id (mask path) and
+        # a delete, both on a cold engine
+        moved = np.array([[9.0, 9.0, 9.0]], dtype=np.float32)
+        eng.upsert(np.array([3]), moved)
+        model[3] = moved[0]
+        eng.delete(np.array([5]))
+        model.pop(5)
+        assert_exact(eng, model, qs, "writes under lockwatch")
+        bad = [v for v in w.violations()
+               if v["lock"] == "mutable.engine"]
+        assert bad == [], f"write lock held past budget with I/O: {bad}"
+        eng.close()
+    finally:
+        w.reset()
+        w.merge_state(saved)
+
+
+def test_rebuild_impact_history_join():
+    """The epoch-rebuild p99 delta is a pure history-ring join: quantile
+    over the rebuild window minus the same-width window before it."""
+    from kdtree_tpu.mutable.engine import (
+        _REQUEST_LATENCY_KEY,
+        rebuild_impact,
+    )
+    from kdtree_tpu.obs.history import MetricHistory
+
+    key = _REQUEST_LATENCY_KEY
+
+    def hist(fast, slow):
+        return {key: {
+            "count": fast + slow, "sum": fast * 0.01 + slow * 0.5,
+            "buckets": {"0.025": fast, "1.0": fast + slow,
+                        "+Inf": fast + slow},
+        }}
+
+    h = MetricHistory(capacity=16)
+    h.record({"histograms": hist(0, 0)}, ts=0.0)
+    h.record({"histograms": hist(100, 0)}, ts=10.0)   # calm before
+    h.record({"histograms": hist(100, 100)}, ts=20.0)  # burn during
+    # a LATER sample must not leak into either window: samples() now
+    # applies the upper bound too, else the "before" window silently
+    # extended to the newest sample and included the rebuild itself
+    h.record({"histograms": hist(100, 600)}, ts=30.0)
+    impact = rebuild_impact(h, 10.0, 20.0)
+    assert impact is not None
+    assert impact["p99_during_ms"] > impact["p99_before_ms"]
+    assert impact["p99_delta_ms"] > 0
+    assert impact["window_s"] == 10.0
+    # a window the ring cannot cover reads as absent, never as zero
+    assert rebuild_impact(h, 100.0, 110.0) is None
+    assert rebuild_impact(h, 20.0, 20.0) is None
+
+
+def test_rebuild_records_impact_flight_event(base_points, queries):
+    """An epoch rebuild leaves a mutable.rebuild_impact event naming
+    the swap window, even when the ring had no latency data (nulls,
+    not silence)."""
+    from kdtree_tpu.obs import flight
+
+    eng = fresh_engine(base_points, max_delta_rows=4, max_delta_frac=0.0)
+    fresh = np.arange(4, dtype=np.float32).reshape(-1, 1) + \
+        np.zeros((4, DIM), dtype=np.float32)
+    eng.upsert(np.array([N + 1, N + 2, N + 3, N + 4]), fresh)
+    deadline = time.monotonic() + 60
+    while eng.epoch == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.epoch == 1
+    eng.close()
+    events = [e for e in flight.recorder().snapshot()
+              if e["type"] == "mutable.rebuild_impact"]
+    assert events, "rebuild completed without an impact event"
+    ev = events[-1]
+    assert ev["epoch"] == 1 and ev["previous_epoch"] == 0
+    assert ev["duration_ms"] > 0
+
+
+def test_rebuild_impact_gauge_lands_on_metrics(base_points):
+    """Once a rebuild window HAS latency data, the p99 delta must be a
+    live gauge on the Prometheus exposition (absent before — an unset
+    gauge would read 'measured, no impact')."""
+    from kdtree_tpu.mutable.engine import _REQUEST_LATENCY_KEY
+    from kdtree_tpu.obs import history as obs_history
+    from kdtree_tpu.obs.export import prometheus_text
+
+    def hist(fast, slow):
+        return {_REQUEST_LATENCY_KEY: {
+            "count": fast + slow, "sum": fast * 0.01 + slow * 0.5,
+            "buckets": {"0.025": fast, "1.0": fast + slow,
+                        "+Inf": fast + slow},
+        }}
+
+    # synthetic samples in the FUTURE: the window filter is a lower
+    # bound (ts >= now - window), so only a future t_base keeps real
+    # sampler samples from other tests out of these windows
+    t_base = time.time() + 1000.0
+    ring = obs_history.get_history()
+    ring.record({"histograms": hist(0, 0)}, ts=t_base)
+    ring.record({"histograms": hist(50, 0)}, ts=t_base + 10)
+    ring.record({"histograms": hist(50, 50)}, ts=t_base + 20)
+    eng = fresh_engine(base_points)
+    eng._note_rebuild_impact(0, 1, t_base + 10, t_base + 20)
+    eng.close()
+    text = prometheus_text()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("kdtree_mutable_rebuild_p99_delta_ms ")]
+    assert line, "gauge missing after a measured rebuild window"
+    assert float(line[0].split()[-1]) > 0

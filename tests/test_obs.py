@@ -677,3 +677,43 @@ def test_cli_stats_rejects_non_report(tmp_path, capsys):
     missing = tmp_path / "nope.json"
     with pytest.raises(SystemExit):
         cli_main(["stats", str(missing)])
+
+
+def _cap_block(knee, p99):
+    return {
+        "capacity_version": 1, "slo_ms": 250.0, "slo_quantile": 0.99,
+        "max_bad_frac": 0.05, "knee_rate": knee,
+        "steps": [{"rate": 50.0, "sent": 10, "goodput_rps": 48.0,
+                   "p50_ms": p99 / 4, "p95_ms": p99 / 2, "p99_ms": p99,
+                   "shed_frac": 0.0, "bad_frac": 0.0}],
+        "server": {
+            "write_latency_ms": {"upsert": {"count": 7, "mean_ms": 0.4}},
+            "rebuild_p99_delta_ms": 1.5, "epoch": 2,
+        },
+    }
+
+
+def test_render_report_shows_capacity_block():
+    rep = {"counters": {}, "gauges": {}, "histograms": {}, "spans": {},
+           "capacity": _cap_block(50.0, 80.0)}
+    text = export.render_report(rep)
+    assert "capacity (open-loop load harness)" in text
+    assert "knee rate:" in text and "50 req/s" in text
+    assert "write upsert" in text and "rebuild p99 delta" in text
+    # reports without one render exactly as before
+    assert "capacity" not in export.render_report(
+        {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}})
+
+
+def test_render_report_diff_capacity_knee_and_p99():
+    old = {"counters": {}, "gauges": {}, "spans": {},
+           "capacity": _cap_block(100.0, 40.0)}
+    new = {"counters": {}, "gauges": {}, "spans": {},
+           "capacity": _cap_block(50.0, 120.0)}
+    text = export.render_report_diff(old, new)
+    assert "capacity (knee + per-rate p99)" in text
+    assert "knee rate (req/s)" in text and "-50.0%" in text
+    assert "p99 @ 50 req/s" in text and "+200.0%" in text
+    # one-sided: a capacity block appearing is itself the signal
+    text = export.render_report_diff({"counters": {}}, new)
+    assert "new" in text and "knee rate" in text

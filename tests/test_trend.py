@@ -225,3 +225,95 @@ def test_cli_update_baseline_roundtrip(tmp_path, capsys):
     # with the fresh baseline the same series gates clean
     cli.main(["trend", *BENCH_SERIES, "--baseline", path])
     assert "0 new" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# capacity blocks (ISSUE 12: the load harness's curve in the trend gate)
+# ---------------------------------------------------------------------------
+
+
+def _loadgen_report(knee, rates=(25, 50, 100), p99s=(20.0, 40.0, 80.0)):
+    return {
+        "loadgen_version": 1,
+        "capacity": {
+            "capacity_version": 1,
+            "offered_unit": "req/s",
+            "slo_ms": 250.0,
+            "slo_quantile": 0.99,
+            "max_bad_frac": 0.05,
+            "knee_rate": knee,
+            "steps": [
+                {"rate": r, "p99_ms": p, "goodput_rps": r, "sent": 10}
+                for r, p in zip(rates, p99s)
+            ],
+        },
+    }
+
+
+def test_capacity_drop_flagged_and_grandfatherable(tmp_path):
+    runs = [
+        tr.load_run(_write(tmp_path, "lg1.json", _loadgen_report(100.0))),
+        tr.load_run(_write(tmp_path, "lg2.json", _loadgen_report(25.0))),
+    ]
+    findings, band = tr.analyze(runs, band=0.3)
+    assert [f["rule"] for f in findings] == ["capacity-drop"]
+    assert findings[0]["metric"] == "capacity:knee"
+    # linter-style grandfathering works for the new rule too
+    base_path = str(tmp_path / "base.json")
+    tr.save_baseline(base_path, findings)
+    assert tr.partition(findings, tr.load_baseline(base_path)) == []
+    # inside the band: clean
+    runs2 = [
+        tr.load_run(_write(tmp_path, "lg3.json", _loadgen_report(100.0))),
+        tr.load_run(_write(tmp_path, "lg4.json", _loadgen_report(90.0))),
+    ]
+    findings2, _ = tr.analyze(runs2, band=0.3)
+    assert findings2 == []
+
+
+def test_capacity_compares_across_interleaved_bench_runs(tmp_path):
+    """A series mixing plain bench sidecars (no capacity) with loadgen
+    reports compares capacity between the capacity-BEARING runs, and
+    the headline scan keeps working unchanged around them."""
+    paths = [
+        _write(tmp_path, "lg_a.json", _loadgen_report(100.0)),
+        _write(tmp_path, "bench.json", _headline(1000)),
+        _write(tmp_path, "lg_b.json", _loadgen_report(10.0)),
+    ]
+    runs = [tr.load_run(p) for p in paths]
+    findings, _ = tr.analyze(runs, band=0.3)
+    assert [f["rule"] for f in findings] == ["capacity-drop"]
+    assert findings[0]["from"] == "lg_a" and findings[0]["to"] == "lg_b"
+    # rendering tolerates headline-less runs in both formats
+    human = tr.render_human(runs, findings, findings, 0.3)
+    assert "knee" in human and "capacity-drop" in human
+    rep = json.loads(tr.render_json(runs, findings, findings, 0.3))
+    assert rep["runs"][0]["headline_value"] is None
+    assert rep["runs"][0]["capacity_knee"] == 100.0
+    assert rep["runs"][1]["headline_value"] == 1000
+
+
+def test_sidecar_with_capacity_block_carries_both(tmp_path):
+    side = {
+        "headline": _headline(500),
+        "counters": {},
+        "platform": "cpu",
+        **_loadgen_report(60.0),
+    }
+    run = tr.load_run(_write(tmp_path, "side.json", side))
+    assert run["metrics"][tr.HEADLINE_KEY]["value"] == 500.0
+    assert run["capacity"]["knee_rate"] == 60.0
+
+
+def test_capacity_schema_versioning_and_absence(tmp_path):
+    # unknown future version -> not comparable, never a crash
+    fut = _loadgen_report(100.0)
+    fut["capacity"]["capacity_version"] = 99
+    run = tr.load_run(_write(tmp_path, "fut.json", fut))
+    assert run["capacity"] is None
+    # old sidecars without any capacity parse exactly as before
+    old = {"headline": _headline(500), "counters": {}, "platform": "cpu"}
+    run = tr.load_run(_write(tmp_path, "old.json", old))
+    assert run["capacity"] is None
+    findings, _ = tr.analyze([run, run], band=0.3)
+    assert findings == []
