@@ -378,6 +378,42 @@ def bench_sparse_dfs(kt, tree, pts, Q: int, k: int):
     return dt, ok
 
 
+def bench_snapshot(kt, pts):
+    """Build cost vs load cost, split (ROADMAP direction 2): a fresh
+    from-scratch build of the point set, timed next to a
+    checksum-verified mmap load of the built index's serving snapshot
+    (kdtree_tpu/snapshot/). The ratio is the replica cold-start story —
+    a snapshot-loaded replica skips exactly the build number. Returns
+    (build_s, load_s, byte_identical); the loaded arrays must equal the
+    built ones bit-for-bit or the snapshot contract is broken."""
+    import shutil
+    import tempfile
+
+    from kdtree_tpu import snapshot as snap
+
+    t0 = time.perf_counter()
+    tree2 = kt.build_morton(pts)
+    _fetch([tree2.node_lo, tree2.bucket_gid])
+    build_s = time.perf_counter() - t0
+    d = tempfile.mkdtemp(prefix="kdtree-bench-snapshot-")
+    try:
+        snap.save_snapshot(d, tree2, epoch=0)
+        t0 = time.perf_counter()
+        tree3, _man = snap.load_snapshot(d)
+        _fetch([tree3.node_lo, tree3.bucket_gid])
+        load_s = time.perf_counter() - t0
+        same = all(
+            np.array_equal(np.asarray(getattr(tree2, a)),
+                           np.asarray(getattr(tree3, a)))
+            for a in ("node_lo", "node_hi", "bucket_pts", "bucket_gid")
+        )
+    finally:
+        # segments at the accel shape run hundreds of MB; paired runs
+        # must not accumulate them in tmp
+        shutil.rmtree(d, ignore_errors=True)
+    return build_s, load_s, same
+
+
 def bench_clustered(kt, n: int, dim: int, nq: int):
     """Gaussian-mixture high-D config on the brute-force path — the same
     path the CLI's auto engine dispatches to at 128-D (cli.py
@@ -551,6 +587,29 @@ def main() -> None:
             "plan_cache": plan_cache,
             "recompiles": recompiles,
         })
+        # replica cold-start split (docs/SERVING.md "Snapshots & replica
+        # fleets"): the same index as a from-scratch build vs a snapshot
+        # load — both as pts/s so the trend gate's drop detection points
+        # the right way for each
+        with obs.span("bench.snapshot"):
+            sb_s, sl_s, s_ok = bench_snapshot(kt, pts)
+        if not s_ok:
+            _fail("oracle check (snapshot round-trip identity)")
+        extra.append({
+            "metric": f"snapshot: from-scratch build pts/sec ({cfg}, "
+                      f"{platform})",
+            "value": round(n / sb_s),
+            "unit": "pts/s",
+            "vs_baseline": None,
+        })
+        extra.append({
+            "metric": f"snapshot: mmap load pts/sec ({cfg}, {platform})",
+            "value": round(n / sl_s),
+            "unit": "pts/s",
+            "vs_baseline": None,
+            "speedup_vs_build": round(sb_s / max(sl_s, 1e-9), 1),
+        })
+
         if capture and metrics_out:
             profile_block = bench_profile(tree, Q, k, 3)
 

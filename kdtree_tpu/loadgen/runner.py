@@ -188,6 +188,16 @@ def discover(
                 dims, kmaxs, bases, total = [], [], [0], 0
                 for s in body["shards"]:
                     detail = s.get("detail") or {}
+                    if "dim" not in detail:
+                        # replica sets: the top-level detail describes
+                        # the PRIMARY — a shard whose primary is
+                        # ejected but whose secondaries serve is still
+                        # routable and must still be discoverable
+                        for rep in s.get("replicas") or []:
+                            rdetail = rep.get("detail") or {}
+                            if "dim" in rdetail:
+                                detail = rdetail
+                                break
                     if "dim" in detail:
                         dims.append(int(detail["dim"]))
                         kmaxs.append(int(detail.get("k_max", 1)))
@@ -326,6 +336,18 @@ def _sum_series(parsed: Dict[str, float], family: str,
     return sum(vals) if vals else None
 
 
+def _max_series(parsed: Dict[str, float], family: str) -> Optional[float]:
+    """Max over a family's series — for stateful gauges like the epoch,
+    where a federated scrape holds one series per shard/replica and a
+    SUM would publish a meaningless total (6 replicas at epoch 1 are
+    not 'epoch 6')."""
+    vals = [
+        v for k, v in parsed.items()
+        if k == family or k.startswith(family + "{")
+    ]
+    return max(vals) if vals else None
+
+
 def scrape_server_block(target: str,
                         timeout_s: float = 5.0) -> Optional[Dict]:
     """One ``/metrics`` scrape distilled to the write-path evidence the
@@ -362,9 +384,12 @@ def scrape_server_block(target: str,
                     }
             if not writes and path == "/metrics":
                 continue  # router front: the shards hold the families
-            delta = _sum_series(parsed,
+            # max, not sum: per-shard/replica series of these are each
+            # a whole statement about one process — the fleet summary
+            # is the worst delta and the furthest epoch
+            delta = _max_series(parsed,
                                 "kdtree_mutable_rebuild_p99_delta_ms")
-            epoch = _sum_series(parsed, "kdtree_epoch")
+            epoch = _max_series(parsed, "kdtree_epoch")
             return {
                 "write_latency_ms": writes,
                 "rebuild_p99_delta_ms": (None if delta is None

@@ -242,8 +242,20 @@ class MutableEngine:
         max_delta_rows: int = DEFAULT_MAX_DELTA_ROWS,
         max_delta_frac: float = DEFAULT_MAX_DELTA_FRAC,
         requested_k: Optional[int] = None,
+        epoch0: int = 0,
+        snapshot_sink=None,
     ) -> None:
         self._lock = lockwatch.make_rlock("mutable.engine")
+        # epoch numbering continues from the snapshot this process booted
+        # from (docs/SERVING.md "Snapshots & replica fleets"): a primary
+        # restarted at epoch E compacts to E+1, and followers comparing
+        # /healthz epochs see one monotone sequence across restarts
+        self._epoch0 = int(epoch0)
+        # called (tree, epoch) on the rebuild thread AFTER each swap —
+        # the epoch compactor IS a snapshot build, so the primary emits
+        # the artifact secondaries blue/green-adopt. Never allowed to
+        # fail the swap that already landed.
+        self._snapshot_sink = snapshot_sink
         # the CONFIGURED k, not inner.k: the bootstrap ServeEngine clamps
         # k to its n_real, and pinning that clamp as the forever-k would
         # cap every future epoch at the seed index's size (a 5-point
@@ -256,8 +268,10 @@ class MutableEngine:
         # buckets the epoch rebuilder pre-warms on the NEW engine before
         # the swap (ServeState.warmup records what it actually compiled)
         self.warm_buckets: List[int] = []
-        self._state = _EpochState(inner, epoch=0, min_cap=self._min_cap)
-        self.last_answer_epoch = 0  # epoch of the latest knn_batch answer
+        self._state = _EpochState(inner, epoch=self._epoch0,
+                                  min_cap=self._min_cap)
+        # epoch of the latest knn_batch answer
+        self.last_answer_epoch = self._epoch0
         self._rebuilding = False
         self._journal: Optional[List[tuple]] = None
         self._rebuild_thread: Optional[threading.Thread] = None
@@ -652,6 +666,11 @@ class MutableEngine:
                         delta_rows=new_st.delta.rows,
                         tombstones=len(new_st.dead),
                     )
+            # a compaction IS a snapshot build: emit the new epoch's
+            # artifact for blue/green secondaries (off the lock, on this
+            # thread — the swap already landed, so serving never waits
+            # on the disk write)
+            self._emit_snapshot(new_st)
             # rebuild-overlap serving impact, joined through the history
             # ring AFTER the swap (off the lock, on this thread): how
             # much did p99 move in windows overlapping the rebuild span?
@@ -728,6 +747,50 @@ class MutableEngine:
             bruteforce.knn(st.masked_pts, jnp.asarray(q), k=kk)
         except Exception:
             pass
+
+    def _emit_snapshot(self, st: _EpochState) -> None:
+        """Hand the new epoch's tree to the snapshot sink (rebuild
+        thread, off the lock). A failed emit is an incident for the
+        fleet's convergence — counted and flight-dumped — but never
+        undoes the in-process swap that already serves."""
+        if self._snapshot_sink is None:
+            return
+        try:
+            self._snapshot_sink(st.inner.tree, st.epoch)
+        except Exception as e:
+            obs.get_registry().counter(
+                "kdtree_snapshot_sink_errors_total").inc()
+            flight.record("snapshot.sink_error", epoch=st.epoch,
+                          error=repr(e)[:200])
+            flight.auto_dump("snapshot-sink-error")
+
+    def adopt_tree(self, tree, epoch: int) -> None:
+        """Blue/green handoff for snapshot-following read replicas
+        (snapshot/follower.py): wrap a freshly loaded tree in a new
+        epoch state, pre-warm its batch shapes on the CALLING thread
+        (compiles stay off the serving path — the epoch rebuilder's own
+        discipline), then swap atomically between batches. The configured
+        k is preserved across the swap (the ROADMAP k_max contract).
+
+        A follower replica is read-only, so the overlay it discards is
+        empty; if local writes somehow exist, the adoption wins — the
+        snapshot is the shard's authoritative state — and the discarded
+        backlog is flight-recorded rather than silently dropped."""
+        from kdtree_tpu.serve.lifecycle import ServeEngine
+
+        new_inner = ServeEngine(tree, self._k_cfg)
+        self._prewarm(new_inner)
+        new_st = _EpochState(new_inner, epoch=int(epoch),
+                             min_cap=self._min_cap)
+        self._warm_overlay(new_st)
+        with self._lock:
+            if self._closed:
+                return
+            discarded = self._state.backlog()
+            self._state = new_st
+            self._update_gauges(new_st)
+            flight.record("snapshot.adopt", epoch=new_st.epoch,
+                          n=new_st.n_main, discarded_backlog=discarded)
 
     def _note_rebuild_impact(self, old_epoch: int, new_epoch: int,
                              t0_unix: float, t1_unix: float) -> None:

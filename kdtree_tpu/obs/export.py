@@ -239,6 +239,32 @@ METRIC_HELP = {
     "kdtree_router_federated_up":
         "1 when the shard's /metrics scrape succeeded in the last "
         "federated exposition",
+    "kdtree_router_replicas": "replicas per shard set",
+    "kdtree_router_replica_requests_total":
+        "attempts dispatched per replica (shard x replica) — the "
+        "read-spread evidence for replica sets",
+    # snapshots & replica fleets (docs/SERVING.md)
+    "kdtree_snapshot_saves_total": "serving snapshots written",
+    "kdtree_snapshot_loads_total": "serving snapshots loaded",
+    "kdtree_snapshot_load_errors_total":
+        "snapshot loads refused, by reason (missing/manifest/schema/"
+        "checksum/segment) — never served half-read",
+    "kdtree_snapshot_sink_errors_total":
+        "epoch-swap snapshot emits that failed (the swap itself stood)",
+    "kdtree_snapshot_version":
+        "manifest version of the last snapshot saved or loaded",
+    "kdtree_snapshot_epoch":
+        "index epoch of the last snapshot saved or loaded",
+    "kdtree_snapshot_bytes": "total segment bytes of the last save",
+    "kdtree_snapshot_save_seconds": "duration of the last snapshot save",
+    "kdtree_snapshot_load_seconds":
+        "duration of the last snapshot load (verify + mmap + device "
+        "transfer — the replica cold-start cost the build no longer "
+        "pays)",
+    "kdtree_snapshot_follow_version":
+        "manifest version this follower replica currently serves",
+    "kdtree_snapshot_adoptions_total":
+        "blue/green snapshot swaps adopted by this follower",
     # mutable index (docs/SERVING.md "Mutable index")
     "kdtree_epoch":
         "index epoch generation; increments on each delta compaction "

@@ -269,13 +269,23 @@ def analyze(runs: List[dict], band: Optional[float] = None):
     Returns ``(findings, band_used)``."""
     used = derive_band(runs, band)
     findings: List[dict] = []
+    last_plat: Optional[dict] = None  # newest run with a real platform
     for prev, cur in zip(runs, runs[1:]):
-        pp, cp = prev["platform"], cur["platform"]
+        # platform verdicts compare against the newest PLATFORM-BEARING
+        # run: a capacity-only artifact (platform "unknown") interposed
+        # between an accelerator round and a cpu round must not mask
+        # the very tpu->cpu fallback this scan exists to flag
+        pref = prev if prev["platform"] != "unknown" else last_plat
+        if prev["platform"] != "unknown":
+            last_plat = prev
+        if pref is None:
+            pref = prev
+        pp, cp = pref["platform"], cur["platform"]
         if pp not in ("cpu", "unknown") and cp == "cpu":
             reason = (f" ({cur['degraded']})"
                       if isinstance(cur["degraded"], str) else "")
             findings.append(_finding(
-                "platform-fallback", "platform", prev, cur,
+                "platform-fallback", "platform", pref, cur,
                 f"{pp} -> {cp}{reason}: numbers are not comparable to "
                 "accelerator rounds",
             ))

@@ -113,6 +113,7 @@ class ServeState:
         slo_engine=None,
         history_period_s: Optional[float] = None,
         id_offset: int = 0,
+        read_only: bool = False,
     ) -> None:
         self.engine = engine
         self.max_batch = max_batch
@@ -132,6 +133,12 @@ class ServeState:
         # KDTREE_TPU_HISTORY_PERIOD_S default.
         self.slo_engine = slo_engine
         self.history_period_s = history_period_s
+        # snapshot-following read replicas reject writes (403): in the
+        # primary/secondary topology writes go only to the shard
+        # primary, and a secondary's local delta would silently diverge
+        # from the snapshot stream it converges by (docs/SERVING.md
+        # "Snapshots & replica fleets")
+        self.read_only = bool(read_only)
         self._ready = threading.Event()
         self._ready_gauge = obs.get_registry().gauge("kdtree_serve_ready")
         self._ready_gauge.set(0)
@@ -222,6 +229,9 @@ def build_state(
     id_offset: int = 0,
     max_delta_rows: Optional[int] = None,
     max_delta_frac: Optional[float] = None,
+    read_only: bool = False,
+    epoch0: int = 0,
+    snapshot_sink=None,
 ) -> ServeState:
     """Assemble a ready-to-warmup :class:`ServeState` from exactly one
     index source: a loaded ``tree``, a materialized ``points`` array, or
@@ -269,6 +279,11 @@ def build_state(
         # the configured k, so an epoch rebuilt over a grown index can
         # serve the full k even when the bootstrap index was smaller
         requested_k=int(k),
+        # snapshot plumbing (docs/SERVING.md "Snapshots & replica
+        # fleets"): epoch numbering continues from the loaded snapshot,
+        # and a primary's epoch compactor emits through the sink
+        epoch0=int(epoch0),
+        snapshot_sink=snapshot_sink,
     )
     if slo_engine is None:
         # the process-default specs (request p99, error/shed/degraded
@@ -290,4 +305,5 @@ def build_state(
         slo_engine=slo_engine,
         history_period_s=history_period_s,
         id_offset=id_offset,
+        read_only=read_only,
     )

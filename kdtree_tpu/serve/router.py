@@ -42,6 +42,20 @@ The router holds no index, no jax, and no queue — shards shed (429 +
 ``Retry-After``, which the backoff honors) and the router propagates
 pressure instead of buffering it.
 
+**Replica sets** (docs/SERVING.md "Snapshots & replica fleets"): a
+shard entry is a SET of equivalent serve processes over the same
+partition — ``url0|url1|url2``, the first being the shard primary.
+Reads load-balance round-robin across routable replicas, with the
+whole per-replica fault-tolerance kit above (each replica owns its
+breaker, latency window, and health verdict), and a hedge fires
+against a *different* replica when one is available — true
+tail-independence, not a second queue position behind the same slow
+process. Writes go ONLY to the shard primary (secondaries are
+snapshot-following read replicas and 403 writes). Exactness dedupe is
+by shard ownership, not liveness: the scatter takes ONE answer per
+shard set, so adding or losing replicas can never duplicate or drop a
+point from the merged top-k.
+
 Two fleet-facing extras ride on the same shard table:
 
 - **write passthrough** (``POST /v1/upsert`` / ``/v1/delete``): the
@@ -212,17 +226,24 @@ class CircuitBreaker:
 
 
 class ShardState:
-    """One downstream serve process: address, breaker, latency window
-    (the hedge-delay source), health verdict, and shed backoff."""
+    """One downstream serve process (one REPLICA of a shard): address,
+    breaker, latency window (the hedge-delay source), health verdict,
+    and shed backoff. ``index`` is the shard-set index; ``replica`` the
+    position inside the set (0 = the write primary). ``multi`` controls
+    whether metric labels carry the replica dimension — single-replica
+    sets keep their historical ``{shard="i"}`` series identity."""
 
     def __init__(self, index: int, url: str, breaker: CircuitBreaker,
-                 hedge_min_s: float = DEFAULT_HEDGE_MIN_S) -> None:
+                 hedge_min_s: float = DEFAULT_HEDGE_MIN_S,
+                 replica: int = 0, multi: bool = False) -> None:
         parsed = urlparse(url if "//" in url else f"http://{url}")
         if parsed.scheme != "http" or not parsed.hostname:
             raise ValueError(
                 f"shard url {url!r} must be http://host:port"
             )
         self.index = index
+        self.replica = int(replica)
+        self.multi = bool(multi)
         self.url = url
         self.host = parsed.hostname
         self.port = parsed.port or 80
@@ -274,7 +295,62 @@ class ShardState:
             return max(0.0, self.retry_after_until - now)
 
     def label(self) -> dict:
+        if self.multi:
+            return {"shard": str(self.index), "replica": str(self.replica)}
         return {"shard": str(self.index)}
+
+    def replica_label(self) -> dict:
+        """Always replica-qualified — for the per-replica request
+        counter, where the replica dimension is the whole point."""
+        return {"shard": str(self.index), "replica": str(self.replica)}
+
+
+class ReplicaSet:
+    """One shard's replica set: the scatter takes ONE answer per set
+    (exactness dedupe is by shard ownership), reads rotate round-robin
+    over routable replicas, writes go to ``primary`` (replica 0)."""
+
+    def __init__(self, index: int, replicas: List[ShardState]) -> None:
+        self.index = index
+        self.replicas = replicas
+        self._rr = 0
+        self._lock = lockwatch.make_lock("route.replica")
+
+    @property
+    def primary(self) -> ShardState:
+        return self.replicas[0]
+
+    def pick_order(self) -> List[ShardState]:
+        """All replicas in this request's rotation order — the caller
+        walks it to the first healthy one whose breaker admits."""
+        with self._lock:
+            start = self._rr % len(self.replicas)
+            self._rr += 1
+        return self.replicas[start:] + self.replicas[:start]
+
+    def hedge_candidate(self, picked: ShardState) -> Optional[ShardState]:
+        """A DIFFERENT routable replica to aim the hedge at (the next
+        one after ``picked`` in set order), or None — the hedge then
+        falls back to re-asking the same replica, the single-replica
+        behavior."""
+        n = len(self.replicas)
+        for off in range(1, n):
+            cand = self.replicas[(picked.replica + off) % n]
+            if cand.healthy and cand.breaker.state == CLOSED:
+                return cand
+        return None
+
+    def id_offset(self) -> Optional[int]:
+        """The set's partition start — every replica serves the same
+        partition, so the first learned offset speaks for the set."""
+        for r in self.replicas:
+            if r.id_offset is not None:
+                return r.id_offset
+        return None
+
+    def routable(self) -> bool:
+        return any(r.healthy and r.breaker.state != OPEN
+                   for r in self.replicas)
 
 
 class RouterConfig:
@@ -466,25 +542,48 @@ class Router(GracefulHTTPServer):
             raise ValueError("router needs at least one shard url")
         self.config = config or RouterConfig()
         self.quorum = self.config.resolve_quorum(len(shard_urls))
-        parsed_shards = [
-            ShardState(i, url,
-                       CircuitBreaker(
-                           failures=self.config.breaker_failures,
-                           reset_s=self.config.breaker_reset_s,
-                           on_transition=self._breaker_reporter(i),
-                       ),
-                       hedge_min_s=self.config.hedge_min_s)
-            for i, url in enumerate(shard_urls)
-        ]
+        parsed_sets: List[ReplicaSet] = []
+        for i, entry in enumerate(shard_urls):
+            # replica-set syntax (docs/SERVING.md "Snapshots & replica
+            # fleets"): url0|url1|... — replica 0 is the shard primary
+            urls = [u.strip() for u in str(entry).split("|")]
+            if not all(urls):
+                raise ValueError(
+                    f"shard {i} entry {entry!r} has an empty replica url"
+                )
+            multi = len(urls) > 1
+            replicas = [
+                ShardState(
+                    i, url,
+                    CircuitBreaker(
+                        failures=self.config.breaker_failures,
+                        reset_s=self.config.breaker_reset_s,
+                        on_transition=self._breaker_reporter(i, j, multi),
+                    ),
+                    hedge_min_s=self.config.hedge_min_s,
+                    replica=j, multi=multi,
+                )
+                for j, url in enumerate(urls)
+            ]
+            parsed_sets.append(ReplicaSet(i, replicas))
         super().__init__(address, RouterHandler)
         reg = obs.get_registry()
-        self.shards: List[ShardState] = parsed_shards
+        self.shard_sets: List[ReplicaSet] = parsed_sets
+        # the flat replica list: health probing and federation walk every
+        # process; routing policy walks the sets
+        self.shards: List[ShardState] = [
+            r for s in parsed_sets for r in s.replicas
+        ]
         for shard in self.shards:
             reg.gauge("kdtree_router_breaker_state",
                       labels=shard.label()).set(CLOSED)
             reg.gauge("kdtree_router_shard_healthy",
                       labels=shard.label()).set(1)
-        reg.gauge("kdtree_router_shards").set(len(self.shards))
+        reg.gauge("kdtree_router_shards").set(len(self.shard_sets))
+        for sset in self.shard_sets:
+            reg.gauge("kdtree_router_replicas",
+                      labels={"shard": str(sset.index)}).set(
+                len(sset.replicas))
         self._req_lat = reg.histogram(
             "kdtree_router_request_seconds",
             buckets=_ROUTER_LATENCY_BUCKETS,
@@ -501,17 +600,20 @@ class Router(GracefulHTTPServer):
 
     # -- telemetry plumbing --------------------------------------------------
 
-    def _breaker_reporter(self, index: int):
+    def _breaker_reporter(self, index: int, replica: int = 0,
+                          multi: bool = False):
         labels = {"shard": str(index)}
+        if multi:
+            labels["replica"] = str(replica)
 
         def report(old: int, new: int) -> None:
             reg = obs.get_registry()
             reg.gauge("kdtree_router_breaker_state", labels=labels).set(new)
             reg.counter(
                 "kdtree_router_breaker_transitions_total",
-                labels={"shard": str(index), "to": BREAKER_NAMES[new]},
+                labels={**labels, "to": BREAKER_NAMES[new]},
             ).inc()
-            flight.record("route.breaker", shard=index,
+            flight.record("route.breaker", shard=index, replica=replica,
                           previous=BREAKER_NAMES[old], to=BREAKER_NAMES[new])
             if new == OPEN:
                 # breaker-open IS an incident: dump the ring (rate-
@@ -548,6 +650,14 @@ class Router(GracefulHTTPServer):
         a redundant full request."""
         import http.client
 
+        # the per-replica spread counter (CI's replica-smoke asserts
+        # every replica of a set sees traffic): counted at dispatch, so
+        # failed attempts count too — this measures where the router
+        # SENT load, not who answered
+        obs.get_registry().counter(
+            "kdtree_router_replica_requests_total",
+            labels=shard.replica_label(),
+        ).inc()
         t0 = time.monotonic()
         conn = http.client.HTTPConnection(
             shard.host, shard.port, timeout=max(timeout_s, 0.001)
@@ -577,10 +687,16 @@ class Router(GracefulHTTPServer):
                            if isinstance(e, TimeoutError) else "network")
                 raise ShardError(f"shard {shard.index}: {e!r}",
                                  outcome=outcome) from None
-            except (http.client.HTTPException, ValueError) as e:
+            except (http.client.HTTPException, ValueError,
+                    AttributeError) as e:
                 # ValueError: a hedge winner closing this twin's
                 # connection mid-read surfaces as "I/O operation on
-                # closed file" — a cancellation, not a crash
+                # closed file" — a cancellation, not a crash.
+                # AttributeError: the same close race one bytecode
+                # later — http.client's _close_conn reads a fp the
+                # concurrent close() already set to None ('NoneType'
+                # has no attribute 'close'); escaping here killed the
+                # hedge thread (caught by the blue/green fleet e2e).
                 raise ShardError(f"shard {shard.index}: {e!r}",
                                  outcome="network") from None
         finally:
@@ -626,13 +742,24 @@ class Router(GracefulHTTPServer):
 
     def _attempt_hedged(
         self, shard: ShardState, body: bytes, deadline: float, trace: str,
-        allow_hedge: bool = True,
-    ) -> dict:
+        allow_hedge: bool = True, hedge_shard: Optional[ShardState] = None,
+    ) -> Tuple[dict, ShardState]:
         """One logical attempt = a primary call plus (maybe) one hedge.
         The first success wins and the loser's connection is closed;
         both failing raises the primary's error. Raises ShardError.
         ``allow_hedge=False`` keeps a breaker's half-open probe to the
-        single request its contract promises."""
+        single request its contract promises. ``hedge_shard`` aims the
+        hedge at a DIFFERENT replica of the same shard set when one is
+        routable — tail latency on one process says nothing about its
+        siblings, which is the whole reason replica hedging beats
+        re-queueing behind the same slow server.
+
+        Returns ``(payload, winner)`` — the replica that actually
+        answered — so the caller's breaker accounting can land on the
+        right process (success on the winner; a picked replica whose
+        SIBLING had to answer for it gets a failure mark — without
+        that, a wedged replica whose hedges always rescue it would
+        never trip its own breaker)."""
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             raise ShardError(f"shard {shard.index}: deadline exhausted",
@@ -644,9 +771,12 @@ class Router(GracefulHTTPServer):
 
         def run(tag: str) -> None:
             budget = deadline - time.monotonic()
+            target = (hedge_shard
+                      if tag == "hedge" and hedge_shard is not None
+                      else shard)
             try:
                 payload = self._call_shard(
-                    shard, body, budget, trace, conn_box=conns, tag=tag,
+                    target, body, budget, trace, conn_box=conns, tag=tag,
                     # a loser registering after the winner's close sweep
                     # aborts itself before sending anything
                     abort_check=lambda: result.get("winner") not in
@@ -668,8 +798,10 @@ class Router(GracefulHTTPServer):
                     except Exception:
                         pass
                 if result.get("winner") == tag and tag == "hedge":
+                    # attributed to the replica that actually answered —
+                    # a cross-replica hedge win is the sibling's credit
                     reg.counter("kdtree_router_hedge_wins_total",
-                                labels=shard.label()).inc()
+                                labels=target.label()).inc()
             except ShardError as e:
                 with cond:
                     result[tag] = e
@@ -718,7 +850,10 @@ class Router(GracefulHTTPServer):
         if hedge_thread is not None:
             hedge_thread.join(timeout=0.05)
         if "winner" in result:
-            return result["payload"]
+            winner = (hedge_shard
+                      if result["winner"] == "hedge"
+                      and hedge_shard is not None else shard)
+            return result["payload"], winner
         err = result.get("primary")
         if not isinstance(err, ShardError):
             err = result.get("hedge")
@@ -735,36 +870,55 @@ class Router(GracefulHTTPServer):
         raise err
 
     def _shard_task(
-        self, shard: ShardState, body: bytes, deadline: float, trace: str,
+        self, sset: ReplicaSet, body: bytes, deadline: float, trace: str,
     ):
-        """The full per-shard policy: ejection check, breaker, bounded
-        retry with jittered backoff (429 Retry-After honored). Returns
-        the payload, or the final ShardError."""
+        """The full per-shard policy, replica-aware: pick a routable
+        replica round-robin (ejection and breaker checks per replica),
+        bounded retry with jittered backoff (429 Retry-After honored;
+        each retry re-picks, so a retry naturally lands on a sibling
+        replica). Returns ONE payload per shard set — exactness dedupe
+        is by shard ownership — or the final ShardError."""
         cfg = self.config
-        if not shard.healthy:
-            self._count_attempt(shard, "breaker_open")
-            return ShardError(f"shard {shard.index}: ejected (unhealthy)",
-                              outcome="breaker_open")
+        if not any(r.healthy for r in sset.replicas):
+            self._count_attempt(sset.primary, "breaker_open")
+            return ShardError(
+                f"shard {sset.index}: all {len(sset.replicas)} "
+                "replica(s) ejected (unhealthy)",
+                outcome="breaker_open",
+            )
         # deterministic jitter: a replayed request backs off identically
-        rng = random.Random(f"{trace}:{shard.index}")
+        rng = random.Random(f"{trace}:{sset.index}")
         last: Optional[ShardError] = None
         for attempt in range(cfg.retries + 1):
             now = time.monotonic()
             if now >= deadline:
                 break
-            if not shard.breaker.allow(now):
-                self._count_attempt(shard, "breaker_open")
+            shard: Optional[ShardState] = None
+            for cand in sset.pick_order():
+                if not cand.healthy:
+                    continue
+                # allow() claims the half-open probe slot, so it runs
+                # only on the replica we commit to
+                if cand.breaker.allow(now):
+                    shard = cand
+                    break
+            if shard is None:
+                self._count_attempt(sset.primary, "breaker_open")
                 return ShardError(
-                    f"shard {shard.index}: circuit breaker open",
+                    f"shard {sset.index}: circuit breaker open on every "
+                    "routable replica",
                     outcome="breaker_open",
                 )
             try:
-                payload = self._attempt_hedged(
+                payload, winner = self._attempt_hedged(
                     shard, body, deadline, trace,
                     # a half-open probe is ONE request by contract — a
                     # just-recovering shard must not be hedged into 2x
                     # load at its weakest moment
                     allow_hedge=shard.breaker.state != HALF_OPEN,
+                    # aim the hedge at a sibling replica when one is
+                    # routable (None falls back to the same process)
+                    hedge_shard=sset.hedge_candidate(shard),
                 )
             except ShardError as e:
                 last = e
@@ -790,22 +944,39 @@ class Router(GracefulHTTPServer):
                 # the pre-attempt `now` is stale by the attempt's own
                 # duration and would over-sleep past the advice (and
                 # maybe past the deadline, forfeiting a viable retry).
+                # Per-replica advice: the NEXT pick may be a sibling the
+                # shed replica's advice does not bind, but honoring the
+                # max keeps the router conservative under fleet-wide
+                # shedding.
                 backoff = max(backoff, shard.retry_after_remaining())
                 if time.monotonic() + backoff >= deadline:
                     break
                 obs.get_registry().counter(
                     "kdtree_router_retries_total", labels=shard.label()
                 ).inc()
-                flight.record("route.retry", shard=shard.index, trace=trace,
+                flight.record("route.retry", shard=shard.index,
+                              replica=shard.replica, trace=trace,
                               attempt=attempt, outcome=e.outcome,
                               backoff_ms=round(backoff * 1e3, 3))
                 time.sleep(backoff)
                 continue
-            shard.breaker.record_success()
-            self._count_attempt(shard, "ok")
+            if winner is not shard:
+                # the picked replica never answered inside its own hedge
+                # window — its SIBLING rescued the request. Success
+                # belongs to the winner; the picked replica gets a
+                # failure mark, or a wedged process whose hedges always
+                # bail it out would keep a CLOSED breaker forever and
+                # keep absorbing ~1/R of the reads at full hedge cost.
+                # Consecutive-counting keeps this safe for healthy
+                # replicas: one genuinely-answered pick resets it.
+                winner.breaker.record_success()
+                shard.breaker.record_failure()
+            else:
+                shard.breaker.record_success()
+            self._count_attempt(winner, "ok")
             return payload
         return last if last is not None else ShardError(
-            f"shard {shard.index}: deadline exhausted", outcome="timeout"
+            f"shard {sset.index}: deadline exhausted", outcome="timeout"
         )
 
     # -- the scatter/gather core --------------------------------------------
@@ -817,11 +988,11 @@ class Router(GracefulHTTPServer):
         the deadline, merge. Returns (status, response body, headers)."""
         t0 = time.monotonic()
         deadline = t0 + self.config.deadline_s
-        n = len(self.shards)
+        n = len(self.shard_sets)
         results: List[Optional[object]] = [None] * n
         threads = []
-        for shard in self.shards:
-            def task(s=shard):
+        for sset in self.shard_sets:
+            def task(s=sset):
                 results[s.index] = self._shard_task(s, body, deadline, trace)
 
             t = threading.Thread(target=task, name="kdtree-route-scatter")
@@ -894,11 +1065,13 @@ class Router(GracefulHTTPServer):
 
     # -- write passthrough (mutable index) -----------------------------------
 
-    def _owner_table(self) -> Optional[List[Tuple[int, ShardState]]]:
-        """(offset, shard) ascending, or None while any shard's
+    def _owner_table(self) -> Optional[List[Tuple[int, ReplicaSet]]]:
+        """(offset, shard set) ascending, or None while any set's
         ``id_offset`` is still unknown (no successful health probe yet)
-        — routing a write on a guessed partition would corrupt it."""
-        offs = [(s.id_offset, s) for s in self.shards]
+        — routing a write on a guessed partition would corrupt it.
+        Every replica of a set serves the same partition, so any
+        replica's learned offset speaks for the set."""
+        offs = [(s.id_offset(), s) for s in self.shard_sets]
         if any(o is None for o, _ in offs):
             return None
         return sorted(offs, key=lambda t: t[0])
@@ -975,7 +1148,11 @@ class Router(GracefulHTTPServer):
         failures = client_error = None
         ordered = sorted(parts.items())
         for n_done, (owner, rows) in enumerate(ordered):
-            shard = table[owner][1]
+            # writes go ONLY to the shard PRIMARY (replica 0): the
+            # secondaries are snapshot-following read replicas — they
+            # 403 writes, and converge to this write's effect through
+            # the primary's next epoch snapshot (blue/green)
+            shard = table[owner][1].primary
             # the reads' fail-fast policy applies to writes too: an
             # ejected or breaker-open shard answers immediately instead
             # of burning budget the remaining partitions need
@@ -1136,7 +1313,7 @@ class Router(GracefulHTTPServer):
         obs.flush()
         merged: dict = {}
 
-        def absorb(fams: dict, shard_label: Optional[str]) -> None:
+        def absorb(fams: dict, tag: Optional[str]) -> None:
             for name, fam in fams.items():
                 tgt = merged.setdefault(
                     name, {"help": None, "type": None, "series": []}
@@ -1145,10 +1322,16 @@ class Router(GracefulHTTPServer):
                     if tgt[key] is None:
                         tgt[key] = fam[key]
                 for sname, inner, value in fam["series"]:
-                    if shard_label is not None:
-                        tag = f'shard="{shard_label}"'
+                    if tag is not None:
                         inner = f"{tag},{inner}" if inner else tag
                     tgt["series"].append((sname, inner, value))
+
+        def fed_tag(shard: ShardState) -> str:
+            # single-replica sets keep their historical shard="i" series
+            # identity; replicas add the replica dimension
+            if shard.multi:
+                return f'shard="{shard.index}",replica="{shard.replica}"'
+            return f'shard="{shard.index}"'
 
         absorb(self._parse_prom_families(prometheus_text()), None)
         # scrape shards CONCURRENTLY: serially, a few hung shards at
@@ -1169,23 +1352,23 @@ class Router(GracefulHTTPServer):
             t.start()
         for t in scrapers:
             t.join()
-        up: Dict[int, int] = {}
+        up: List[Tuple[str, int]] = []
         reg = obs.get_registry()
         for shard, text in zip(self.shards, texts):
-            up[shard.index] = 1 if text is not None else 0
+            up.append((fed_tag(shard), 1 if text is not None else 0))
             if text is None:
                 reg.counter("kdtree_router_federate_errors_total",
                             labels=shard.label()).inc()
                 continue
-            absorb(self._parse_prom_families(text), str(shard.index))
+            absorb(self._parse_prom_families(text), fed_tag(shard))
         fam = merged.setdefault(
             "kdtree_router_federated_up",
             {"help": METRIC_HELP.get("kdtree_router_federated_up"),
              "type": "gauge", "series": []},
         )
-        for i in sorted(up):
+        for tag, val in up:
             fam["series"].append(
-                ("kdtree_router_federated_up", f'shard="{i}"', str(up[i]))
+                ("kdtree_router_federated_up", tag, str(val))
             )
         lines: List[str] = []
         for name, fam in merged.items():
@@ -1248,28 +1431,64 @@ class Router(GracefulHTTPServer):
             if not healthy:
                 flight.auto_dump("route-eject")
 
+    def _probe_health_safe(self, shard: ShardState) -> None:
+        try:
+            self._probe_health(shard)
+        except Exception:
+            pass  # the loop must outlive any single probe bug
+
     def _health_loop(self) -> None:
         while not self._stopping.is_set():
-            for shard in self.shards:
-                if self._stopping.is_set():
-                    return
-                try:
-                    self._probe_health(shard)
-                except Exception:
-                    pass  # the loop must outlive any single probe bug
+            # probe CONCURRENTLY: serially, each unreachable replica
+            # costs its full connect timeout, so a few dead replicas
+            # would delay every OTHER replica's ejection/readmission by
+            # seconds per sweep — the same serial-timeout pileup the
+            # federated scrape already fans out to avoid
+            probes = [
+                threading.Thread(target=self._probe_health_safe,
+                                 args=(shard,),
+                                 name="kdtree-route-health-probe")
+                for shard in self.shards
+            ]
+            for t in probes:
+                t.start()
+            for t in probes:
+                t.join()
+            if self._stopping.is_set():
+                return
             self._stopping.wait(self.config.health_period_s)
 
     def shard_report(self) -> List[dict]:
+        """One entry per shard SET. A set is routable while ANY replica
+        is (reads load-balance); the top-level url/breaker/detail keys
+        describe the primary — identical to the historical per-shard
+        shape for single-replica sets — and ``replicas`` carries the
+        full per-replica breakdown (each secondary's adopted epoch
+        rides in its health detail, so fleet convergence after a
+        blue/green swap is one /debug/shards read)."""
         out = []
-        for s in self.shards:
-            state = s.breaker.state
+        for sset in self.shard_sets:
+            reps = []
+            for r in sset.replicas:
+                state = r.breaker.state
+                reps.append({
+                    "replica": r.replica,
+                    "url": r.url,
+                    "healthy": r.healthy,
+                    "breaker": BREAKER_NAMES[state],
+                    "routable": r.healthy and state != OPEN,
+                    "detail": r.health_detail,
+                })
             out.append({
-                "index": s.index,
-                "url": s.url,
-                "healthy": s.healthy,
-                "breaker": BREAKER_NAMES[state],
-                "routable": s.healthy and state != OPEN,
-                "detail": s.health_detail,
+                "index": sset.index,
+                "url": sset.primary.url,
+                "healthy": any(x["healthy"] for x in reps),
+                "breaker": reps[0]["breaker"],
+                # the one definition of set-level routability — the
+                # quorum math in _send_health reads this key
+                "routable": sset.routable(),
+                "detail": reps[0]["detail"],
+                "replicas": reps,
             })
         return out
 

@@ -322,6 +322,13 @@ class KnnRequestHandler(JsonRequestHandler):
                         # across deletes and epoch swaps); k_effective
                         # says how many real neighbors currently exist
                         body["k_effective"] = mut["k_effective"]
+                if state.read_only:
+                    body["read_only"] = True
+                if "snapshot" in state.meta:
+                    # the snapshot block (role, dir, live version): the
+                    # follower updates version on each blue/green adopt,
+                    # so a fleet's convergence is one /healthz sweep
+                    body["snapshot"] = state.meta["snapshot"]
                 if state.slo_engine is not None:
                     # SLO verdict rides along without gating readiness:
                     # a burning p99 wants traffic drained elsewhere, not
@@ -537,6 +544,15 @@ class KnnRequestHandler(JsonRequestHandler):
         # fault path had to fix in PR 9
         payload = self._read_json_object()
         if payload is None:
+            return
+        if state.read_only:
+            # snapshot-following secondary: writes belong to the shard
+            # primary; a local delta here would silently diverge from
+            # the snapshot stream this replica converges by
+            self._send_json(403, {"error": "this replica is read-only "
+                                           "(snapshot follower) — send "
+                                           "writes to the shard primary",
+                                  "trace_id": trace})
             return
         if not hasattr(engine, "upsert"):
             self._send_json(501, {"error": "this index is immutable "

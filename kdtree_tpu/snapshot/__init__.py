@@ -1,0 +1,32 @@
+"""Index snapshots: versioned on-disk serialization of the built
+serving structure, the replica fleet's cold-start and blue/green
+primitive (docs/SERVING.md "Snapshots & replica fleets")."""
+
+from kdtree_tpu.snapshot.follower import DEFAULT_POLL_S, SnapshotFollower
+from kdtree_tpu.snapshot.store import (
+    MANIFEST_NAME,
+    SNAPSHOT_SCHEMA,
+    SnapshotCorruptError,
+    SnapshotError,
+    SnapshotSchemaError,
+    load_snapshot,
+    plan_keys_for,
+    read_manifest,
+    resolve_dir,
+    save_snapshot,
+)
+
+__all__ = [
+    "DEFAULT_POLL_S",
+    "MANIFEST_NAME",
+    "SNAPSHOT_SCHEMA",
+    "SnapshotCorruptError",
+    "SnapshotError",
+    "SnapshotFollower",
+    "SnapshotSchemaError",
+    "load_snapshot",
+    "plan_keys_for",
+    "read_manifest",
+    "resolve_dir",
+    "save_snapshot",
+]

@@ -1,0 +1,447 @@
+"""Versioned on-disk snapshots of a built serving index.
+
+The reference's MPI layer assumes every process rebuilds its shard from
+the seed, and our serving stack inherited that: replica cold-start was
+gen/load + the full sample-sort build + the warmup ladder. A snapshot
+separates build cost from query cost — the expensive artifact (the
+built :class:`~kdtree_tpu.ops.morton.MortonTree`'s device arrays) is
+serialized ONCE and every replica mmap-loads it in seconds
+(docs/SERVING.md "Snapshots & replica fleets").
+
+On-disk layout (one directory per index)::
+
+    DIR/
+      MANIFEST.json            # schema, version, epoch, signature,
+                               # per-segment sha256 checksums, plan keys
+      seg-node_lo-<tag>.npy    # flat .npy segments, one per tree array
+      seg-node_hi-<tag>.npy
+      seg-bucket_pts-<tag>.npy
+      seg-bucket_gid-<tag>.npy
+
+Write protocol: segments first (fresh per-save ``tag`` so a crashed
+re-save can never mix generations), manifest written to a tmp file and
+``os.replace``d LAST — a reader that sees a manifest sees a complete,
+self-consistent segment set. ``version`` increments on every save into
+the directory; the blue/green follower (``snapshot/follower.py``) polls
+it to detect a fresh epoch.
+
+Read protocol: schema check, per-segment sha256 verification (streamed
+— the verify pass doubles as the page-cache warm for the mmap), then
+``np.load(mmap_mode="r")`` and ONE device transfer per segment. No
+sort, no reductions, no build compile: loaded answers are byte-identical
+to a from-scratch build over the same points because the bytes ARE the
+built tree's. A checksum mismatch or schema skew raises a NAMED error
+(:class:`SnapshotCorruptError` / :class:`SnapshotSchemaError`) — a
+half-read mmap must never serve.
+
+The delta buffer of a mutable engine is deliberately NOT snapshotted:
+a snapshot captures one epoch's compacted main tree, and the manifest
+records which epoch that is (``epoch``). Replicas converge by adopting
+the next epoch's snapshot, not by replaying writes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kdtree_tpu import obs
+from kdtree_tpu.obs import flight
+
+SNAPSHOT_SCHEMA = 1
+MANIFEST_NAME = "MANIFEST.json"
+# the MortonTree pytree leaves, in tree_flatten order
+_SEGMENTS = ("node_lo", "node_hi", "bucket_pts", "bucket_gid")
+_HASH_CHUNK = 1 << 22  # 4 MiB streaming-checksum window
+
+
+class SnapshotError(Exception):
+    """Base class for snapshot load/save failures — callers that want
+    to fall back to a from-scratch rebuild catch exactly this."""
+
+
+class SnapshotSchemaError(SnapshotError):
+    """The manifest's schema version is not one this code reads."""
+
+
+class SnapshotCorruptError(SnapshotError):
+    """A segment is missing, truncated, or fails its checksum — the
+    snapshot must not serve."""
+
+
+def resolve_dir(path: str) -> str:
+    """Resolve a snapshot directory path. Relative paths resolve under
+    ``KDTREE_TPU_SNAPSHOT_DIR`` when it is set — the per-run isolation
+    hook tests/CI use so snapshot litter can never land in the working
+    tree. Absolute paths (and relative ones with the env unset) pass
+    through unchanged. The result is ABSOLUTE whenever the base
+    applies, so resolving twice (the follower stores a resolved dir
+    and load_snapshot resolves again) is idempotent even under a
+    relative base — without that, 'snapshots' + 'dir' re-resolved to
+    'snapshots/snapshots/dir' and a follower never converged."""
+    base = os.environ.get("KDTREE_TPU_SNAPSHOT_DIR")
+    if base and not os.path.isabs(path):
+        return os.path.abspath(os.path.join(base, path))
+    return path
+
+
+def _manifest_path(dirpath: str) -> str:
+    return os.path.join(dirpath, MANIFEST_NAME)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_HASH_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class _HashingWriter:
+    """File-object shim that hashes every byte as it is written, so the
+    save path computes each segment's checksum DURING the write instead
+    of re-reading hundreds of MB back per epoch emit. (The load side's
+    streamed re-hash stays — there it doubles as the page-cache warm.)
+    Not a real file object on purpose: np.save's isfileobj check then
+    takes the buffered fp.write path, which is the one that feeds us."""
+
+    def __init__(self, f) -> None:
+        self._f = f
+        self.h = hashlib.sha256()
+
+    def write(self, data) -> int:
+        self.h.update(data)
+        return self._f.write(data)
+
+
+def _count_load_error(reason: str) -> None:
+    obs.get_registry().counter(
+        "kdtree_snapshot_load_errors_total", labels={"reason": reason}
+    ).inc()
+
+
+def _load_error(exc: SnapshotError, reason: str,
+                dirpath: str) -> SnapshotError:
+    """Count + flight-record one failed load, then return the exception
+    for the caller to raise — every load failure is an incident-shaped
+    event (the fallback-to-rebuild path dumps context from here)."""
+    _count_load_error(reason)
+    flight.record("snapshot.load_error", dir=dirpath, reason=reason,
+                  error=str(exc)[:200])
+    return exc
+
+
+def plan_keys_for(tree, k: int, max_batch: int = 1024,
+                  min_bucket: Optional[int] = None) -> List[str]:
+    """The plan-store keys a server over this snapshot warms on its
+    ladder (docs/TUNING.md): one signature per pow2 warmup bucket.
+    Advisory manifest metadata — a replica fleet can pre-ship the
+    matching plan profiles so even the FIRST batch after a blue/green
+    swap dispatches warm."""
+    from kdtree_tpu.serve.batcher import MIN_BUCKET, batch_bucket
+    from kdtree_tpu.tuning.store import _pow2_ceil, make_signature
+
+    import jax
+
+    max_batch = _pow2_ceil(int(max_batch))
+    lo = batch_bucket(1, max_batch, MIN_BUCKET if min_bucket is None
+                      else min_bucket)
+    buckets = []
+    b = lo
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    kk = min(int(k), int(tree.n_real))
+    return [
+        make_signature(
+            q, tree.dim, tree.n_real, kk, tree.bucket_size,
+            tree.num_buckets, devices=1, backend=jax.default_backend(),
+        ).key
+        for q in buckets
+    ]
+
+
+def read_manifest(dirpath: str) -> Optional[dict]:
+    """Parse the manifest, or None when the directory holds none (or a
+    torn/unparseable one — the follower treats that as 'nothing new
+    yet', and an actual load attempt reports it crisply)."""
+    try:
+        with open(_manifest_path(dirpath)) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) else None
+
+
+def _cleanup_stale_segments(dirpath: str, keep_tag: str) -> None:
+    """Best-effort removal of segment files from superseded saves —
+    every save replaces the whole set, so only the manifest's own tag
+    survives (the checkpoint module's crashed-re-save discipline)."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return
+    for fname in names:
+        if (fname.startswith("seg-") and fname.endswith(".npy")
+                and f"-{keep_tag}." not in fname):
+            try:
+                os.remove(os.path.join(dirpath, fname))
+            except OSError:
+                pass
+
+
+def save_snapshot(
+    dirpath: str,
+    tree,
+    epoch: int = 0,
+    id_offset: int = 0,
+    plan_keys: Optional[List[str]] = None,
+    meta: Optional[dict] = None,
+) -> dict:
+    """Serialize a built Morton serving index into ``dirpath``; returns
+    the manifest dict (its ``version`` is the previous manifest's + 1).
+
+    Only :class:`~kdtree_tpu.ops.morton.MortonTree` is snapshotable —
+    it IS the serving representation; adapt other kinds through
+    ``serve.lifecycle.tree_for_serving`` first (crisp ``TypeError``
+    otherwise, same contract as serving itself)."""
+    from kdtree_tpu.ops.morton import MortonTree
+
+    if not isinstance(tree, MortonTree):
+        raise TypeError(
+            f"snapshots hold the Morton serving index, got "
+            f"{type(tree).__name__} — adapt it with "
+            "serve.lifecycle.tree_for_serving first"
+        )
+    dirpath = resolve_dir(dirpath)
+    t0 = time.perf_counter()
+    os.makedirs(dirpath, exist_ok=True)
+    prev = read_manifest(dirpath)
+    version = int(prev.get("version", 0)) + 1 if prev else 1
+    tag = uuid.uuid4().hex[:8]
+    segments: Dict[str, dict] = {}
+    total_bytes = 0
+    for name in _SEGMENTS:
+        arr = np.asarray(getattr(tree, name))
+        fname = f"seg-{name}-{tag}.npy"
+        fpath = os.path.join(dirpath, fname)
+        tmp = f"{fpath}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                w = _HashingWriter(f)
+                np.save(w, arr)
+            os.replace(tmp, fpath)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        nbytes = os.path.getsize(fpath)
+        total_bytes += nbytes
+        segments[name] = {
+            "file": fname,
+            "sha256": w.h.hexdigest(),
+            "bytes": nbytes,
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    manifest = {
+        "schema": SNAPSHOT_SCHEMA,
+        "version": version,
+        "epoch": int(epoch),
+        "id_offset": int(id_offset),
+        "kind": "morton",
+        "signature": {
+            "n_real": int(tree.n_real),
+            "num_levels": int(tree.num_levels),
+            "dim": int(tree.dim),
+            "num_buckets": int(tree.num_buckets),
+            "bucket_size": int(tree.bucket_size),
+            "heap_size": int(tree.heap_size),
+        },
+        "segments": segments,
+        "plan_keys": list(plan_keys or []),
+        "created_unix": round(time.time(), 3),
+        "meta": dict(meta or {}),
+    }
+    tmp = f"{_manifest_path(dirpath)}.tmp-{tag}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, _manifest_path(dirpath))
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _cleanup_stale_segments(dirpath, keep_tag=tag)
+    dt = time.perf_counter() - t0
+    reg = obs.get_registry()
+    reg.counter("kdtree_snapshot_saves_total").inc()
+    reg.gauge("kdtree_snapshot_version").set(version)
+    reg.gauge("kdtree_snapshot_epoch").set(int(epoch))
+    reg.gauge("kdtree_snapshot_bytes").set(total_bytes)
+    reg.gauge("kdtree_snapshot_save_seconds").set(round(dt, 6))
+    flight.record("snapshot.save", dir=dirpath, version=version,
+                  epoch=int(epoch), n=int(tree.n_real),
+                  bytes=total_bytes, seconds=round(dt, 3))
+    return manifest
+
+
+def _read_manifest_strict(dirpath: str) -> dict:
+    mpath = _manifest_path(dirpath)
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except OSError as e:
+        raise _load_error(
+            SnapshotError(f"no snapshot manifest at {mpath}: {e}"),
+            "missing", dirpath,
+        ) from None
+    except ValueError as e:
+        raise _load_error(
+            SnapshotCorruptError(f"manifest {mpath} is not JSON: {e}"),
+            "manifest", dirpath,
+        ) from None
+    if not isinstance(man, dict) or "segments" not in man:
+        raise _load_error(
+            SnapshotCorruptError(f"manifest {mpath} is not a snapshot "
+                                 "manifest (no 'segments')"),
+            "manifest", dirpath,
+        )
+    schema = man.get("schema")
+    if schema != SNAPSHOT_SCHEMA:
+        raise _load_error(
+            SnapshotSchemaError(
+                f"snapshot {dirpath} has schema {schema!r}; this build "
+                f"reads schema {SNAPSHOT_SCHEMA} — rebuild the snapshot "
+                "with a matching kdtree-tpu"
+            ),
+            "schema", dirpath,
+        )
+    return man
+
+
+def load_snapshot(
+    dirpath: str, verify: bool = True,
+) -> Tuple[object, dict]:
+    """Load a snapshot into a ready-to-serve
+    :class:`~kdtree_tpu.ops.morton.MortonTree`; returns
+    ``(tree, manifest)``.
+
+    Every segment is checksum-verified BEFORE any of it is handed to
+    the engine (``verify=False`` skips the hash for trusted local
+    hand-offs, e.g. the follower re-loading a file set this process
+    just wrote and verified), then read through ``np.load(mmap_mode=
+    "r")`` and transferred to the device once. Raises the named
+    :class:`SnapshotError` subclasses on any inconsistency — never
+    returns a partially-read index."""
+    import jax.numpy as jnp
+
+    dirpath = resolve_dir(dirpath)
+    t0 = time.perf_counter()
+    man = _read_manifest_strict(dirpath)
+    sig = man.get("signature", {})
+    arrays = {}
+    for name in _SEGMENTS:
+        seg = man["segments"].get(name)
+        if not isinstance(seg, dict) or "file" not in seg:
+            raise _load_error(
+                SnapshotCorruptError(
+                    f"snapshot {dirpath}: manifest lacks segment "
+                    f"{name!r}"),
+                "manifest", dirpath,
+            )
+        fpath = os.path.join(dirpath, seg["file"])
+        try:
+            size = os.path.getsize(fpath)
+        except OSError as e:
+            raise _load_error(
+                SnapshotCorruptError(
+                    f"snapshot {dirpath}: segment {seg['file']} "
+                    f"unreadable ({e}) — a snapshot is the manifest "
+                    "plus its seg-*.npy files and must be copied as a "
+                    "set"),
+                "segment", dirpath,
+            ) from None
+        if size != int(seg.get("bytes", -1)):
+            raise _load_error(
+                SnapshotCorruptError(
+                    f"snapshot {dirpath}: segment {seg['file']} is "
+                    f"{size} bytes, manifest says {seg.get('bytes')} "
+                    "(truncated or torn write)"),
+                "checksum", dirpath,
+            )
+        if verify:
+            digest = _sha256_file(fpath)
+            if digest != seg.get("sha256"):
+                raise _load_error(
+                    SnapshotCorruptError(
+                        f"snapshot {dirpath}: segment {seg['file']} "
+                        f"fails its sha256 check (have {digest[:12]}…, "
+                        f"manifest {str(seg.get('sha256'))[:12]}…)"),
+                    "checksum", dirpath,
+                )
+        try:
+            arr = np.load(fpath, mmap_mode="r")
+        except ValueError as e:
+            raise _load_error(
+                SnapshotCorruptError(
+                    f"snapshot {dirpath}: segment {seg['file']} is not "
+                    f"a readable .npy ({e})"),
+                "segment", dirpath,
+            ) from None
+        if list(arr.shape) != list(seg.get("shape", [])) or \
+                str(arr.dtype) != seg.get("dtype"):
+            raise _load_error(
+                SnapshotCorruptError(
+                    f"snapshot {dirpath}: segment {seg['file']} has "
+                    f"shape {arr.shape}/{arr.dtype}, manifest says "
+                    f"{seg.get('shape')}/{seg.get('dtype')}"),
+                "segment", dirpath,
+            )
+        # ONE device transfer per segment; the mmap means the host never
+        # holds a second buffered copy alongside it
+        arrays[name] = jnp.asarray(arr)
+    from kdtree_tpu.ops.morton import MortonTree
+
+    tree = MortonTree(
+        node_lo=arrays["node_lo"],
+        node_hi=arrays["node_hi"],
+        bucket_pts=arrays["bucket_pts"],
+        bucket_gid=arrays["bucket_gid"],
+        n_real=int(sig.get("n_real", 0)),
+        num_levels=int(sig.get("num_levels", 0)),
+    )
+    if tree.n_real <= 0 or tree.num_buckets != int(
+            sig.get("num_buckets", -1)):
+        raise _load_error(
+            SnapshotCorruptError(
+                f"snapshot {dirpath}: signature {sig!r} disagrees with "
+                "the loaded arrays"),
+            "manifest", dirpath,
+        )
+    dt = time.perf_counter() - t0
+    reg = obs.get_registry()
+    reg.counter("kdtree_snapshot_loads_total").inc()
+    reg.gauge("kdtree_snapshot_version").set(int(man.get("version", 0)))
+    reg.gauge("kdtree_snapshot_epoch").set(int(man.get("epoch", 0)))
+    reg.gauge("kdtree_snapshot_load_seconds").set(round(dt, 6))
+    flight.record("snapshot.load", dir=dirpath,
+                  version=int(man.get("version", 0)),
+                  epoch=int(man.get("epoch", 0)), n=int(tree.n_real),
+                  seconds=round(dt, 3))
+    return tree, man

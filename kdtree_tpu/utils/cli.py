@@ -617,6 +617,10 @@ def cmd_build(args) -> None:
 
     dist = getattr(args, "distribution", "uniform")
     _check_distribution(args.engine, dist)
+    if not args.out and not getattr(args, "save", None):
+        print("build needs --out FILE (npz checkpoint) and/or --save DIR "
+              "(serving snapshot)", file=sys.stderr)
+        sys.exit(1)
     if getattr(args, "points", None):
         # user data, not a seeded problem: build over an arbitrary point set
         # (the reference can only generate; a framework must also ingest)
@@ -754,21 +758,44 @@ def cmd_build(args) -> None:
         tree = _build_tree_for_engine(points, args.engine, args.devices)
         n, dim = points.shape
         meta = {"seed": args.seed, "generator": gen_used}
-    try:
-        fmt = save_tree(args.out, tree, meta=meta,
-                        sharded=True if getattr(args, "sharded", False) else None)
-    except TypeError as e:
-        # --sharded with an engine whose tree has no device axis: the same
-        # crisp stderr + exit-code contract as the other validation branches
-        print(f"cannot save sharded: {e}", file=sys.stderr)
-        sys.exit(1)
-    suffix = ""
-    if fmt == "sharded":
-        # the checkpoint is NOT one self-contained file — say so, or the
-        # next person copies just the manifest to another machine
-        suffix = f" (+ per-device shard files {args.out}.shard*.npz)"
-    print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to {args.out}"
-          f"{suffix}")
+    if args.out:
+        try:
+            fmt = save_tree(args.out, tree, meta=meta,
+                            sharded=True if getattr(args, "sharded", False)
+                            else None)
+        except TypeError as e:
+            # --sharded with an engine whose tree has no device axis: the
+            # same crisp stderr + exit-code contract as the other branches
+            print(f"cannot save sharded: {e}", file=sys.stderr)
+            sys.exit(1)
+        suffix = ""
+        if fmt == "sharded":
+            # the checkpoint is NOT one self-contained file — say so, or
+            # the next person copies just the manifest to another machine
+            suffix = f" (+ per-device shard files {args.out}.shard*.npz)"
+        print(f"saved {type(tree).__name__} (n={n}, dim={dim}) to "
+              f"{args.out}{suffix}")
+    if getattr(args, "save", None):
+        # serving snapshot (docs/SERVING.md "Snapshots & replica
+        # fleets"): the built index's device arrays as checksummed flat
+        # .npy segments + a versioned manifest, so `serve --snapshot`
+        # replicas cold-start in seconds without re-running the build
+        from kdtree_tpu import snapshot as snap
+        from kdtree_tpu.serve.lifecycle import tree_for_serving
+
+        try:
+            serving = tree_for_serving(tree)
+        except TypeError as e:
+            print(f"cannot snapshot: {e}", file=sys.stderr)
+            sys.exit(1)
+        man = snap.save_snapshot(
+            args.save, serving, epoch=0,
+            plan_keys=snap.plan_keys_for(serving, k=16),
+            meta=dict(meta),
+        )
+        print(f"serving snapshot v{man['version']} (epoch "
+              f"{man['epoch']}, n={man['signature']['n_real']}) saved "
+              f"to {snap.resolve_dir(args.save)}")
 
 
 def cmd_query(args) -> None:
@@ -854,14 +881,98 @@ def cmd_serve(args) -> None:
 
     from kdtree_tpu.serve import lifecycle, server as srv
 
+    snap_dir = getattr(args, "snapshot", None)
+    follow_s = getattr(args, "snapshot_follow", None)
+    save_dir = getattr(args, "snapshot_save", None)
     sources = [s for s in (args.index, args.points) if s]
-    if len(sources) > 1:
-        print("serve needs ONE index source: --index, --points, or the "
-              "seeded --seed/--dim/--n problem", file=sys.stderr)
+    if len(sources) > 1 or (args.index and snap_dir):
+        print("serve needs ONE index source: --snapshot, --index, "
+              "--points, or the seeded --seed/--dim/--n problem "
+              "(--snapshot may pair with --points as the corruption "
+              "fallback)", file=sys.stderr)
+        sys.exit(1)
+    if follow_s is not None and not snap_dir:
+        print("--snapshot-follow needs --snapshot DIR (the manifest the "
+              "secondary polls)", file=sys.stderr)
+        sys.exit(1)
+    if follow_s is not None and save_dir:
+        print("--snapshot-follow and --snapshot-save are exclusive: a "
+              "secondary adopts snapshots, only the shard primary emits "
+              "them", file=sys.stderr)
         sys.exit(1)
     tree = points = problem = None
     meta = {}
-    if args.index:
+    epoch0 = 0
+    loaded_version = 0
+    loaded_from_snapshot = False
+    # an explicit --id-offset always wins; a snapshot of a non-zero-
+    # offset shard carries its partition start in the manifest, and a
+    # replica cold-started without the flag must inherit it — an
+    # offset-0 default would overlap shard 0's id range in the
+    # router's owner table
+    id_offset = args.id_offset if args.id_offset is not None else 0
+    if snap_dir:
+        from kdtree_tpu import snapshot as snap
+
+        try:
+            tree, man = snap.load_snapshot(snap_dir)
+            epoch0 = int(man.get("epoch", 0))
+            loaded_version = int(man.get("version", 0))
+            loaded_from_snapshot = True
+            if args.id_offset is None and man.get("id_offset"):
+                id_offset = int(man["id_offset"])
+                print(f"id_offset {id_offset} inherited from the "
+                      "snapshot manifest (pass --id-offset to "
+                      "override)", file=sys.stderr)
+            meta = {"snapshot": {
+                "dir": snap.resolve_dir(snap_dir),
+                "version": loaded_version,
+                "epoch": epoch0,
+                "role": ("secondary" if follow_s is not None
+                         else "primary" if save_dir else "static"),
+            }}
+            print(f"snapshot loaded: v{loaded_version} epoch {epoch0} "
+                  f"(n={tree.n_real}) from {snap.resolve_dir(snap_dir)}",
+                  file=sys.stderr)
+        except snap.SnapshotError as e:
+            # named failure (schema skew / checksum mismatch / missing
+            # segment — never a half-read mmap), already counted in
+            # kdtree_snapshot_load_errors_total + flight-recorded by
+            # the store. Fall back to a from-source rebuild when one
+            # was provided; otherwise fail crisply.
+            if args.points or getattr(args, "snapshot_fallback", False):
+                src = "--points" if args.points else "the seeded problem"
+                print(f"snapshot load failed: {e}", file=sys.stderr)
+                print(f"falling back to a from-scratch rebuild from "
+                      f"{src} (--snapshot-fallback contract)",
+                      file=sys.stderr)
+                meta = {"snapshot": {
+                    "dir": snap.resolve_dir(snap_dir),
+                    "role": "fallback-rebuild",
+                    "error": str(e)[:200],
+                    # pre-seed the keys the follower's on-adopt hook
+                    # updates: this dict is shared with the /healthz
+                    # body, and ADDING keys during a concurrent
+                    # json.dumps raises "dictionary changed size";
+                    # overwriting existing values does not
+                    "version": 0,
+                    "epoch": 0,
+                }}
+                if args.points:
+                    points = _load_array(args.points, "points")
+                    meta["points"] = args.points
+                else:
+                    problem = (args.seed, args.dim, args.n)
+                    meta.update(seed=args.seed, generator="threefry")
+            else:
+                print(f"cannot load snapshot {snap_dir}: {e}",
+                      file=sys.stderr)
+                print("hint: pass --points FILE (or --snapshot-fallback "
+                      "with the seeded --seed/--dim/--n) to rebuild "
+                      "from source when the snapshot is unusable",
+                      file=sys.stderr)
+                sys.exit(1)
+    elif args.index:
         from kdtree_tpu.utils.checkpoint import load_tree
 
         try:
@@ -879,18 +990,47 @@ def cmd_serve(args) -> None:
                   file=sys.stderr)
         problem = (args.seed, args.dim, args.n)
         meta = {"seed": args.seed, "generator": "threefry"}
+    snapshot_sink = None
+    if save_dir:
+        from kdtree_tpu import snapshot as snap
+
+        def snapshot_sink(tree_, epoch, _dir=save_dir,
+                          _off=id_offset, _k=args.k,
+                          _mb=args.max_batch):
+            snap.save_snapshot(
+                _dir, tree_, epoch=epoch, id_offset=_off,
+                plan_keys=snap.plan_keys_for(tree_, _k, _mb),
+            )
     try:
         state = lifecycle.build_state(
             tree=tree, points=points, problem=problem, k=args.k,
             max_batch=args.max_batch, meta=meta,
-            id_offset=args.id_offset,
+            id_offset=id_offset,
             max_delta_rows=args.max_delta_rows,
             max_delta_frac=args.max_delta_frac,
+            read_only=follow_s is not None,
+            epoch0=epoch0,
+            snapshot_sink=snapshot_sink,
         )
     except TypeError as e:
         # un-servable checkpoint kind — crisp stderr + exit code (C10)
         print(f"cannot serve: {e}", file=sys.stderr)
         sys.exit(1)
+    if save_dir:
+        # primary bootstrap emit: make the save dir's artifact match the
+        # epoch this process serves, so secondaries can cold-start from
+        # it immediately. Skipped only when this process just loaded the
+        # identical content from the same dir.
+        from kdtree_tpu import snapshot as snap
+
+        same = (loaded_from_snapshot and snap_dir
+                and snap.resolve_dir(snap_dir) == snap.resolve_dir(save_dir))
+        if not same or snap.read_manifest(snap.resolve_dir(save_dir)) is None:
+            snapshot_sink(state.engine.tree, state.engine.epoch)
+            print(f"serving snapshot emitted to "
+                  f"{snap.resolve_dir(save_dir)} (epoch "
+                  f"{state.engine.epoch}); epoch rebuilds re-emit on "
+                  "every swap", file=sys.stderr)
     try:
         httpd = srv.make_server(
             state, host=args.host, port=args.port,
@@ -938,10 +1078,35 @@ def cmd_serve(args) -> None:
         # holding the process open with /healthz stuck at 503 forever
         httpd.stop()
         raise
+    follower = None
+    if follow_s is not None:
+        # blue/green secondary: poll the snapshot manifest, adopt new
+        # versions (load -> pre-warm -> atomic engine swap), report the
+        # adopted epoch on /healthz. Started AFTER warmup so the adopt
+        # pre-warms exactly the batch shapes serving compiled.
+        from kdtree_tpu.snapshot import SnapshotFollower
+
+        snap_block = state.meta.setdefault("snapshot", {})
+
+        def _on_adopt(man, _blk=snap_block):
+            _blk["version"] = int(man.get("version", 0))
+            _blk["epoch"] = int(man.get("epoch", 0))
+
+        follower = SnapshotFollower(
+            state.engine, snap_dir, poll_s=follow_s,
+            start_version=loaded_version, on_adopt=_on_adopt,
+        )
+        follower.start()
+        print(f"snapshot follower armed: polling {follower.dir} every "
+              f"{follower.poll_s:g}s for blue/green epoch swaps "
+              "(this replica is read-only — writes 403)",
+              file=sys.stderr)
     print(f"ready: POST /v1/knn, GET /healthz, GET /metrics on port "
           f"{port}", file=sys.stderr)
     stop.wait()
     print("shutting down: draining in-flight requests...", file=sys.stderr)
+    if follower is not None:
+        follower.stop()
     httpd.stop()
     print("drained; bye", file=sys.stderr)
 
@@ -1488,7 +1653,16 @@ def main(argv=None) -> None:
                     help="scale-engine exchange capacity factor (the "
                          "'capacity overflow ... retry with slack > X' "
                          "errors name this as the remedy)")
-    bu.add_argument("--out", required=True)
+    bu.add_argument("--out", default=None,
+                    help="npz checkpoint path (required unless --save "
+                         "is given)")
+    bu.add_argument("--save", default=None, metavar="DIR",
+                    help="also write a versioned SERVING snapshot "
+                         "(checksummed flat .npy segments + manifest) "
+                         "that `serve --snapshot DIR` replicas "
+                         "mmap-load in seconds — the replica-fleet "
+                         "cold-start artifact (docs/SERVING.md "
+                         "\"Snapshots & replica fleets\")")
     bu.add_argument("--sharded", action="store_true",
                     help="force the per-device shard checkpoint format "
                          "(forest engines auto-shard above 1 GiB)")
@@ -1544,11 +1718,13 @@ def main(argv=None) -> None:
     sv.add_argument("--queue-depth", type=int, default=None, metavar="ROWS",
                     help="admission budget in query rows; beyond it "
                          "requests shed with 429 (default 4x max-batch)")
-    sv.add_argument("--id-offset", type=int, default=0, metavar="ROWS",
+    sv.add_argument("--id-offset", type=int, default=None, metavar="ROWS",
                     help="sharded serving: this process holds rows "
                          "[offset, offset+n) of a partitioned point set "
                          "and answers GLOBAL ids (local id + offset); "
-                         "the route subcommand's merge depends on it")
+                         "the route subcommand's merge depends on it. "
+                         "Default 0, or the --snapshot manifest's "
+                         "recorded offset when loading one")
     sv.add_argument("--max-delta-rows", type=int, default=None,
                     metavar="ROWS",
                     help="mutable index: epoch rebuild triggers when the "
@@ -1561,6 +1737,29 @@ def main(argv=None) -> None:
                          "write backlog reaches this fraction of the "
                          "main tree (default 0.25; <= 0 disables this "
                          "bound; the tighter of the two bounds wins)")
+    sv.add_argument("--snapshot", default=None, metavar="DIR",
+                    help="load the index from a serving snapshot "
+                         "(`build --save` / a primary's epoch emits): "
+                         "checksum-verified, mmap-read, ready in "
+                         "seconds — no rebuild. Pairs with --points "
+                         "or --snapshot-fallback as the corruption "
+                         "fallback (docs/SERVING.md)")
+    sv.add_argument("--snapshot-save", default=None, metavar="DIR",
+                    help="shard PRIMARY: emit a snapshot at startup and "
+                         "re-emit on every epoch rebuild swap — the "
+                         "blue/green artifact secondaries adopt")
+    sv.add_argument("--snapshot-follow", type=float, default=None,
+                    metavar="SECONDS",
+                    help="read SECONDARY: poll --snapshot DIR's "
+                         "manifest at this period and blue/green-swap "
+                         "new versions in (load -> warm -> atomic "
+                         "engine swap; /healthz reports the adopted "
+                         "epoch). Implies read-only — writes 403")
+    sv.add_argument("--snapshot-fallback", action="store_true",
+                    help="on snapshot load failure (checksum/schema), "
+                         "rebuild from the seeded --seed/--dim/--n "
+                         "problem instead of exiting (--points falls "
+                         "back automatically)")
     sv.add_argument("--debug-faults", action="store_true",
                     help="arm POST /debug/faults (live fault injection, "
                          "docs/SERVING.md) — a remote wedge-this-process "
@@ -1577,7 +1776,13 @@ def main(argv=None) -> None:
     )
     ro.add_argument("--shard", action="append", metavar="URL",
                     help="shard serve process base url (http://host:port); "
-                         "repeat the flag or comma-separate")
+                         "repeat the flag or comma-separate. A shard "
+                         "entry may be a REPLICA SET — "
+                         "'primary|replica1|replica2' — reads "
+                         "load-balance across replicas, writes go to "
+                         "the first (primary) url "
+                         "(docs/SERVING.md \"Snapshots & replica "
+                         "fleets\")")
     ro.add_argument("--host", default="127.0.0.1")
     ro.add_argument("--port", type=int, default=8081,
                     help="TCP port (0 = ephemeral, printed on stderr)")

@@ -32,6 +32,15 @@ if "KDTREE_TPU_FLIGHT_DIR" not in os.environ:
         prefix="kdtree-tpu-flight-"
     )
 
+# Serving snapshots (docs/SERVING.md "Snapshots & replica fleets"):
+# relative snapshot dirs resolve under this base, so a test (or a serve
+# subprocess a test spawns) that names a bare "snapdir" can never litter
+# the working tree — same per-run isolation as the plan store above.
+if "KDTREE_TPU_SNAPSHOT_DIR" not in os.environ:
+    os.environ["KDTREE_TPU_SNAPSHOT_DIR"] = tempfile.mkdtemp(
+        prefix="kdtree-tpu-snapshots-"
+    )
+
 # And the lock-order sanitizer's graph artifacts (docs/OBSERVABILITY.md
 # "Concurrency sanitizer"): when CI runs tier-1 under
 # KDTREE_TPU_LOCKWATCH=1 it sets the dir explicitly so it can assert

@@ -143,6 +143,16 @@ def test_prom_scrape_parsing_sums_across_extra_labels():
         parsed, "kdtree_write_latency_ms_count", 'op="upsert"') == 8
     assert lg_runner._sum_series(parsed, "kdtree_epoch") == 2
     assert lg_runner._sum_series(parsed, "kdtree_missing") is None
+    # stateful gauges federate as one series PER shard/replica: the
+    # fleet summary is the max (six replicas at epoch 1 are not
+    # "epoch 6"), which is what scrape_server_block publishes
+    multi = lg_runner._parse_prom_lines("\n".join([
+        'kdtree_epoch{shard="0"} 1',
+        'kdtree_epoch{shard="0",replica="1"} 1',
+        'kdtree_epoch{shard="1"} 2',
+    ]))
+    assert lg_runner._max_series(multi, "kdtree_epoch") == 2
+    assert lg_runner._max_series(multi, "kdtree_missing") is None
 
 
 # ---------------------------------------------------------------------------
@@ -369,3 +379,33 @@ def test_e2e_capacity_block_with_write_mix_and_fault_knee_drop(
     base = tr.load_baseline("trend_baseline.json")
     assert any(f["rule"] == "capacity-drop"
                for f in tr.partition(findings, base))
+
+
+def test_compute_knee_rejects_unsupported_quantile():
+    """The PR 12 satellite contract: a quantile the steps don't report
+    is a ValueError naming the supported set — never a silent fall-back
+    to p99 that contradicts the slo_quantile the artifact publishes."""
+    steps = [{"rate": 10, "sent": 20, "p50_ms": 20.0, "p95_ms": 30.0,
+              "p99_ms": 50.0, "bad_frac": 0.0}]
+    for q in (0.9, 0.999, 0.0, 1.0):
+        with pytest.raises(ValueError, match="0.5 / 0.95 / 0.99"):
+            lg_runner.compute_knee(steps, slo_ms=250, slo_quantile=q)
+    # the supported set passes
+    for q in (0.5, 0.95, 0.99):
+        assert lg_runner.compute_knee(steps, slo_ms=250,
+                                      slo_quantile=q) == 10.0
+
+
+def test_cli_rejects_unsupported_slo_quantile(capsys):
+    """`kdtree-tpu loadgen --slo-quantile 0.9` fails BEFORE the sweep
+    (and before the target is ever contacted — the bogus port proves
+    it), with a crisp error naming the supported set."""
+    from kdtree_tpu.utils import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["loadgen", "--target", "http://127.0.0.1:9",
+                  "--rates", "10", "--slo-quantile", "0.9"])
+    assert e.value.code == 1
+    err = capsys.readouterr().err
+    assert "--slo-quantile must be 0.5, 0.95, or 0.99" in err
+    assert "0.9" in err

@@ -1039,3 +1039,220 @@ def test_route_cli_needs_shards(capsys):
         cli.main(["route"])
     assert e.value.code == 1
     assert "--shard" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# replica sets (docs/SERVING.md "Snapshots & replica fleets")
+# ---------------------------------------------------------------------------
+
+
+class Replicas:
+    """One shard's replica set: N in-process servers over the SAME
+    partition (replica 0 is the primary; the rest are read-only)."""
+
+    def __init__(self, points, n_replicas=3):
+        self.servers = []
+        self.faults = []
+        self.urls = []
+        for j in range(n_replicas):
+            state = lifecycle.build_state(
+                points=points, k=K, max_batch=64,
+                read_only=j > 0,
+            )
+            fset = faults_mod.FaultSet()
+            httpd = srv.make_server(state, port=0, faults=fset)
+            httpd.start(warmup_buckets=[8])
+            self.servers.append(httpd)
+            self.faults.append(fset)
+            self.urls.append(
+                f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    @property
+    def entry(self):
+        return "|".join(self.urls)
+
+    def stop(self):
+        for f in self.faults:
+            f.clear()
+        for httpd in self.servers:
+            httpd.stop()
+
+
+@pytest.fixture(scope="module")
+def replica_points(points):
+    return points[:SHARD_N]
+
+
+@pytest.fixture(scope="module")
+def replicas(replica_points):
+    reps = Replicas(replica_points)
+    yield reps
+    reps.stop()
+
+
+@pytest.fixture(scope="module")
+def replica_oracle(replica_points):
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import build_morton
+
+    return build_morton(jnp.asarray(replica_points))
+
+
+@contextlib.contextmanager
+def replica_router(reps, health_loop=False, **cfg):
+    defaults = dict(deadline_s=30.0, retries=2, backoff_base_s=0.01,
+                    hedge_min_s=5.0, breaker_failures=2,
+                    breaker_reset_s=0.3, health_period_s=0.2)
+    defaults.update(cfg)
+    router = rt.make_router([reps.entry],
+                            config=rt.RouterConfig(**defaults))
+    router.start(health_loop=health_loop)
+    try:
+        yield router
+    finally:
+        router.stop()
+
+
+def test_replica_reads_spread_and_byte_identical(
+    replicas, replica_oracle,
+):
+    """ONE shard set, three replicas: every routed answer is the
+    single-index oracle's (exactness dedupe is by shard ownership — a
+    replica set can never duplicate a point), reads round-robin over
+    all three replicas, and the shard-count gauge counts SETS."""
+    qs = _queries(6, seed=31)
+    od, oi = _oracle(replica_oracle, qs, K)
+    with replica_router(replicas) as router:
+        for _ in range(9):
+            status, out = _post(router, {"queries": qs.tolist(), "k": K})
+            assert status == 200
+            assert out["degraded"] is None
+            assert out["ids"] == oi and out["distances"] == od
+            assert out["shards"]["total"] == 1
+        gauges = obs.get_registry().snapshot()["gauges"]
+        assert gauges["kdtree_router_shards"] == 1
+        assert gauges['kdtree_router_replicas{shard="0"}'] == 3
+        for j in range(3):
+            assert _counter(
+                "kdtree_router_replica_requests_total"
+                f'{{replica="{j}",shard="0"}}') > 0, j
+        status, report = _get(router, "/debug/shards")
+        assert status == 200
+        (entry,) = report["shards"]
+        assert len(entry["replicas"]) == 3
+        assert entry["routable"] is True
+
+
+def test_replica_failure_fails_over_exact_not_partial(
+    replicas, replica_oracle,
+):
+    """One replica erroring is invisible to the caller: the retry
+    re-picks a sibling, the set still answers, and the result is the
+    FULL exact answer (not a partial) — losing a replica loses
+    capacity, never answer quality."""
+    qs = _queries(4, seed=32)
+    od, oi = _oracle(replica_oracle, qs, K)
+    replicas.faults[1].set_spec("knn=error:500*100")
+    try:
+        with replica_router(replicas) as router:
+            for _ in range(8):
+                status, out = _post(router,
+                                    {"queries": qs.tolist(), "k": K})
+                assert status == 200
+                assert out["degraded"] is None
+                assert out["ids"] == oi and out["distances"] == od
+    finally:
+        replicas.faults[1].clear()
+
+
+def test_replica_all_down_breaker_open_crisp_503(replicas):
+    """Every replica refusing = the SET is down: below quorum, crisp
+    503 naming the shard — never a silent wrong answer."""
+    for f in replicas.faults:
+        f.set_spec("knn=error:500*100")
+    try:
+        with replica_router(replicas, retries=1) as router:
+            status = None
+            for _ in range(6):
+                status, out = _post(router, {
+                    "queries": _queries(2).tolist(), "k": K})
+                if status == 503:
+                    break
+            assert status == 503
+    finally:
+        for f in replicas.faults:
+            f.clear()
+        # let the breakers close again for the module's other tests
+        time.sleep(0.4)
+
+
+def test_replica_write_goes_to_primary_only(replicas):
+    """Writes partition to the shard PRIMARY (replica 0): secondaries
+    are read-only (403 writes), so a write routed anywhere else would
+    fail this request. The health loop must first learn the set's
+    id_offset from any replica."""
+    with replica_router(replicas, health_loop=True) as router:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                router._owner_table() is None:
+            time.sleep(0.05)
+        assert router._owner_table() is not None
+        url = (f"http://127.0.0.1:{router.server_address[1]}"
+               "/v1/upsert")
+        wid = SHARD_N + 777
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({"ids": [wid],
+                             "points": [[0.5] * DIM]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert out["applied"] == 1
+        # applied on the primary's engine, nowhere else
+        deltas = [s.state.engine.stats()["delta_rows"]
+                  for s in replicas.servers]
+        assert deltas[0] >= 1 and deltas[1:] == [0, 0]
+
+
+def test_replica_entry_validation():
+    with pytest.raises(ValueError, match="empty replica"):
+        rt.Router(("127.0.0.1", 0),
+                  ["http://127.0.0.1:1|"])
+    with pytest.raises(ValueError, match="http"):
+        rt.Router(("127.0.0.1", 0),
+                  ["http://127.0.0.1:1|ftp://x"])
+
+
+def test_cross_replica_hedge_win_fails_over_wedged_replica(
+    replicas, replica_oracle,
+):
+    """Breaker accounting lands on the replica that ANSWERED: a picked
+    replica whose sibling had to rescue the request via the hedge gets
+    a failure mark, so a wedged process opens its breaker instead of
+    absorbing ~1/R of the reads at full hedge cost forever."""
+    qs = _queries(2, seed=33)
+    od, oi = _oracle(replica_oracle, qs, K)
+    # replica 1 answers, but only after 1.5s — far past the 50ms hedge
+    # floor, so every pick of it is rescued by a sibling
+    replicas.faults[1].set_spec("knn=latency:1500*100")
+    try:
+        with replica_router(replicas, hedge_min_s=0.05, retries=0,
+                            breaker_failures=1) as router:
+            opened = False
+            for _ in range(8):
+                status, out = _post(router,
+                                    {"queries": qs.tolist(), "k": K})
+                assert status == 200
+                assert out["ids"] == oi and out["distances"] == od
+                _, report = _get(router, "/debug/shards")
+                states = [r["breaker"]
+                          for r in report["shards"][0]["replicas"]]
+                if states[1] == "open":
+                    opened = True
+                    break
+            assert opened, "wedged replica's breaker never opened"
+    finally:
+        replicas.faults[1].clear()
+        time.sleep(0.4)  # let the breaker cooldown pass for later tests

@@ -1,0 +1,640 @@
+"""Index snapshots & replica fleets (docs/SERVING.md).
+
+Three layers of evidence:
+
+1. **Round-trip identity**: build → save → load (in this process AND in
+   a fresh one) gives bit-identical arrays and byte-identical query
+   answers — the snapshot IS the built structure, never a re-derivation.
+2. **Corruption honesty**: a flipped byte, a truncated segment, or a
+   schema skew refuses the load with the NAMED error and counts
+   ``kdtree_snapshot_load_errors_total`` — a half-read mmap never
+   serves; the serve CLI falls back to a from-source rebuild when one
+   was provided.
+3. **Blue/green fleet**: a primary's epoch compaction emits a snapshot
+   (delta NOT included; manifest records the epoch), a follower adopts
+   it with zero downtime, and the /healthz epoch converges.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import obs
+from kdtree_tpu import snapshot as snap
+from kdtree_tpu.mutable.engine import MutableEngine
+from kdtree_tpu.serve import lifecycle
+from kdtree_tpu.serve import server as srv
+from kdtree_tpu.snapshot import SnapshotFollower
+
+REPO = Path(__file__).resolve().parents[1]
+DIM, K, N = 3, 4, 4096
+SEED = 11
+_ARRAYS = ("node_lo", "node_hi", "bucket_pts", "bucket_gid")
+
+
+@pytest.fixture(scope="module")
+def points():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    return np.asarray(generate_points_rowwise(SEED, DIM, N))
+
+
+@pytest.fixture(scope="module")
+def tree(points):
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import build_morton
+
+    return build_morton(jnp.asarray(points))
+
+
+def _tiled(tree, queries, k=K):
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    d2, ids = morton_knn_tiled(tree, jnp.asarray(queries), k=k)
+    return np.asarray(d2), np.asarray(ids)
+
+
+def _counter_value(name: str) -> float:
+    return sum(v for key, v in obs.get_registry().snapshot()["counters"]
+               .items() if key.startswith(name))
+
+
+def _corrupt_segment(d, name="bucket_pts", offset=512):
+    seg = [f for f in os.listdir(d) if f.startswith(f"seg-{name}-")][0]
+    with open(os.path.join(d, seg), "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return os.path.join(d, seg)
+
+
+# ---------------------------------------------------------------------------
+# round-trip identity
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_bit_identical_arrays_and_answers(tree, points, tmp_path):
+    d = str(tmp_path / "snap")
+    man = snap.save_snapshot(d, tree, epoch=0,
+                             plan_keys=snap.plan_keys_for(tree, K))
+    assert man["version"] == 1
+    assert man["signature"]["n_real"] == N
+    assert man["plan_keys"]  # advisory warmup-ladder keys ride along
+    loaded, man2 = snap.load_snapshot(d)
+    assert man2["version"] == 1
+    for a in _ARRAYS:
+        assert np.array_equal(np.asarray(getattr(tree, a)),
+                              np.asarray(getattr(loaded, a))), a
+    assert (loaded.n_real, loaded.num_levels) == (tree.n_real,
+                                                  tree.num_levels)
+    q = points[:64]
+    od2, oids = _tiled(tree, q)
+    ld2, lids = _tiled(loaded, q)
+    # byte-identical, not allclose: the snapshot serves the SAME index
+    assert np.array_equal(od2, ld2) and np.array_equal(oids, lids)
+
+
+def test_version_increments_and_stale_segments_cleaned(tree, tmp_path):
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree, epoch=0)
+    man2 = snap.save_snapshot(d, tree, epoch=1)
+    assert man2["version"] == 2 and man2["epoch"] == 1
+    segs = [f for f in os.listdir(d) if f.startswith("seg-")]
+    # one generation of segments on disk — the superseded save's files
+    # are cleaned, so a long-lived primary can't fill the disk
+    assert len(segs) == len(_ARRAYS)
+    loaded, man = snap.load_snapshot(d)
+    assert man["version"] == 2
+
+
+def test_fresh_process_answers_byte_identical(tree, points, tmp_path):
+    """The satellite contract: save → load in a FRESH process → answers
+    byte-identical to this process's in-memory oracle."""
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree, epoch=0)
+    q = points[:32]
+    qpath, outpath = str(tmp_path / "q.npy"), str(tmp_path / "out.npz")
+    np.save(qpath, q)
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from kdtree_tpu import snapshot as snap\n"
+        "from kdtree_tpu.ops.tile_query import morton_knn_tiled\n"
+        f"tree, man = snap.load_snapshot({d!r})\n"
+        f"q = np.load({qpath!r})\n"
+        f"d2, ids = morton_knn_tiled(tree, jnp.asarray(q), k={K})\n"
+        f"np.savez({outpath!r}, d2=np.asarray(d2), ids=np.asarray(ids))\n"
+        "print('epoch', man['epoch'])\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    od2, oids = _tiled(tree, q)
+    with np.load(outpath) as z:
+        assert np.array_equal(z["d2"], od2)
+        assert np.array_equal(z["ids"], oids)
+
+
+def test_resolve_dir_env_isolation(monkeypatch, tmp_path):
+    monkeypatch.setenv("KDTREE_TPU_SNAPSHOT_DIR", str(tmp_path))
+    assert snap.resolve_dir("rel/a") == str(tmp_path / "rel" / "a")
+    assert snap.resolve_dir("/abs/a") == "/abs/a"
+    # idempotent even under a RELATIVE base: the follower stores a
+    # resolved dir and load_snapshot resolves again — double resolution
+    # must not nest ('snapshots/snapshots/dir' never converges)
+    monkeypatch.setenv("KDTREE_TPU_SNAPSHOT_DIR", "relbase")
+    once = snap.resolve_dir("rel/a")
+    assert os.path.isabs(once)
+    assert snap.resolve_dir(once) == once
+    monkeypatch.delenv("KDTREE_TPU_SNAPSHOT_DIR")
+    assert snap.resolve_dir("rel/a") == "rel/a"
+
+
+def test_snapshot_rejects_non_morton(tmp_path):
+    with pytest.raises(TypeError, match="Morton"):
+        snap.save_snapshot(str(tmp_path / "s"), object())
+
+
+# ---------------------------------------------------------------------------
+# corruption honesty
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_segment_named_error_and_counter(tree, tmp_path):
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree)
+    _corrupt_segment(d)
+    before = _counter_value("kdtree_snapshot_load_errors_total")
+    with pytest.raises(snap.SnapshotCorruptError, match="sha256"):
+        snap.load_snapshot(d)
+    assert _counter_value("kdtree_snapshot_load_errors_total") == before + 1
+
+
+def test_truncated_segment_refused(tree, tmp_path):
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree)
+    seg = [f for f in os.listdir(d) if f.startswith("seg-bucket_gid")][0]
+    path = os.path.join(d, seg)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(snap.SnapshotCorruptError, match="truncated|bytes"):
+        snap.load_snapshot(d)
+
+
+def test_schema_skew_refused(tree, tmp_path):
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree)
+    mp = os.path.join(d, snap.MANIFEST_NAME)
+    man = json.load(open(mp))
+    man["schema"] = snap.SNAPSHOT_SCHEMA + 1
+    json.dump(man, open(mp, "w"))
+    with pytest.raises(snap.SnapshotSchemaError, match="schema"):
+        snap.load_snapshot(d)
+
+
+def test_missing_manifest_and_missing_segment(tree, tmp_path):
+    with pytest.raises(snap.SnapshotError, match="manifest"):
+        snap.load_snapshot(str(tmp_path / "empty"))
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree)
+    seg = [f for f in os.listdir(d) if f.startswith("seg-node_lo")][0]
+    os.remove(os.path.join(d, seg))
+    with pytest.raises(snap.SnapshotCorruptError, match="copied as a set"):
+        snap.load_snapshot(d)
+
+
+def test_serve_cli_falls_back_to_points_on_corrupt_snapshot(
+    points, tree, tmp_path,
+):
+    """The serve process must NEVER serve a half-read snapshot: a
+    corrupt one is refused with the named error, and with --points
+    provided the process rebuilds from source and still reaches ready
+    (the satellite's fallback contract), counting the load error."""
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree)
+    _corrupt_segment(d)
+    pts_file = tmp_path / "pts.npy"
+    np.save(pts_file, points)
+    log_path = tmp_path / "serve.log"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kdtree_tpu", "--platform", "cpu",
+             "serve", "--snapshot", d, "--points", str(pts_file),
+             "--port", "0", "--k", str(K), "--max-batch", "8"],
+            cwd=REPO, env=env, stderr=log, stdout=subprocess.DEVNULL,
+        )
+    try:
+        port = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline and port is None:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"serve died instead of falling back: "
+                    f"{log_path.read_text()[-2000:]}"
+                )
+            for line in log_path.read_text().splitlines():
+                if line.startswith("ready:"):
+                    port = int(line.rsplit("port", 1)[1].strip())
+            time.sleep(0.2)
+        assert port is not None, log_path.read_text()[-2000:]
+        text = log_path.read_text()
+        assert "snapshot load failed" in text
+        assert "falling back" in text
+        # the rebuilt index answers exactly like the oracle
+        q = points[:8]
+        body = json.dumps({"queries": q.tolist(), "k": K}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/knn", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.load(resp)
+        _, oids = _tiled(tree, q)
+        assert out["ids"] == oids.tolist()
+        # the named load error landed on the live scrape
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as resp:
+            metrics = resp.read().decode()
+        assert 'kdtree_snapshot_load_errors_total{reason="checksum"} 1' \
+            in metrics
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+        assert proc.wait(timeout=60) == 0
+
+
+# ---------------------------------------------------------------------------
+# mutable engine: emit on swap, delta excluded, epoch recorded
+# ---------------------------------------------------------------------------
+
+
+def _engine(tree, sink=None, max_delta_rows=6, epoch0=0):
+    return MutableEngine(
+        lifecycle.ServeEngine(tree, K), max_delta_rows=max_delta_rows,
+        max_delta_frac=0.0, requested_k=K, epoch0=epoch0,
+        snapshot_sink=sink,
+    )
+
+
+def _wait_epoch(engine, epoch, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if engine.epoch >= epoch and not engine._rebuilding:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"epoch {epoch} never arrived "
+                         f"(at {engine.epoch})")
+
+
+def _wait_manifest(d, epoch, timeout=60.0):
+    """The swap lands BEFORE the sink's disk write (serving never waits
+    on the emit) — poll the manifest for the epoch's artifact."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        man = snap.read_manifest(d)
+        if man is not None and int(man.get("epoch", -1)) >= epoch:
+            return man
+        time.sleep(0.02)
+    raise AssertionError(f"no epoch-{epoch} manifest in {d}")
+
+
+def test_epoch_swap_emits_snapshot_without_delta(tree, points, tmp_path):
+    d = str(tmp_path / "emit")
+    emitted = []
+
+    def sink(t, epoch):
+        emitted.append(epoch)
+        snap.save_snapshot(d, t, epoch=epoch)
+
+    eng = _engine(tree, sink=sink, max_delta_rows=6)
+    try:
+        new_pts = np.full((6, DIM), 0.5, dtype=np.float32) + \
+            np.arange(6, dtype=np.float32)[:, None] * 1e-3
+        eng.upsert(np.arange(N, N + 6), new_pts)  # crosses the threshold
+        _wait_epoch(eng, 1)
+        _wait_manifest(d, 1)
+        assert emitted == [1]
+        loaded, man = snap.load_snapshot(d)
+        assert man["epoch"] == 1
+        # the compacted tree INCLUDES the pre-swap upserts...
+        assert loaded.n_real == N + 6
+        # ...and a post-swap delta is NOT snapshotted: write below the
+        # threshold, no new emit, manifest still names epoch 1
+        eng.upsert(np.asarray([N + 100]),
+                   np.full((1, DIM), 0.25, dtype=np.float32))
+        assert eng.stats()["delta_rows"] == 1
+        assert snap.read_manifest(d)["epoch"] == 1
+        assert emitted == [1]
+        # the loaded tree answers the epoch's MAIN state: the live
+        # engine (main + delta overlay) knows id N+100, the snapshot
+        # must not
+        q = np.full((1, DIM), 0.25, dtype=np.float32)
+        _, live_ids = eng.knn_batch(q)[:2]
+        assert N + 100 in live_ids[0].tolist()
+        _, snap_ids = _tiled(loaded, q, k=K)
+        assert N + 100 not in snap_ids[0].tolist()
+    finally:
+        eng.close()
+
+
+def test_sink_failure_never_undoes_swap(tree, tmp_path):
+    def sink(t, epoch):
+        raise OSError("disk full")
+
+    before = _counter_value("kdtree_snapshot_sink_errors_total")
+    eng = _engine(tree, sink=sink, max_delta_rows=4)
+    try:
+        eng.upsert(np.arange(N, N + 4),
+                   np.zeros((4, DIM), dtype=np.float32))
+        _wait_epoch(eng, 1)
+        assert eng.epoch == 1  # the swap stood
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and _counter_value(
+            "kdtree_snapshot_sink_errors_total"
+        ) != before + 1:
+            time.sleep(0.02)  # the emit runs after the swap lands
+        assert _counter_value(
+            "kdtree_snapshot_sink_errors_total") == before + 1
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# blue/green follower
+# ---------------------------------------------------------------------------
+
+
+def test_follower_adopts_and_preserves_k(tree, points, tmp_path):
+    d = str(tmp_path / "bg")
+    primary = _engine(
+        tree, sink=lambda t, e: snap.save_snapshot(d, t, epoch=e),
+        max_delta_rows=6,
+    )
+    man0 = snap.save_snapshot(d, tree, epoch=0)  # bootstrap artifact
+    sec_tree, man = snap.load_snapshot(d)
+    secondary = _engine(sec_tree, epoch0=man["epoch"])
+    follower = SnapshotFollower(secondary, d, poll_s=0.05,
+                                start_version=man["version"])
+    try:
+        assert follower.poll_once() is False  # nothing new yet
+        new_pts = np.full((6, DIM), 0.75, dtype=np.float32)
+        new_pts += np.arange(6, dtype=np.float32)[:, None] * 1e-3
+        primary.upsert(np.arange(N, N + 6), new_pts)
+        _wait_epoch(primary, 1)
+        _wait_manifest(d, 1)
+        assert follower.poll_once() is True
+        assert secondary.epoch == 1
+        assert secondary.k == K  # configured k preserved across adopts
+        assert follower.poll_once() is False  # idempotent until the next
+        # zero stale-epoch answers after convergence: the upserted ids
+        # are visible through the adopted epoch, byte-identical to the
+        # primary's own answers
+        q = new_pts[:2]
+        pd2, pids = primary.knn_batch(q)[:2]
+        sd2, sids = secondary.knn_batch(q)[:2]
+        assert np.array_equal(pd2, sd2) and np.array_equal(pids, sids)
+        assert man0["version"] + 1 == snap.read_manifest(d)["version"]
+    finally:
+        follower.stop()
+        primary.close()
+        secondary.close()
+
+
+def test_follower_keeps_serving_through_corrupt_update(tree, tmp_path):
+    d = str(tmp_path / "bg2")
+    snap.save_snapshot(d, tree, epoch=0)
+    sec_tree, man = snap.load_snapshot(d)
+    secondary = _engine(sec_tree, epoch0=0)
+    follower = SnapshotFollower(secondary, d, poll_s=0.05,
+                                start_version=man["version"])
+    try:
+        snap.save_snapshot(d, tree, epoch=1)  # v2...
+        _corrupt_segment(d)                    # ...corrupted on disk
+        before = _counter_value("kdtree_snapshot_load_errors_total")
+        assert follower.poll_once() is False
+        assert secondary.epoch == 0            # stale beats down
+        assert _counter_value(
+            "kdtree_snapshot_load_errors_total") == before + 1
+        # the failed version is LATCHED: the next tick must not
+        # re-checksum the same broken segment set (hundreds of MB at
+        # real scale) — no new load error, no new verify pass
+        assert follower.poll_once() is False
+        assert _counter_value(
+            "kdtree_snapshot_load_errors_total") == before + 1
+        # a good save (version bump) re-arms and heals the follower
+        snap.save_snapshot(d, tree, epoch=2)
+        assert follower.poll_once() is True
+        assert secondary.epoch == 2
+    finally:
+        follower.stop()
+        secondary.close()
+
+
+def test_follower_thread_polls_in_background(tree, tmp_path):
+    d = str(tmp_path / "bg3")
+    snap.save_snapshot(d, tree, epoch=0)
+    sec_tree, man = snap.load_snapshot(d)
+    secondary = _engine(sec_tree, epoch0=0)
+    follower = SnapshotFollower(secondary, d, poll_s=0.05,
+                                start_version=man["version"])
+    follower.start()
+    try:
+        snap.save_snapshot(d, tree, epoch=3)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and secondary.epoch != 3:
+            time.sleep(0.02)
+        assert secondary.epoch == 3
+    finally:
+        follower.stop()
+        secondary.close()
+
+
+# ---------------------------------------------------------------------------
+# read-only replicas over HTTP
+# ---------------------------------------------------------------------------
+
+
+def test_read_only_replica_403s_writes_and_reports_snapshot(tree, points):
+    state = lifecycle.build_state(
+        tree=tree, k=K, max_batch=16, read_only=True,
+        meta={"snapshot": {"role": "secondary", "version": 1,
+                           "epoch": 0}},
+    )
+    httpd = srv.make_server(state, port=0)
+    httpd.start(warmup_buckets=[8])
+    port = httpd.server_address[1]
+    try:
+        body = json.dumps(
+            {"ids": [1], "points": [[0.0] * DIM]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/upsert", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 403
+        err = json.load(exc.value)
+        assert "primary" in err["error"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30
+        ) as resp:
+            health = json.load(resp)
+        assert health["read_only"] is True
+        assert health["snapshot"]["role"] == "secondary"
+        # reads still serve
+        q = json.dumps({"queries": points[:4].tolist(), "k": 1}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/knn", data=q,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+    finally:
+        httpd.stop()
+
+
+# ---------------------------------------------------------------------------
+# the in-process fleet e2e: primary + 2 followers behind the router
+# ---------------------------------------------------------------------------
+
+
+def test_blue_green_fleet_converges_under_traffic(tree, points, tmp_path):
+    """The acceptance e2e, in-process: 1 primary + 2 snapshot-following
+    secondaries as ONE replica set behind the router. Reads hammer the
+    router throughout a write → epoch rebuild → snapshot emit → both
+    followers adopt; every response is 200, reads spread over every
+    replica, and after convergence every replica answers with the new
+    epoch's points (zero stale answers)."""
+    from kdtree_tpu.serve import router as rt
+
+    d = str(tmp_path / "fleet")
+    man0 = snap.save_snapshot(d, tree, epoch=0)
+
+    servers, followers, urls = [], [], []
+    # primary: emits on swap
+    pstate = lifecycle.build_state(
+        tree=tree, k=K, max_batch=16, max_delta_rows=6,
+        snapshot_sink=lambda t, e: snap.save_snapshot(d, t, epoch=e),
+    )
+    phttpd = srv.make_server(pstate, port=0)
+    phttpd.start(warmup_buckets=[8])
+    servers.append(phttpd)
+    urls.append(f"http://127.0.0.1:{phttpd.server_address[1]}")
+    # two read-only followers booted FROM the snapshot
+    for _ in range(2):
+        st_tree, man = snap.load_snapshot(d)
+        sstate = lifecycle.build_state(
+            tree=st_tree, k=K, max_batch=16, read_only=True,
+            epoch0=man["epoch"],
+        )
+        shttpd = srv.make_server(sstate, port=0)
+        shttpd.start(warmup_buckets=[8])
+        follower = SnapshotFollower(sstate.engine, d, poll_s=0.05,
+                                    start_version=man["version"])
+        follower.start()
+        servers.append(shttpd)
+        followers.append(follower)
+        urls.append(f"http://127.0.0.1:{shttpd.server_address[1]}")
+
+    router = rt.make_router(["|".join(urls)], port=0,
+                            config=rt.RouterConfig(deadline_s=30.0))
+    router.start(health_loop=True)
+    rport = router.server_address[1]
+    q = points[:4]
+    body = json.dumps({"queries": q.tolist(), "k": K}).encode()
+    statuses, stop_reads = [], threading.Event()
+
+    def reader():
+        while not stop_reads.is_set():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rport}/v1/knn", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    statuses.append(resp.status)
+            except urllib.error.HTTPError as e:
+                statuses.append(e.code)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        # the router must learn id_offsets before a write routes
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                router._owner_table() is None:
+            time.sleep(0.05)
+        assert router._owner_table() is not None
+        new_pts = np.full((6, DIM), 0.66, dtype=np.float32)
+        new_pts += np.arange(6, dtype=np.float32)[:, None] * 1e-3
+        wbody = json.dumps({"ids": list(range(N, N + 6)),
+                            "points": new_pts.tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rport}/v1/upsert", data=wbody,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            wout = json.load(resp)
+        assert wout["applied"] == 6
+        # primary rebuilds (threshold 6), emits; both followers adopt
+        _wait_epoch(pstate.engine, 1)
+        deadline = time.monotonic() + 60
+        secondaries = [s.state.engine for s in servers[1:]]
+        while time.monotonic() < deadline and not all(
+            e.epoch == 1 for e in secondaries
+        ):
+            time.sleep(0.05)
+        assert [e.epoch for e in secondaries] == [1, 1]
+        stop_reads.set()
+        t.join(timeout=30)
+        # zero non-200 responses through the whole swap window
+        assert statuses and set(statuses) == {200}
+        # reads spread across EVERY replica of the set (round-robin)
+        counters = obs.get_registry().snapshot()["counters"]
+        for j in range(3):
+            key = ('kdtree_router_replica_requests_total'
+                   f'{{replica="{j}",shard="0"}}')
+            assert counters.get(key, 0) > 0, key
+        # zero stale-epoch answers after convergence: EVERY replica
+        # (asked directly, bypassing the router's rotation) returns the
+        # new epoch's points
+        nq = json.dumps({"queries": new_pts[:2].tolist(),
+                         "k": 1}).encode()
+        for url in urls:
+            req = urllib.request.Request(
+                f"{url}/v1/knn", data=nq,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.load(resp)
+            assert [row[0] for row in out["ids"]] == [N, N + 1]
+        assert snap.read_manifest(d)["version"] == man0["version"] + 1
+    finally:
+        stop_reads.set()
+        t.join(timeout=30)
+        router.stop()
+        for f in followers:
+            f.stop()
+        for s in servers:
+            s.stop()

@@ -317,3 +317,33 @@ def test_capacity_schema_versioning_and_absence(tmp_path):
     assert run["capacity"] is None
     findings, _ = tr.analyze([run, run], band=0.3)
     assert findings == []
+
+
+def test_platform_fallback_not_masked_by_capacity_only_run(tmp_path):
+    """A capacity-only loadgen artifact (platform 'unknown') interposed
+    between an accelerator round and a cpu round must not swallow the
+    tpu->cpu fallback verdict — the platform scan compares against the
+    newest PLATFORM-BEARING run, skipping over capacity-only ones."""
+    cap_report = {"capacity": {
+        "capacity_version": 1, "knee_rate": 40.0, "slo_ms": 250.0,
+        "slo_quantile": 0.99, "max_bad_frac": 0.05, "steps": [
+            {"rate": 40.0, "sent": 10, "ok": 10, "p50_ms": 5.0,
+             "p95_ms": 9.0, "p99_ms": 10.0, "bad_frac": 0.0,
+             "goodput": 40.0},
+        ],
+    }}
+    paths = [
+        _write(tmp_path, "a.json",
+               _headline(1000, platform="tpu", degraded=False)),
+        _write(tmp_path, "b.json", cap_report),
+        _write(tmp_path, "c.json",
+               _headline(900, platform="cpu", degraded=False)),
+    ]
+    runs = [tr.load_run(p) for p in paths]
+    assert runs[1]["platform"] == "unknown"
+    findings, _ = tr.analyze(runs, band=0.95)
+    rules = [f["rule"] for f in findings]
+    assert "platform-fallback" in rules
+    fb = next(f for f in findings if f["rule"] == "platform-fallback")
+    # the verdict names the real accelerator round, not the capacity run
+    assert fb["from"] == runs[0]["label"]
