@@ -1,0 +1,74 @@
+"""kdtree_tpu.approx — approximate k-NN with a measured recall dial.
+
+The rest of this repository is exact by contract. This package is the
+deliberate exception — and it exists precisely BECAUSE the exact oracle
+is always available: every approximation here is *measured* against it,
+never assumed (ROADMAP direction 1).
+
+Three pieces:
+
+- :mod:`~kdtree_tpu.approx.search` — bounded-visit / best-bin-first
+  search over the bucketed Morton tree. The tile query already ranks
+  every candidate bucket by box lower bound; the approximate mode is a
+  **cap on that ranking** (scan only the ``visit_cap`` nearest buckets
+  per tile), not a new traversal. Truncations of one fixed lb-ascending
+  ranking are nested, so recall@k is monotone in ``visit_cap``, and the
+  full cap is byte-identical to the exact engine (both test-pinned).
+  ``resolve_visit_cap`` turns a ``recall_target`` into a cap — from a
+  measured calibration in the plan store when one exists, from a
+  conservative documented heuristic otherwise.
+- :mod:`~kdtree_tpu.approx.recall` — the recall harness
+  (``kdtree-tpu recall``): sweep visit caps against the exact oracle,
+  emit recall@k-vs-speedup curves (bench-sidecar ``recall`` block, a
+  ``kdtree-tpu trend`` input — regressions gate CI like throughput
+  drops), and persist the measured recall_target → visit_cap
+  calibration per plan signature into the PR 2 plan store.
+- :mod:`~kdtree_tpu.approx.ladder` — the serving degradation ladder:
+  under sustained SLO burn the batcher steps
+  exact → approx(0.99) → approx(0.9) → brute-force-deadline and climbs
+  back on recovery, every transition flight-recorded and exported
+  (docs/SERVING.md "Degradation ladder").
+
+Trust model: calibrations are ADVISORY, like plan profiles — they tune
+the recall/latency trade, never the exactness contract. A request
+without ``recall_target`` runs the exact path, byte-identical to a
+build without this package.
+"""
+
+from __future__ import annotations
+
+from kdtree_tpu.approx.ladder import (
+    GEARS,
+    DegradationLadder,
+    GearSpec,
+    gear_token,
+)
+from kdtree_tpu.approx.recall import (
+    RECALL_VERSION,
+    calibrate_caps,
+    recall_at_k,
+    sweep_recall,
+)
+from kdtree_tpu.approx.search import (
+    DEFAULT_TARGETS,
+    RECALL_TARGET_ERROR,
+    morton_knn_approx,
+    parse_recall_target,
+    resolve_visit_cap,
+)
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "RECALL_TARGET_ERROR",
+    "parse_recall_target",
+    "DegradationLadder",
+    "GEARS",
+    "GearSpec",
+    "RECALL_VERSION",
+    "calibrate_caps",
+    "gear_token",
+    "morton_knn_approx",
+    "recall_at_k",
+    "resolve_visit_cap",
+    "sweep_recall",
+]

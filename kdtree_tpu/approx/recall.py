@@ -1,0 +1,224 @@
+"""The recall harness: measure the recall dial against the exact oracle.
+
+This repository's rare property (ROADMAP direction 1) is that the exact
+answer is always computable — so recall@k is a *measurement*, never an
+estimate. The harness sweeps a ladder of visit caps over one seeded
+problem, answers each cap with the bounded-visit engine
+(:mod:`kdtree_tpu.approx.search`) and the full cap with the exact tiled
+engine, and reports per cap:
+
+- **recall@k** — the fraction of the oracle's true top-k ids the
+  bounded answer found (padding-aware; deterministic for a seeded
+  problem, which is what lets CI gate on it);
+- **q/s and speedup** — warmup-excluded timed runs, the same
+  discipline as ``kdtree-tpu tune`` (compile + cap settling outside
+  the clock).
+
+Two artifacts come out of a sweep:
+
+- the **curve** (the sidecar ``recall`` block, RECALL_VERSION-stamped):
+  ``kdtree-tpu trend`` compares it across rounds and flags a
+  ``recall-drop`` exactly like a throughput drop — a tree-layout change
+  that silently tanks the dial's quality fails CI;
+- the **calibration** (``recall_caps``: recall_target → smallest cap
+  measured to reach it), persisted into the PR 2 plan store under the
+  problem's plan signature. Serving resolves per-request
+  ``recall_target`` through it. Advisory, like every profile: a stale
+  calibration costs recall (watched by the recall SLO) or speed, never
+  exactness — requests without a target never consult it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kdtree_tpu import obs
+from kdtree_tpu.approx.search import DEFAULT_TARGETS
+
+RECALL_VERSION = 1
+
+
+def recall_at_k(approx_ids, exact_ids) -> float:
+    """Mean per-query recall@k: |approx ∩ oracle| / |oracle real ids|.
+
+    Both arguments are [Q, k] id arrays with the engines' -1 padding;
+    padding ids never count as members on either side, and a query whose
+    oracle row is all padding (k > n) contributes recall 1.0 — there was
+    nothing to find."""
+    a = np.asarray(approx_ids)
+    e = np.asarray(exact_ids)
+    if a.shape != e.shape:
+        raise ValueError(
+            f"approx ids {a.shape} and oracle ids {e.shape} must match"
+        )
+    total = 0.0
+    rows = a.shape[0]
+    for qi in range(rows):
+        truth = set(int(x) for x in e[qi] if x >= 0)
+        if not truth:
+            total += 1.0
+            continue
+        found = set(int(x) for x in a[qi] if x >= 0)
+        total += len(truth & found) / len(truth)
+    return total / max(rows, 1)
+
+
+def default_caps(nbp: int) -> List[int]:
+    """The sweep ladder: powers of two up to (and including) the bucket
+    count — the full-cap point is what pins recall 1.0 / byte-identity."""
+    caps = []
+    c = 2
+    while c < int(nbp):
+        caps.append(c)
+        c *= 2
+    caps.append(int(nbp))
+    return caps
+
+
+def _timed(tree, queries, k: int, visit_cap: Optional[int], plan):
+    """Warmup + one timed run (the tuner's measurement discipline);
+    returns (seconds, d2, ids) of the timed pass."""
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    d2, ids = morton_knn_tiled(tree, queries, k=k, plan=plan,
+                               visit_cap=visit_cap)
+    obs.hard_sync([d2, ids])  # warmup: compile + cap settling
+    t0 = time.perf_counter()
+    d2, ids = morton_knn_tiled(tree, queries, k=k, plan=plan,
+                               visit_cap=visit_cap)
+    obs.hard_sync([d2, ids])
+    return time.perf_counter() - t0, d2, ids
+
+
+def sweep_recall(
+    tree,
+    queries,
+    k: int,
+    caps: Optional[Sequence[int]] = None,
+    log=None,
+) -> Dict:
+    """Sweep ``caps`` (default: the pow2 ladder up to the bucket count)
+    against the exact oracle; returns the sidecar ``recall`` block:
+    ``{recall_version, n, q, k, nbp, exact_qps, curve: [{visit_cap,
+    recall, qps, speedup, seconds}]}`` with the curve ascending in
+    ``visit_cap``."""
+    import jax
+
+    from kdtree_tpu.ops.tile_query import plan_tiled
+
+    Q, D = queries.shape
+    nbp = int(tree.num_buckets)
+    caps = sorted({min(max(int(c), 1), nbp)
+                   for c in (caps or default_caps(nbp))})
+    # ONE plan for every run: the sweep must compare visit caps, not
+    # plan-store luck (a warm exact plan against heuristic approx plans
+    # would skew every speedup). Explicit source => nothing recorded.
+    plan = plan_tiled(Q, D, tree.n_real, nbp, tree.bucket_size, k,
+                      tile=None, use_pallas=jax.default_backend() == "tpu")
+    exact_s, _, exact_ids = _timed(tree, queries, k, None, plan)
+    exact_ids = np.asarray(exact_ids)
+    exact_qps = Q / exact_s if exact_s > 0 else None
+    curve = []
+    for cap in caps:
+        dt, _, ids = _timed(tree, queries, k,
+                            None if cap >= nbp else cap, plan)
+        row = {
+            "visit_cap": cap,
+            "recall": round(recall_at_k(np.asarray(ids), exact_ids), 6),
+            "seconds": round(dt, 6),
+            "qps": round(Q / dt, 3) if dt > 0 else None,
+            "speedup": round(exact_s / dt, 3) if dt > 0 else None,
+        }
+        curve.append(row)
+        if log is not None:
+            log(row)
+    reg = obs.get_registry()
+    reg.counter("kdtree_recall_sweeps_total").inc()
+    return {
+        "recall_version": RECALL_VERSION,
+        "n": int(tree.n_real),
+        "q": int(Q),
+        "k": int(k),
+        "nbp": nbp,
+        "exact_qps": (round(exact_qps, 3)
+                      if exact_qps is not None else None),
+        "exact_seconds": round(exact_s, 6),
+        "curve": curve,
+    }
+
+
+def calibrate_caps(
+    curve: List[dict],
+    targets: Sequence[float] = DEFAULT_TARGETS,
+) -> Dict[str, int]:
+    """recall_target → smallest measured cap reaching it. Targets no
+    swept cap reached are omitted (resolution falls back to the
+    heuristic there) — a calibration must never promise a recall the
+    harness did not see."""
+    out: Dict[str, int] = {}
+    for target in targets:
+        for row in sorted(curve, key=lambda r: r["visit_cap"]):
+            if row["recall"] >= float(target):
+                out[f"{float(target):g}"] = int(row["visit_cap"])
+                break
+    return out
+
+
+def persist_calibration(
+    tree, Q: int, D: int, k: int, block: Dict,
+    targets: Sequence[float] = DEFAULT_TARGETS,
+    store=None,
+) -> Dict:
+    """Write the sweep's calibration into the plan store (merge
+    semantics — launch knobs a tuner settled there survive).
+
+    Recorded under EVERY pow2 Q-bucket signature from the serving
+    batcher's smallest bucket up to the sweep's own Q: serving
+    resolves a request's target at its BATCH's plan signature, and a
+    calibration keyed only by the harness's sweep width would be
+    invisible to every micro-batch (the plan_keys_for warm-ladder
+    idea, applied to calibration). Returns ``{"recall_caps": ...,
+    "persisted": bool, "path": ...}``; disabled stores persist
+    nothing, crisply."""
+    from kdtree_tpu import tuning
+    from kdtree_tpu.serve.batcher import MIN_BUCKET
+    from kdtree_tpu.tuning.store import _pow2_ceil
+
+    store = store if store is not None else tuning.default_store()
+    caps = calibrate_caps(block["curve"], targets)
+    top_sig = tuning.make_signature(Q, D, tree.n_real, k,
+                                    tree.bucket_size, tree.num_buckets,
+                                    devices=1)
+    persisted = False
+    if caps and store.enabled:
+        # measured recall per calibrated cap rides along: serving's
+        # recall-estimate gauge reports the MEASURED value for a gear,
+        # so a miscalibrated dial burns the recall SLO instead of
+        # silently claiming its target
+        measured = {
+            t: next((r["recall"] for r in block["curve"]
+                     if r["visit_cap"] == cap), None)
+            for t, cap in caps.items()
+        }
+        q = MIN_BUCKET
+        buckets = []
+        while q < _pow2_ceil(max(Q, 1)):
+            buckets.append(q)
+            q *= 2
+        buckets.append(_pow2_ceil(max(Q, 1)))
+        for q in buckets:
+            sig = tuning.make_signature(q, D, tree.n_real, k,
+                                        tree.bucket_size,
+                                        tree.num_buckets, devices=1)
+            if store.record(sig, recall_caps=caps,
+                            recall_measured=measured):
+                persisted = True
+    return {
+        "recall_caps": caps,
+        "persisted": bool(persisted),
+        "path": store.path_for(top_sig) if store.enabled else None,
+        "signature": top_sig.key,
+    }

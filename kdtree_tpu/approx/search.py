@@ -1,0 +1,175 @@
+"""Bounded-visit approximate k-NN: a cap on the exact candidate ranking.
+
+The exact tiled query (:mod:`kdtree_tpu.ops.tile_query`) already does
+the hard part of best-bin-first search: its collect pass ranks every
+candidate bucket lb-ascending per tile, and its dense scan consumes
+that ranking front-to-back behind an early exit. The approximate mode
+is therefore a **truncation**, not a new algorithm: scan only the
+``visit_cap`` nearest buckets and stop. Three properties fall out of
+reusing the exact machinery verbatim:
+
+- **monotone recall**: truncations of one fixed ranking are nested
+  (the cap-M bucket set is a subset of the cap-M' set for M' > M), so
+  growing the cap can only add candidates — recall@k never decreases
+  (property-tested, tests/test_approx.py);
+- **exactness at full cap**: a cap at least as wide as the collected
+  list makes the truncation a no-op — the program is the exact program,
+  byte for byte (test-pinned across shapes);
+- **the per-answer distances stay true**: an approximate answer is the
+  exact top-k over the visited points — distances are never estimated,
+  only the candidate set is bounded. What approximation costs is
+  *membership* (a true neighbor in an unvisited bucket), which is
+  exactly what recall@k measures.
+
+``resolve_visit_cap`` maps a ``recall_target`` to a cap: from the
+plan-store calibration the recall harness persisted when one exists
+(measured on this problem signature, :mod:`kdtree_tpu.approx.recall`),
+from a conservative fraction-of-buckets heuristic otherwise. Both are
+advisory — a wrong cap costs recall (visible on the ``kdtree_recall*``
+gauges and the recall SLO), never a crash or a silently-wrong exact
+answer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+from kdtree_tpu import obs
+
+# the calibration grid the harness measures and serving resolves
+# against; ascending, so "smallest calibrated target covering the
+# request" is a scan
+DEFAULT_TARGETS = (0.5, 0.75, 0.9, 0.95, 0.99)
+
+# uncalibrated fallback: fraction of the bucket count visited per
+# recall band. Deliberately conservative (recall misses cost answers,
+# visits only cost time) — the harness's measured calibration replaces
+# this with much smaller caps on real shapes (docs/SERVING.md
+# "Degradation ladder", calibration trust model).
+_HEURISTIC_FRACS = (
+    (0.99, 0.5),
+    (0.95, 0.33),
+    (0.9, 0.25),
+    (0.0, 0.125),
+)
+_MIN_VISIT = 2
+
+# the wire contract's rejection text — shared by every validator so the
+# shard server and the router front cannot drift apart
+RECALL_TARGET_ERROR = "recall_target must be a number in (0, 1]"
+
+
+def parse_recall_target(raw) -> Tuple[bool, Optional[float]]:
+    """Validate one wire ``recall_target`` value: ``(ok, normalized)``.
+    ``ok`` False means reject with :data:`RECALL_TARGET_ERROR`;
+    ``normalized`` is None for absent / 1.0 (both spell exact), the
+    float target otherwise. ONE implementation — the shard server and
+    the router validate through here, so a change to the accepted
+    range can never make the router 400 requests the shards accept."""
+    if raw is None:
+        return True, None
+    if not isinstance(raw, (int, float)) or isinstance(raw, bool) or \
+            not (0.0 < raw <= 1.0):
+        return False, None
+    target = float(raw)
+    return True, None if target >= 1.0 else target
+
+
+def _min_cap_for_k(k: int, bucket_size: int) -> int:
+    """Visiting fewer than ceil(k / B) buckets cannot even produce k
+    real candidates; one extra bucket keeps the k-th slot contested."""
+    return max(_MIN_VISIT, -(-int(k) // max(int(bucket_size), 1)) + 1)
+
+
+def _calibrated_cap(recall_caps: dict, target: float) -> Optional[int]:
+    """The smallest calibrated cap whose measured target covers the
+    requested one, or None when no calibrated entry is >= target.
+    ``recall_caps`` is the store's ``{"0.99": 12, ...}`` mapping —
+    string keys (JSON) with positive-int values; anything malformed
+    reads as absent, same advisory contract as plan profiles."""
+    best: Optional[int] = None
+    for raw_t, raw_cap in (recall_caps or {}).items():
+        try:
+            t, cap = float(raw_t), int(raw_cap)
+        except (TypeError, ValueError):
+            continue
+        if isinstance(raw_cap, bool) or cap < 1 or t < float(target):
+            continue
+        if best is None or cap < best:
+            best = cap
+    return best
+
+
+def resolve_visit_cap(
+    recall_target: Optional[float],
+    nbp: int,
+    k: int,
+    bucket_size: int,
+    sig=None,
+    profile: Optional[dict] = None,
+) -> Optional[int]:
+    """The visit cap serving a ``recall_target`` — None means exact.
+
+    Resolution order: an explicit ``profile`` (or the plan-store
+    profile for ``sig``) with a ``recall_caps`` calibration wins; the
+    documented fraction-of-buckets heuristic otherwise. ``None`` and
+    targets >= 1.0 resolve to exact (the default contract); the result
+    is always clamped so at least k real candidates are reachable and
+    never exceeds the bucket count (where it equals exact anyway)."""
+    if recall_target is None or float(recall_target) >= 1.0:
+        return None
+    target = float(recall_target)
+    nbp = int(nbp)
+    if profile is None and sig is not None:
+        from kdtree_tpu import tuning
+
+        profile = tuning.profile_for(sig)
+    cap = None
+    if isinstance(profile, dict):
+        cap = _calibrated_cap(profile.get("recall_caps"), target)
+    if cap is None:
+        frac = _HEURISTIC_FRACS[-1][1]
+        for floor, f in _HEURISTIC_FRACS:
+            if target >= floor:
+                frac = f
+                break
+        cap = int(math.ceil(nbp * frac))
+    cap = max(cap, _min_cap_for_k(k, bucket_size))
+    if cap >= nbp:
+        return None  # visiting everything IS the exact path
+    return cap
+
+
+def morton_knn_approx(
+    tree,
+    queries,
+    k: int = 1,
+    visit_cap: Optional[int] = None,
+    recall_target: Optional[float] = None,
+    plan=None,
+) -> Tuple[object, object]:
+    """Approximate k-NN over a Morton tree: the tiled engine with its
+    dense scan bounded to the ``visit_cap`` nearest candidate buckets
+    per tile. Same signature contract as
+    :func:`~kdtree_tpu.ops.tile_query.morton_knn_tiled` (d2 f32[Q, k],
+    ids i32[Q, k], ascending; answers exact over the visited points).
+
+    Exactly one of ``visit_cap`` / ``recall_target`` bounds the visit:
+    an explicit cap wins; a target resolves through
+    :func:`resolve_visit_cap` (calibration, then heuristic). Both
+    ``None`` — or a cap/target that resolves to the full bucket count —
+    run the exact path unchanged."""
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    if visit_cap is None and recall_target is not None:
+        visit_cap = resolve_visit_cap(
+            recall_target, tree.num_buckets, k, tree.bucket_size,
+        )
+    if visit_cap is not None:
+        visit_cap = min(max(int(visit_cap), 1), int(tree.num_buckets))
+        obs.get_registry().gauge("kdtree_approx_visit_cap").set(visit_cap)
+        if visit_cap >= int(tree.num_buckets):
+            visit_cap = None
+    return morton_knn_tiled(tree, queries, k=k, plan=plan,
+                            visit_cap=visit_cap)

@@ -228,7 +228,7 @@ class _StepAcc:
     the lock guards list/int updates only, never I/O)."""
 
     __slots__ = ("rate", "intended", "sent", "latencies_ms",
-                 "send_lag_ms", "counts")
+                 "send_lag_ms", "counts", "gears")
 
     def __init__(self, rate: float) -> None:
         self.rate = float(rate)
@@ -240,6 +240,11 @@ class _StepAcc:
             "ok": 0, "shed": 0, "degraded": 0, "partial": 0,
             "errors": 0, "timeouts": 0, "writes_ok": 0,
         }
+        # answered-query gear distribution (docs/SERVING.md
+        # "Degradation ladder"): "exact", "approx:<t>", or
+        # "brute-deadline" — the response's gear token, so a capacity
+        # step says WHICH gear its goodput was measured at
+        self.gears: Dict[str, int] = {}
 
 
 def _classify(op: str, status: int, body: Optional[dict]) -> List[str]:
@@ -258,6 +263,16 @@ def _classify(op: str, status: int, body: Optional[dict]) -> List[str]:
         tags.append("partial" if degraded.startswith("partial")
                     else "degraded")
     return tags
+
+
+def _gear_of(op: str, status: int, body: Optional[dict]) -> Optional[str]:
+    """The answering gear of one completed QUERY exchange — the
+    response's gear token, "exact" when a 200 carries none. None for
+    writes and failures (they have no gear)."""
+    if op != "query" or status != 200:
+        return None
+    gear = (body or {}).get("gear")
+    return gear if isinstance(gear, str) else "exact"
 
 
 def _quantiles_ms(vals: List[float]) -> Dict[str, Optional[float]]:
@@ -430,7 +445,8 @@ def run_load(
     t0 = time.monotonic()
 
     def record(arrival, intended: float, tags: List[str],
-               done: float, actual_send: float) -> None:
+               done: float, actual_send: float,
+               gear: Optional[str] = None) -> None:
         acc = accs[arrival.step]
         with lock:
             acc.sent += 1
@@ -439,6 +455,8 @@ def run_load(
                 max(actual_send - intended, 0.0) * 1e3)
             for tag in tags:
                 acc.counts[tag] += 1
+            if gear is not None:
+                acc.gears[gear] = acc.gears.get(gear, 0) + 1
 
     def do_request(conn: _WorkerConn, arrival, intended: float,
                    seq: int) -> None:
@@ -452,22 +470,27 @@ def run_load(
         if arrival.op == "query":
             path, body = "/v1/knn", {
                 "queries": [arrival.point.tolist()], "k": int(k)}
+            if getattr(arrival, "recall", None) is not None:
+                body["recall_target"] = float(arrival.recall)
         elif arrival.op == "upsert":
             path, body = "/v1/upsert", {
                 "ids": [int(arrival.gid)],
                 "points": [arrival.point.tolist()]}
         else:
             path, body = "/v1/delete", {"ids": [int(arrival.gid)]}
+        gear = None
         try:
             status, resp = conn.request(path, body, headers)
             tags = _classify(arrival.op, status, resp)
+            gear = _gear_of(arrival.op, status, resp)
         except TimeoutError:
             # socket.timeout IS TimeoutError: the request outlived its
             # client budget — the open-loop analog of a deadline miss
             tags = ["timeouts"]
         except (http.client.HTTPException, OSError):
             tags = ["errors"]
-        record(arrival, intended, tags, time.monotonic(), actual_send)
+        record(arrival, intended, tags, time.monotonic(), actual_send,
+               gear)
 
     def worker() -> None:
         conn = _WorkerConn(target, timeout_s)
@@ -535,6 +558,10 @@ def run_load(
                 "timeouts", "writes_ok")},
             **_quantiles_ms(acc.latencies_ms),
             "send_lag_p99_ms": _quantiles_ms(acc.send_lag_ms)["p99_ms"],
+            # the gear distribution the step's answered queries were
+            # served at — a capacity point is only comparable to
+            # another measured at the same gears
+            "gears": dict(sorted(acc.gears.items())),
         }
         steps.append(row)
     knee = compute_knee(steps, slo_ms=slo_ms, slo_quantile=slo_quantile,

@@ -47,7 +47,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Arrival", "MixSpec", "Schedule", "build_schedule",
-           "parse_mix"]
+           "parse_mix", "parse_recall_mix"]
 
 OPS = ("query", "upsert", "delete")
 DEFAULT_REGIONS = 64
@@ -111,20 +111,78 @@ def parse_mix(raw: str) -> MixSpec:
     return MixSpec(**weights)
 
 
+def parse_recall_mix(raw: Optional[str]):
+    """``--recall-target`` → ``[(target | None, weight), ...]``.
+
+    Accepts a single value (``"0.99"`` — every query carries it;
+    ``"exact"``/``"1"`` — the pure-exact default) or a weighted mix
+    (``"exact:0.5,0.99:0.3,0.9:0.2"``) so capacity curves can be
+    driven per gear. Weights normalize; a typo'd target is an error,
+    never a silently-exact run (the fault-spec grammar's lesson)."""
+    if raw is None or not raw.strip():
+        return None
+
+    def one_target(tok: str) -> Optional[float]:
+        tok = tok.strip()
+        if tok.lower() in ("exact", "1", "1.0"):
+            return None
+        try:
+            t = float(tok)
+        except ValueError:
+            raise ValueError(
+                f"bad recall target {tok!r}: expected 'exact' or a "
+                "number in (0, 1)"
+            ) from None
+        if not (0.0 < t < 1.0):
+            raise ValueError(
+                f"recall target {t:g} must be in (0, 1) — use 'exact' "
+                "for 1.0"
+            )
+        return t
+
+    if ":" not in raw:
+        target = one_target(raw)
+        return None if target is None else [(target, 1.0)]
+    out = []
+    for clause in raw.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        tok, _, w = clause.rpartition(":")
+        try:
+            weight = float(w)
+        except ValueError:
+            raise ValueError(
+                f"bad recall-mix weight {w!r} in {clause!r}: must be a "
+                "number"
+            ) from None
+        if weight < 0:
+            raise ValueError(f"recall-mix weight {weight:g} in "
+                             f"{clause!r} must be >= 0")
+        out.append((one_target(tok), weight))
+    total = sum(w for _, w in out)
+    if total <= 0:
+        raise ValueError("recall-mix weights must not all be zero")
+    return [(t, w / total) for t, w in out]
+
+
 class Arrival:
     """One scheduled request: when (offset seconds from run start),
-    what (op + payload), and which rate step it belongs to."""
+    what (op + payload + the query's recall target, None = exact), and
+    which rate step it belongs to."""
 
-    __slots__ = ("t", "step", "op", "point", "gid")
+    __slots__ = ("t", "step", "op", "point", "gid", "recall")
 
     def __init__(self, t: float, step: int, op: str,
                  point: Optional[np.ndarray] = None,
-                 gid: Optional[int] = None) -> None:
+                 gid: Optional[int] = None,
+                 recall: Optional[float] = None) -> None:
         self.t = float(t)
         self.step = int(step)
         self.op = op
         self.point = point
         self.gid = gid
+        self.recall = recall
 
     def key(self):
         """Comparable identity for determinism tests: timing, step, op,
@@ -133,6 +191,7 @@ class Arrival:
             round(self.t, 9), self.step, self.op, self.gid,
             None if self.point is None
             else tuple(round(float(x), 9) for x in self.point),
+            self.recall,
         )
 
 
@@ -141,7 +200,8 @@ class Schedule:
 
     def __init__(self, arrivals: List[Arrival], rates: List[float],
                  step_seconds: float, seed: int, mix: MixSpec,
-                 dim: int, write_base: int, shape: str) -> None:
+                 dim: int, write_base: int, shape: str,
+                 recall_mix=None) -> None:
         self.arrivals = arrivals
         self.rates = [float(r) for r in rates]
         self.step_seconds = float(step_seconds)
@@ -150,6 +210,7 @@ class Schedule:
         self.dim = int(dim)
         self.write_base = int(write_base)
         self.shape = shape
+        self.recall_mix = recall_mix
 
     @property
     def duration_s(self) -> float:
@@ -162,7 +223,7 @@ class Schedule:
         ops = {op: 0 for op in OPS}
         for a in self.arrivals:
             ops[a.op] += 1
-        return {
+        out = {
             "arrivals": len(self.arrivals),
             "rates": self.rates,
             "step_seconds": self.step_seconds,
@@ -173,6 +234,12 @@ class Schedule:
             "dim": self.dim,
             "write_base": self.write_base,
         }
+        if self.recall_mix:
+            out["recall_mix"] = [
+                ["exact" if t is None else t, w]
+                for t, w in self.recall_mix
+            ]
+        return out
 
 
 def _zipf_weights(regions: int, s: float) -> np.ndarray:
@@ -192,6 +259,7 @@ def build_schedule(
     shape: str = "steps",
     diurnal_amp: float = 0.3,
     write_base: int = 10_000_000,
+    recall_mix=None,
 ) -> Schedule:
     """Materialize the whole schedule from the seed — see the module
     docstring for the open-loop rationale.
@@ -199,7 +267,11 @@ def build_schedule(
     ``rates`` are offered request rates (req/s) per ladder step;
     ``write_base`` is the first id upserts mint (pick it above the
     served index's id range so writes never collide with real rows —
-    the CLI derives it from ``/healthz``)."""
+    the CLI derives it from ``/healthz``). ``recall_mix`` (from
+    :func:`parse_recall_mix`) draws each QUERY arrival's
+    ``recall_target`` from a weighted set — still seeded, still
+    response-blind — so capacity curves can be driven per serving
+    gear; ``None`` keeps every query exact."""
     if not rates or any(r <= 0 for r in rates):
         raise ValueError(f"rates must be positive, got {list(rates)}")
     if step_seconds <= 0:
@@ -217,6 +289,10 @@ def build_schedule(
     centers = rng.random((regions, dim))
     region_p = _zipf_weights(regions, zipf_s)
     probs = mix.probs()
+    recall_targets = recall_probs = None
+    if recall_mix:
+        recall_targets = [t for t, _ in recall_mix]
+        recall_probs = [w for _, w in recall_mix]
 
     arrivals: List[Arrival] = []
     upserted: List[int] = []  # gids minted so far, in schedule order
@@ -251,7 +327,14 @@ def build_schedule(
                 point = np.clip(
                     center + rng.normal(0.0, _JITTER_STD, dim), 0.0, 1.0
                 ).astype(np.float32)
-                arrivals.append(Arrival(t, step, "query", point=point))
+                recall = None
+                if recall_targets is not None:
+                    recall = recall_targets[
+                        int(rng.choice(len(recall_targets),
+                                       p=recall_probs))
+                    ]
+                arrivals.append(Arrival(t, step, "query", point=point,
+                                        recall=recall))
             elif op == "upsert":
                 gid = next_gid
                 next_gid += 1
@@ -267,4 +350,4 @@ def build_schedule(
                 gid = upserted.pop(pick)
                 arrivals.append(Arrival(t, step, "delete", gid=gid))
     return Schedule(arrivals, list(rates), step_seconds, seed, mix, dim,
-                    write_base, shape)
+                    write_base, shape, recall_mix=recall_mix)

@@ -272,6 +272,10 @@ class MutableEngine:
                                   min_cap=self._min_cap)
         # epoch of the latest knn_batch answer
         self.last_answer_epoch = self._epoch0
+        # gear facts of the latest knn_batch answer (ServeEngine duck
+        # surface): visit cap (None = exact) + recall estimate
+        self.last_visit_cap: Optional[int] = None
+        self.last_recall_estimate: float = 1.0
         self._rebuilding = False
         self._journal: Optional[List[tuple]] = None
         self._rebuild_thread: Optional[threading.Thread] = None
@@ -333,13 +337,24 @@ class MutableEngine:
 
     def knn_batch(
         self, queries: np.ndarray,
+        recall_target: Optional[float] = None,
     ) -> Tuple[np.ndarray, np.ndarray, str]:
-        """Exact k-NN for one padded micro-batch: the warm main-tree
-        dispatch, overlaid with the delta buffer and tombstone masks.
-        With an empty overlay this is a pure passthrough — byte-for-byte
-        the immutable serving path."""
+        """k-NN for one padded micro-batch: the warm main-tree dispatch
+        (exact, or bounded-visit under a ``recall_target`` — forwarded
+        to the inner engine's dial), overlaid with the delta buffer and
+        tombstone masks. The overlay itself is always EXACT — delta
+        rows are brute-forced and tombstones masked regardless of the
+        gear, so an approximate answer's recall comes only from the
+        main tree's bounded visit, never from missed writes. With an
+        empty overlay and no target this is a pure passthrough —
+        byte-for-byte the immutable serving path."""
         snap = self._snapshot()
-        d2, ids, source = snap.inner.knn_batch(queries)
+        d2, ids, source = snap.inner.knn_batch(queries, recall_target)
+        # gear facts mirror the ANSWERING inner engine's (the snapshot's
+        # — a concurrent epoch swap must not misattribute the dispatch),
+        # same single-reader contract as last_answer_epoch below
+        self.last_visit_cap = snap.inner.last_visit_cap
+        self.last_recall_estimate = snap.inner.last_recall_estimate
         # which epoch ANSWERED this call — the snapshot's, not whatever
         # self.epoch reads after a concurrent swap. The batch worker is
         # the only steady-state caller, so the plain attribute is

@@ -291,6 +291,29 @@ METRIC_HELP = {
     "kdtree_loadgen_offered_rate":
         "open-loop offered rate (req/s) the load generator most "
         "recently declared via X-Loadgen-Rate",
+    # the recall dial + degradation ladder (docs/SERVING.md
+    # "Degradation ladder")
+    "kdtree_approx_queries_total":
+        "query rows answered by the bounded-visit approximate engine",
+    "kdtree_approx_visit_cap":
+        "visit cap (candidate buckets per tile) of the last "
+        "approximate dispatch",
+    "kdtree_recall_gear":
+        "engaged degradation-ladder gear: 0 exact, 1 approx(0.99), "
+        "2 approx(0.9), 3 brute-force-deadline",
+    "kdtree_recall_estimate":
+        "recall estimate of the engaged gear (measured calibration "
+        "value when one exists; 1.0 exact) — the served-recall SLO's "
+        "gauge",
+    "kdtree_recall_requests_total":
+        "requests answered, by gear class (exact / approx / "
+        "brute-deadline)",
+    "kdtree_recall_ladder_transitions_total":
+        "degradation-ladder gear shifts, by destination gear",
+    "kdtree_recall_sweeps_total":
+        "recall-harness sweeps run (kdtree-tpu recall)",
+    "kdtree_snapshot_gc_generations_total":
+        "retained snapshot generations removed by --snapshot-keep GC",
     # SLOs + metric history (docs/OBSERVABILITY.md "SLOs & burn rates")
     "kdtree_slo_state":
         "SLO state by spec: 0 OK, 1 WARN, 2 PAGE (multi-window burn rate)",
@@ -447,6 +470,31 @@ def _capacity_lines(cap: Dict) -> list:
     return out
 
 
+def _recall_lines(block: Dict) -> list:
+    """Human rendering of a recall-harness ``recall`` block (shared by
+    ``stats`` and ``stats --diff`` so the two views cannot drift)."""
+    out = ["== recall (bounded-visit vs exact oracle) =="]
+    out.append(
+        f"shape: n={block.get('n')} q={block.get('q')} "
+        f"k={block.get('k')} buckets={block.get('nbp')}  exact "
+        f"{block.get('exact_qps') or '?'} q/s"
+    )
+    curve = block.get("curve") or []
+    if curve:
+        out.append(f"{'visit_cap':>10s}  {'recall@k':>9s}  "
+                   f"{'q/s':>10s}  {'speedup':>8s}")
+        for row in curve:
+            qps = row.get("qps")
+            spd = row.get("speedup")
+            out.append(
+                f"{row.get('visit_cap', 0):>10d}  "
+                f"{row.get('recall', 0.0):>9.4f}  "
+                f"{qps if qps is not None else float('nan'):>10g}  "
+                f"{spd if spd is not None else float('nan'):>7.2f}x"
+            )
+    return out
+
+
 def render_report(rep: Dict) -> str:
     """Human-readable rendering of a report dict (the ``stats``
     subcommand). Leads with the run facts that decide whether the numbers
@@ -514,6 +562,10 @@ def render_report(rep: Dict) -> str:
     if isinstance(rep.get("capacity"), dict):
         out.append("")
         out.extend(_capacity_lines(rep["capacity"]))
+
+    if isinstance(rep.get("recall"), dict):
+        out.append("")
+        out.extend(_recall_lines(rep["recall"]))
 
     hists = {
         k: v for k, v in rep.get("histograms", {}).items()
@@ -672,5 +724,45 @@ def render_report_diff(old: Dict, new: Dict) -> str:
                 f"{op99 if op99 is not None else float('nan'):>12.1f}ms  "
                 f"{np99 if np99 is not None else float('nan'):>12.1f}ms  "
                 f"{delta}"
+            )
+        # gear distributions ride in the steps (loadgen --recall-target):
+        # show rates whose served-gear mix CHANGED — a capacity point is
+        # only comparable to one measured at the same gears
+        for rate in sorted(set(osteps) & set(nsteps)):
+            og = (osteps.get(rate) or {}).get("gears") or {}
+            ng = (nsteps.get(rate) or {}).get("gears") or {}
+            if (og or ng) and og != ng:
+                out.append(
+                    f"{f'gears @ {rate:g} req/s':20s}  {og}  ->  {ng}"
+                )
+
+    orec, nrec = old.get("recall"), new.get("recall")
+    if isinstance(orec, dict) or isinstance(nrec, dict):
+        out.append("")
+        out.append("== recall curve (per visit cap) ==")
+        ocurve = {r.get("visit_cap"): r
+                  for r in (orec or {}).get("curve") or []}
+        ncurve = {r.get("visit_cap"): r
+                  for r in (nrec or {}).get("curve") or []}
+        out.append(f"{'visit_cap':>10s}  {'OLD recall':>11s}  "
+                   f"{'NEW recall':>11s}  {'OLD q/s':>10s}  "
+                   f"{'NEW q/s':>10s}")
+        for cap in sorted(set(ocurve) | set(ncurve)):
+            o, n = ocurve.get(cap), ncurve.get(cap)
+
+            def cell(row, key, fmt):
+                v = (row or {}).get(key)
+                return format(v, fmt) if v is not None else "-"
+
+            flag = ""
+            if o and n and o.get("recall") is not None and \
+                    n.get("recall") is not None and \
+                    o["recall"] - n["recall"] > 1e-9:
+                flag = "   <- recall fell"
+            out.append(
+                f"{cap:>10d}  {cell(o, 'recall', '11.4f'):>11s}  "
+                f"{cell(n, 'recall', '11.4f'):>11s}  "
+                f"{cell(o, 'qps', '10g'):>10s}  "
+                f"{cell(n, 'qps', '10g'):>10s}{flag}"
             )
     return "\n".join(out) + "\n"

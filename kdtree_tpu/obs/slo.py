@@ -217,6 +217,37 @@ def mutable_specs() -> List[SloSpec]:
     ]
 
 
+def recall_specs() -> List[SloSpec]:
+    """The recall-dial SLO (docs/SERVING.md "Degradation ladder"),
+    armed alongside :func:`default_specs` by a serving process: the
+    recall the serving gears actually deliver — the
+    ``kdtree_recall_estimate`` gauge, which carries the MEASURED
+    calibration value of the engaged gear, not its promise — must stay
+    at or above the 0.9 floor. Sustained samples below it mean the
+    ladder is parked past its deepest approximate gear, or a
+    calibration is claiming a recall the harness never measured —
+    either way the dial is lying to clients, which pages like any
+    other burn."""
+    return [
+        SloSpec(
+            name="served-recall",
+            objective="served recall estimate (measured calibration of "
+                      "the engaged gear) stays >= 0.9",
+            target=0.90,
+            kind="gauge_min",
+            gauge="kdtree_recall_estimate",
+            # just under the deepest shipped gear's 0.9 target: the
+            # gear MEETING its promise must not burn, only a measured
+            # shortfall below it
+            threshold=0.895,
+            # same wide-budget burn sizing as device-busy: with budget
+            # 0.1 the default >10x fast tier is unreachable
+            fast=BurnWindow(long_s=60.0, short_s=10.0, max_burn=4.0),
+            slow=BurnWindow(long_s=600.0, short_s=60.0, max_burn=1.5),
+        ),
+    ]
+
+
 def router_specs() -> List[SloSpec]:
     """The routing-process SLOs (``kdtree-tpu route`` arms these instead
     of :func:`default_specs` — a router has no batches or device, it has

@@ -22,6 +22,13 @@ series of bench artifacts and flags exactly that class of silent decay:
   blocks are schema-versioned and optional: a series mixing plain
   bench sidecars with loadgen reports compares capacity only where it
   was measured — old artifacts parse exactly as before.
+- **recall-drop**: the recall harness's measured recall@k at a visit
+  cap (``kdtree-tpu recall``'s sidecar ``recall`` block) falling more
+  than ``RECALL_DROP_BAND`` *absolute* vs the previous recall-bearing
+  run at the same cap. Recall on a seeded shape is deterministic —
+  the throughput noise band does not apply — so the band here is a
+  small absolute tolerance for shape drift, and a genuine quality
+  regression of the dial fails CI exactly like a throughput cliff.
 
 The noise band is fitted from ``--pair`` runs when any input carries a
 ``pair_first`` block (two same-process passes bound the run-to-run
@@ -56,6 +63,10 @@ _PLATFORM_TOKENS = {"cpu", "tpu", "gpu", "axon", "cuda", "rocm", "metal"}
 _RATE_UNITS = {"pts/s", "q/s"}
 HEADLINE_KEY = "headline"
 KNOWN_CAPACITY_VERSIONS = (1,)
+KNOWN_RECALL_VERSIONS = (1,)
+# recall@cap is deterministic for a seeded shape; this absolute
+# tolerance absorbs intentional small shape drift, not noise
+RECALL_DROP_BAND = 0.02
 
 
 # --------------------------------------------------------------------------
@@ -148,6 +159,7 @@ def _from_headline(headline: dict, label: str, path: str) -> dict:
         "pair_spread": None,
         "passes": 1,
         "capacity": None,
+        "recall": None,
     }
     pair = headline.get("pair_first")
     if isinstance(pair, dict):
@@ -171,14 +183,48 @@ def _capacity_facts(cap) -> Optional[dict]:
     except (TypeError, ValueError):
         return None
     steps = []
+    gears = set()
+    gears_known = False
     for s in cap.get("steps") or []:
         if not isinstance(s, dict) or "rate" not in s:
             continue
         steps.append({"rate": float(s["rate"]),
                       "p99_ms": s.get("p99_ms"),
                       "goodput_rps": s.get("goodput_rps")})
+        if isinstance(s.get("gears"), dict):
+            gears_known = True
+            gears.update(s["gears"])
     return {"knee_rate": knee, "steps": steps,
-            "slo_ms": cap.get("slo_ms")}
+            "slo_ms": cap.get("slo_ms"),
+            # the gear classes the run's answered queries came back at
+            # (None for pre-gear artifacts): the knee comparison must
+            # not cross a changed mix — a knee measured half-approx is
+            # not comparable to an all-exact one
+            "gears": sorted(gears) if gears_known else None}
+
+
+def _recall_facts(block) -> Optional[dict]:
+    """Distill a ``recall`` block (the ``kdtree-tpu recall`` harness's
+    sidecar payload) to what the trend scan compares: measured recall
+    per visit cap. Same tolerance contract as :func:`_capacity_facts`
+    — absent/unversioned/unknown-version blocks read as 'not
+    comparable', never as a crash."""
+    if not isinstance(block, dict):
+        return None
+    if block.get("recall_version") not in KNOWN_RECALL_VERSIONS:
+        return None
+    curve = {}
+    for row in block.get("curve") or []:
+        if not isinstance(row, dict) or "visit_cap" not in row:
+            continue
+        try:
+            curve[int(row["visit_cap"])] = float(row.get("recall", 0.0))
+        except (TypeError, ValueError):
+            continue
+    if not curve:
+        return None
+    return {"curve": curve, "k": block.get("k"),
+            "exact_qps": block.get("exact_qps")}
 
 
 def load_run(path: str) -> dict:
@@ -205,15 +251,17 @@ def load_run(path: str) -> dict:
         run = _from_headline(head, label, path)
         run["passes"] = int(data.get("passes", run["passes"]) or 1)
         run["capacity"] = _capacity_facts(data.get("capacity"))
+        run["recall"] = _recall_facts(data.get("recall"))
         return run
     if "metric" in data and "value" in data:
         return _from_headline(data, label, path)
-    if isinstance(data.get("capacity"), dict):
-        # a standalone loadgen report (or a sidecar from a run with no
-        # bench headline): capacity-only — it has no cross-round
-        # throughput series, only the curve. An unknown future
-        # capacity_version still parses (capacity = not comparable);
-        # forward-compat must degrade to silence, never to a crash.
+    if isinstance(data.get("capacity"), dict) or \
+            isinstance(data.get("recall"), dict):
+        # a standalone loadgen/recall report (or a sidecar from a run
+        # with no bench headline): curve-only — it has no cross-round
+        # throughput series. An unknown future block version still
+        # parses (block = not comparable); forward-compat must degrade
+        # to silence, never to a crash.
         return {
             "label": label,
             "path": path,
@@ -222,11 +270,13 @@ def load_run(path: str) -> dict:
             "metrics": {},
             "pair_spread": None,
             "passes": 1,
-            "capacity": _capacity_facts(data["capacity"]),
+            "capacity": _capacity_facts(data.get("capacity")),
+            "recall": _recall_facts(data.get("recall")),
         }
     raise ValueError(
         f"{path}: not a bench headline, driver BENCH_r*.json, bench "
-        "telemetry sidecar, or loadgen capacity report"
+        "telemetry sidecar, or a loadgen capacity / recall-harness "
+        "report"
     )
 
 
@@ -322,7 +372,15 @@ def analyze(runs: List[dict], band: Optional[float] = None):
         if prev_cap is not None:
             pknee = prev_cap[1].get("knee_rate")
             cknee = cap.get("knee_rate")
-            if pknee and pknee > 0 and cknee is not None and \
+            # a changed gear mix makes the knees incommensurable: a
+            # run driven half-approximate meets the latency SLO at
+            # rates an all-exact run cannot, and comparing them would
+            # mint false drops (or mask real ones). Pre-gear
+            # artifacts (gears None) compare as before.
+            pg, cg = prev_cap[1].get("gears"), cap.get("gears")
+            comparable = pg is None or cg is None or pg == cg
+            if comparable and pknee and pknee > 0 and \
+                    cknee is not None and \
                     (pknee - cknee) / pknee > used:
                 findings.append(_finding(
                     "capacity-drop", "capacity:knee", prev_cap[0], cur,
@@ -332,6 +390,31 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     "load than it used to",
                 ))
         prev_cap = (cur, cap)
+    # recall curves compare against the PREVIOUS recall-bearing run
+    # (same interleaving tolerance as capacity), at matching visit
+    # caps, with the ABSOLUTE band — recall on a seeded shape is
+    # deterministic, so the throughput noise band does not apply
+    prev_rec = None
+    for cur in runs:
+        rec = cur.get("recall")
+        if not rec:
+            continue
+        if prev_rec is not None:
+            pcurve = prev_rec[1]["curve"]
+            ccurve = rec["curve"]
+            for cap in sorted(set(pcurve) & set(ccurve)):
+                pr, cr = pcurve[cap], ccurve[cap]
+                if pr - cr > RECALL_DROP_BAND:
+                    findings.append(_finding(
+                        "recall-drop", f"recall:cap{cap}", prev_rec[0],
+                        cur,
+                        f"recall@k at visit_cap {cap} fell "
+                        f"{pr:.4f} -> {cr:.4f} (band "
+                        f"{RECALL_DROP_BAND:g} absolute): the recall "
+                        "dial serves measurably worse answers at the "
+                        "same visit budget",
+                    ))
+        prev_rec = (cur, rec)
     return findings, used
 
 
@@ -398,9 +481,13 @@ def render_human(runs: List[dict], findings: List[dict],
         if head is not None and cap is not None and \
                 cap.get("knee_rate") is not None:
             capnote = f"  (knee {cap['knee_rate']:g} req/s)"
+        rec = r.get("recall")
+        recnote = ""
+        if rec is not None:
+            recnote = f"  (recall curve: {len(rec['curve'])} caps)"
         out.append(
             f"{r['label']:<{width}}  {r['platform']:<8}"
-            f"{value}{capnote}{pair}{deg}"
+            f"{value}{capnote}{recnote}{pair}{deg}"
         )
     out.append("")
     new_fps = {f["fingerprint"] for f in new}
@@ -437,6 +524,10 @@ def render_json(runs: List[dict], findings: List[dict],
                 "passes": r["passes"],
                 "capacity_knee": (
                     (r.get("capacity") or {}).get("knee_rate")
+                ),
+                "recall_caps": (
+                    sorted((r.get("recall") or {}).get("curve", {}))
+                    or None
                 ),
             }
             for r in runs

@@ -330,7 +330,7 @@ def _sort_queries(queries, bits: int, qpad: int):
 
 def _tiled_batch_core(
     tree, sq, k: int, tile: int, cmax: int, seeds: int, v: int, tb: int,
-    use_pallas: bool = False,
+    use_pallas: bool = False, visit_cap: int | None = None,
 ):
     """Seed + collect + scan for ONE batch of sorted queries (trace-level
     body, shared by the jitted single-tree wrapper below and the SPMD
@@ -357,6 +357,17 @@ def _tiled_batch_core(
     tile_bound = jnp.max(sd[..., k - 1], axis=1)  # [T]
 
     cand, cand_lb, overflow = _frontier(tree, box_lo, box_hi, tile_bound, cmax)
+    if visit_cap is not None and visit_cap < cand.shape[1]:
+        # bounded-visit (approximate) mode: the collect pass already
+        # ranked every relevant bucket lb-ascending, so approximation is
+        # a TRUNCATION of that list, not a different traversal
+        # (kdtree_tpu/approx/search.py). Truncations of one fixed
+        # ranking are nested — visit_cap M's bucket set is a subset of
+        # M' > M's — which is what makes recall@k monotone in the cap,
+        # and visit_cap >= C makes the slice a no-op: the program IS the
+        # exact program, byte for byte (both test-pinned).
+        cand = cand[:, :visit_cap]
+        cand_lb = cand_lb[:, :visit_cap]
     if use_pallas:
         fd, fi = scan_tiles_fused(tree, tq, cand, cand_lb, k, V=v)
     else:
@@ -372,11 +383,12 @@ def _tiled_batch_core(
 @functools.partial(
     jax.jit,
     static_argnames=("k", "qbatch", "tile", "cmax", "seeds", "v", "tb",
-                     "use_pallas"),
+                     "use_pallas", "visit_cap"),
 )
 def _tiled_batch(
     tree, sq, b0, k: int, qbatch: int, tile: int, cmax: int, seeds: int,
     v: int, tb: int, use_pallas: bool = False,
+    visit_cap: int | None = None,
 ):
     """One batch = ONE device program: the batch's query slice is a
     ``dynamic_slice`` on the traced offset ``b0`` INSIDE the program, so
@@ -386,7 +398,7 @@ def _tiled_batch(
     at the ~150-batch north-star shape)."""
     sqb = lax.dynamic_slice_in_dim(sq, b0, qbatch, axis=0)
     return _tiled_batch_core(tree, sqb, k, tile, cmax, seeds, v, tb,
-                             use_pallas)
+                             use_pallas, visit_cap)
 
 
 @functools.partial(jax.jit, static_argnames=("qreal",))
@@ -827,6 +839,7 @@ def morton_knn_tiled(
     plan: TiledPlan | None = None,
     scan_v: int | None = None,
     scan_tb: int | None = None,
+    visit_cap: int | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact batched k-NN via Hilbert-sorted query tiles and dense scans.
 
@@ -845,6 +858,15 @@ def morton_knn_tiled(
     dispatching) passes it via ``plan`` so the store is consulted — and
     its hit/miss counters advanced — exactly once; the tile/cmax/seeds/
     use_pallas knob arguments are ignored then.
+
+    ``visit_cap`` (docs/SERVING.md "Degradation ladder") bounds the
+    dense scan to the ``visit_cap`` nearest candidate buckets per tile
+    (by box lower bound) — the bounded-visit APPROXIMATE mode
+    :mod:`kdtree_tpu.approx` resolves from a recall target. ``None``
+    (the default) is the exact path, unchanged; a cap at least as wide
+    as the collected candidate list is byte-identical to it. Approx
+    runs never feed the plan store (a truncated run's stats would
+    contaminate the exact shape's profile).
     """
     Q, D = queries.shape
     k = min(k, tree.n_real)
@@ -861,7 +883,14 @@ def morton_knn_tiled(
         )
     from kdtree_tpu import tuning
 
-    feedback = tuning.feedback_for(plan)
+    # approx (bounded-visit) runs are excluded from the auto-tune loop:
+    # their settled caps and prune stats describe a deliberately
+    # truncated scan, and recording them would warm-start the EXACT
+    # path of this shape from approximate evidence
+    feedback = None if visit_cap is not None else tuning.feedback_for(plan)
+    if visit_cap is not None:
+        visit_cap = max(int(visit_cap), 1)
+        obs.get_registry().counter("kdtree_approx_queries_total").inc(Q)
     qpad = (-Q) % plan.qbatch
     with obs.span("query.tiled", sync=False, q=Q, k=k):
         sq, order = _sort_queries(queries, plan.bits, qpad)
@@ -870,7 +899,7 @@ def morton_knn_tiled(
         def run_batch(b0: int, cap: int):
             return _tiled_batch(
                 tree, sq, b0, k, plan.qbatch, plan.tile, cap, plan.seeds,
-                plan.v, plan.tb, plan.use_pallas,
+                plan.v, plan.tb, plan.use_pallas, visit_cap,
             )
 
         offsets = list(range(0, Qp, plan.qbatch))

@@ -58,16 +58,23 @@ class PendingRequest:
     __slots__ = (
         "queries", "k", "deadline", "enqueued_at", "dispatched_at",
         "event", "d2", "ids", "degraded", "error", "trace_id",
+        "recall_target", "gear",
     )
 
     def __init__(
         self, queries: np.ndarray, k: int,
         deadline: Optional[float] = None,
         trace_id: str = "",
+        recall_target: Optional[float] = None,
     ) -> None:
         self.queries = queries  # f32[q, D], validated by the handler
         self.k = k
         self.deadline = deadline  # absolute time.monotonic(), or None
+        # the request's recall dial (docs/SERVING.md "Degradation
+        # ladder"): None = exact (the default contract), a float < 1 =
+        # the client accepts any answer with recall >= target. The
+        # batcher groups same-target requests into one dispatch.
+        self.recall_target = recall_target
         # per-request trace id (client X-Request-Id or server-generated):
         # threads admission -> batcher -> dispatch, so one slow request's
         # queue/coalesce/device decomposition can be pulled from the
@@ -79,6 +86,11 @@ class PendingRequest:
         self.d2: Optional[np.ndarray] = None
         self.ids: Optional[np.ndarray] = None
         self.degraded: Optional[str] = None  # None | "deadline" | "oversized"
+        # | "approx:<t>" / "brute-deadline" for LADDER-forced gears
+        # the gear that ANSWERED (approx.gear_token format), echoed in
+        # the response: set whenever the answer was not plain exact —
+        # including client-REQUESTED approx, which is not "degraded"
+        self.gear: Optional[str] = None
         self.error: Optional[str] = None
 
     @property
@@ -92,8 +104,10 @@ class PendingRequest:
     def fulfill(
         self, d2: np.ndarray, ids: np.ndarray,
         degraded: Optional[str] = None,
+        gear: Optional[str] = None,
     ) -> None:
         self.d2, self.ids, self.degraded = d2, ids, degraded
+        self.gear = gear
         self.event.set()
 
     def fail(self, message: str) -> None:

@@ -19,6 +19,21 @@ Requests whose deadline expired while queued are split off and answered
 through the engine's brute-force degradation path (exact, flagged
 ``degraded`` — see :mod:`kdtree_tpu.serve.lifecycle`), so one slow burst
 degrades its stragglers instead of erroring them.
+
+**The recall dial** (docs/SERVING.md "Degradation ladder") threads
+through here in two ways:
+
+- per-request ``recall_target``: coalescing groups same-target
+  requests into one batch (a mixed batch would either degrade the
+  exact requests or waste the approximate ones' latitude), and the
+  batch dispatches at that target — the answer echoes its gear;
+- the **degradation ladder** (:mod:`kdtree_tpu.approx.ladder`): under
+  sustained SLO burn the ladder's gear caps every batch — exact
+  requests then get approximate answers, honestly flagged
+  ``degraded``; the last gear routes whole batches through the proven
+  brute-force path. The effective target of a batch is the MINIMUM of
+  the ladder's and the requests' (more aggressive wins — a client that
+  asked for 0.9 under a 0.99 ladder still gets its cheaper answer).
 """
 
 from __future__ import annotations
@@ -32,6 +47,7 @@ import numpy as np
 from kdtree_tpu import obs
 from kdtree_tpu.obs import flight
 from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
+from kdtree_tpu.serve.faults import SITE_BATCH
 from kdtree_tpu.tuning.store import _pow2_ceil
 
 DEFAULT_MAX_BATCH = 1024
@@ -66,11 +82,19 @@ class MicroBatcher:
         max_batch: int = DEFAULT_MAX_BATCH,
         max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
         min_bucket: int = MIN_BUCKET,
+        ladder=None,
+        faults=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.engine = engine
         self.queue = queue
+        # the degradation ladder (approx/ladder.py) whose gear caps
+        # every batch, and the server's fault set (the "batch" site:
+        # injected dispatch latency/errors — the deterministic overload
+        # the ladder's tests and drills step down under)
+        self.ladder = ladder
+        self.faults = faults
         # pow2: every bucket (including the cap itself) is then a plan-
         # signature quantum, and batch_bucket can never exceed it for an
         # admitted row count
@@ -103,7 +127,17 @@ class MicroBatcher:
             reason: reg.counter(
                 "kdtree_serve_degraded_total", labels={"reason": reason}
             )
-            for reason in ("deadline", "oversized")
+            for reason in ("deadline", "oversized", "ladder",
+                           "brute-deadline")
+        }
+        # requests by answering gear class — a BOUNDED label set on
+        # purpose (KDT106): the precise target rides in the response's
+        # gear token and the flight ring, never in a label value
+        self._by_gear = {
+            gear: reg.counter(
+                "kdtree_recall_requests_total", labels={"gear": gear}
+            )
+            for gear in ("exact", "approx", "brute-deadline")
         }
         self._errors = reg.counter("kdtree_serve_batch_errors_total")
 
@@ -142,7 +176,10 @@ class MicroBatcher:
 
     def _collect(self, first: PendingRequest) -> List[PendingRequest]:
         """Absorb arrivals behind ``first`` until the batch is full or
-        ``max_wait`` has elapsed since coalescing began."""
+        ``max_wait`` has elapsed since coalescing began. Only requests
+        sharing ``first``'s recall target join: one batch = one gear
+        (a mixed batch would either over-serve the approximate
+        requests or approximate the exact ones)."""
         batch = [first]
         rows = first.rows
         t_end = time.monotonic() + self.max_wait
@@ -153,7 +190,8 @@ class MicroBatcher:
             nxt = self.queue.pop_wait(remaining)
             if nxt is None:
                 break
-            if rows + nxt.rows > self.max_batch:
+            if rows + nxt.rows > self.max_batch or \
+                    nxt.recall_target != first.recall_target:
                 self.queue.push_front(nxt)  # keeps FIFO; next batch leads with it
                 break
             batch.append(nxt)
@@ -161,6 +199,17 @@ class MicroBatcher:
         return batch
 
     def _dispatch(self, batch: List[PendingRequest]) -> None:
+        if self.faults is not None:
+            # the "batch" injection site: latency/hang are served inside
+            # fire() (inflating the dispatch/total histograms the
+            # watched p99 SLO reads — the deterministic ladder drive);
+            # act-kinds fail the whole batch like an engine error would
+            act = self.faults.fire(SITE_BATCH)
+            if act is not None:
+                self._errors.inc()
+                for r in batch:
+                    r.fail("injected batch fault (serve/faults.py)")
+                return
         now = time.monotonic()
         for req in batch:
             req.dispatched_at = now
@@ -168,12 +217,21 @@ class MicroBatcher:
         live = [r for r in batch if not r.expired(now)]
         late = [r for r in batch if r.expired(now)]
         if live:
-            self._run_batch(live)
+            spec = self.ladder.spec() if self.ladder is not None else None
+            if spec is not None and spec.brute:
+                # the ladder's floor gear: answer every request through
+                # the proven exact brute-force path (immune to
+                # batch-shape compiles) — the PR 4 behavior as the
+                # LAST step of the ladder instead of its only one
+                for req in live:
+                    self._run_fallback(req, reason="brute-deadline")
+            else:
+                self._run_batch(live, spec)
         for req in late:
             self._deadline.inc()
             self._run_fallback(req, reason="deadline")
 
-    def _run_batch(self, live: List[PendingRequest]) -> None:
+    def _run_batch(self, live: List[PendingRequest], spec=None) -> None:
         rows = sum(r.rows for r in live)
         bucket = batch_bucket(rows, self.max_batch, self.min_bucket)
         q = np.concatenate([r.queries for r in live], axis=0)
@@ -182,8 +240,19 @@ class MicroBatcher:
             # sliced away — same trick as the tiled engine's own qpad
             pad = np.broadcast_to(q[-1], (bucket - rows, q.shape[1]))
             q = np.concatenate([q, pad], axis=0)
+        # effective recall target: the MINIMUM of what the ladder caps
+        # and what the (gear-homogeneous) batch asked — more aggressive
+        # wins; None = exact, today's path byte for byte
+        ladder_t = spec.recall_target if spec is not None else None
+        req_t = live[0].recall_target
+        asked = [t for t in (ladder_t, req_t) if t is not None]
+        effective = min(asked) if asked else None
         try:
-            d2, ids, source = self.engine.knn_batch(q)
+            if effective is None:
+                d2, ids, source = self.engine.knn_batch(q)
+            else:
+                d2, ids, source = self.engine.knn_batch(
+                    q, recall_target=effective)
         except Exception as e:
             self._errors.inc()
             flight.record("serve.batch_error", rows=rows,
@@ -194,13 +263,40 @@ class MicroBatcher:
                 r.fail(f"batch dispatch failed: {e!r}")
             return
         done = time.monotonic()
+        # gear accounting: what actually ANSWERED. The engine reports
+        # the applied cap (a target can resolve to exact when the
+        # calibration says every bucket is needed) and the recall
+        # estimate (measured calibration value when one exists).
+        visit_cap = getattr(self.engine, "last_visit_cap", None)
+        estimate = getattr(self.engine, "last_recall_estimate", 1.0)
+        gear = None
+        forced = None
+        if effective is not None and visit_cap is not None:
+            gear = f"approx:{effective:g}"
+            if ladder_t is not None and (req_t is None
+                                         or ladder_t < req_t):
+                # the LADDER pushed this batch below what its requests
+                # asked for — that is degradation, flagged as such
+                # (client-requested approx is a contract, not a
+                # degradation)
+                forced = gear
+                self._degraded["ladder"].inc(len(live))
+        self._by_gear["approx" if gear else "exact"].inc(len(live))
+        if self.ladder is not None and forced is not None:
+            # refine the LADDER gear's promise with the measured
+            # calibration value — only for ladder-FORCED batches: a
+            # client-requested low target is a kept contract, and
+            # feeding it to the served-recall SLO's gauge would page
+            # on traffic that is exactly what it asked for
+            self.ladder.engaged(estimate)
         self._batches["warm" if source == "warm" else "cold"].inc()
         self._batch_rows.observe(rows)
         self._batch_reqs.observe(len(live))
         flight.record(
             "serve.batch", rows=rows, bucket=bucket, requests=len(live),
-            plan=source, dispatch_ms=round((done - live[0].dispatched_at)
-                                           * 1e3, 3),
+            plan=source, gear=gear or "exact", visit_cap=visit_cap,
+            dispatch_ms=round((done - live[0].dispatched_at)
+                              * 1e3, 3),
             # which index generation ANSWERED this batch (mutable
             # serving): an epoch swap between two batches is visible in
             # the ring as this number stepping — the post-incident
@@ -213,7 +309,9 @@ class MicroBatcher:
         )
         off = 0
         for r in live:
-            r.fulfill(d2[off:off + r.rows, :r.k], ids[off:off + r.rows, :r.k])
+            r.fulfill(d2[off:off + r.rows, :r.k],
+                      ids[off:off + r.rows, :r.k],
+                      degraded=forced, gear=gear)
             off += r.rows
             self._lat["dispatch"].observe(done - r.dispatched_at)
             self._lat["total"].observe(done - r.enqueued_at)
@@ -228,8 +326,15 @@ class MicroBatcher:
             )
 
     def _run_fallback(self, req: PendingRequest, reason: str) -> None:
-        """Answer one straggler through the exact brute-force path."""
+        """Answer one straggler (or, at the ladder's floor gear, every
+        request) through the exact brute-force path."""
         self._degraded[reason].inc()
+        # every answered request lands in exactly one gear class: a
+        # deadline straggler's brute-force answer is EXACT (the gear
+        # classes partition answers, and only the ladder's floor gear
+        # is the brute-deadline class)
+        self._by_gear["brute-deadline" if reason == "brute-deadline"
+                      else "exact"].inc()
         try:
             d2, ids = self.engine.fallback_knn(req.queries, req.k)
         except Exception as e:
@@ -240,7 +345,9 @@ class MicroBatcher:
             req.fail(f"fallback dispatch failed: {e!r}")
             return
         done = time.monotonic()
-        req.fulfill(d2, ids, degraded=reason)
+        req.fulfill(d2, ids, degraded=reason,
+                    gear="brute-deadline" if reason == "brute-deadline"
+                    else None)
         if req.dispatched_at is not None:
             self._lat["dispatch"].observe(done - req.dispatched_at)
         self._lat["total"].observe(done - req.enqueued_at)

@@ -59,13 +59,18 @@ HANG_MAX_S = 600.0
 _KINDS = ("latency", "error", "hang", "drop")
 
 # the injection-site names the serving stack exposes (docs/SERVING.md):
-# the shard request path and the health probe the router's ejection
-# loop reads. A bounded, documented enum — not an open namespace: a
-# typo'd site ("helthz") must be a parse error, or the drill it was
-# meant to arm observes zero failures and passes vacuously.
+# the shard request path, the health probe the router's ejection loop
+# reads, and the batch worker's dispatch (the site that inflates the
+# SERVER-side request histograms — the deterministic overload the
+# degradation ladder's drills and tests step down under; an HTTP-layer
+# knn=latency only slows the client's view, the batcher never sees it).
+# A bounded, documented enum — not an open namespace: a typo'd site
+# ("helthz") must be a parse error, or the drill it was meant to arm
+# observes zero failures and passes vacuously.
 SITE_KNN = "knn"
 SITE_HEALTHZ = "healthz"
-KNOWN_SITES = (SITE_KNN, SITE_HEALTHZ)
+SITE_BATCH = "batch"
+KNOWN_SITES = (SITE_KNN, SITE_HEALTHZ, SITE_BATCH)
 
 
 class FaultSpecError(ValueError):

@@ -47,11 +47,25 @@ class ServeEngine:
         # to id -1 through the gid table
         self._flat_pts = tree.bucket_pts.reshape(-1, tree.dim)
         self._flat_gid = tree.bucket_gid.reshape(-1)
+        # facts about the LAST knn_batch dispatch (batch worker is the
+        # only steady-state caller — same single-reader contract as the
+        # mutable engine's last_answer_epoch): which visit cap answered
+        # (None = exact) and the recall estimate that cap carries
+        # (measured calibration when one exists, the requested target
+        # otherwise, 1.0 for exact)
+        self.last_visit_cap: Optional[int] = None
+        self.last_recall_estimate: float = 1.0
 
     def knn_batch(
         self, queries: np.ndarray,
+        recall_target: Optional[float] = None,
     ) -> Tuple[np.ndarray, np.ndarray, str]:
-        """Exact k-NN for one padded micro-batch via the tiled engine.
+        """k-NN for one padded micro-batch via the tiled engine — exact
+        by default, bounded-visit approximate under a ``recall_target``
+        (docs/SERVING.md "Degradation ladder": the target resolves to a
+        visit cap through the plan store's measured calibration, or the
+        conservative heuristic on a calibration miss; ``None`` is the
+        exact path, byte-identical to before the dial existed).
 
         Returns host arrays (d2 f32[Q, k], ids i32[Q, k]) plus the plan
         source ("warm" | "heuristic" | "explicit") — resolved here, once,
@@ -66,18 +80,41 @@ class ServeEngine:
         Q, D = queries.shape
         plan = plan_tiled(Q, D, t.n_real, t.num_buckets, t.bucket_size,
                           self.k)
+        visit_cap = None
+        estimate = 1.0
+        if recall_target is not None:
+            from kdtree_tpu import approx, tuning
+
+            prof = (tuning.profile_for(plan.sig)
+                    if plan.sig is not None else None)
+            visit_cap = approx.resolve_visit_cap(
+                recall_target, t.num_buckets, self.k, t.bucket_size,
+                profile=prof,
+            )
+            if visit_cap is not None:
+                measured = (prof or {}).get("recall_measured") or {}
+                try:
+                    estimate = float(
+                        measured.get(f"{float(recall_target):g}",
+                                     recall_target))
+                except (TypeError, ValueError):
+                    estimate = float(recall_target)
         # block shape rides in the span args: a serving-process capture
         # (/debug/profile) then shows which scan regime each batch
         # dispatched with — warm plans carry tuner-swept v/tb
         # (docs/TUNING.md "Raw speed")
         with obs.span("serve.batch", sync=False, q=Q, plan=plan.source,
-                      v=plan.v, tb=plan.tb):
+                      v=plan.v, tb=plan.tb, visit_cap=visit_cap):
             d2, gid = morton_knn_tiled(
-                t, jnp.asarray(queries), k=self.k, plan=plan
+                t, jnp.asarray(queries), k=self.k, plan=plan,
+                visit_cap=visit_cap,
             )
             # response materialization boundary: the batch is complete and
             # per-request slices leave as JSON from here
             out = (np.asarray(d2), np.asarray(gid))  # kdt-lint: disable=KDT201 response boundary: the batch result must be host-materialized to answer HTTP requests
+        self.last_visit_cap = visit_cap
+        self.last_recall_estimate = estimate if visit_cap is not None \
+            else 1.0
         return out[0], out[1], plan.source
 
     def fallback_knn(
@@ -114,6 +151,7 @@ class ServeState:
         history_period_s: Optional[float] = None,
         id_offset: int = 0,
         read_only: bool = False,
+        ladder_enabled: bool = False,
     ) -> None:
         self.engine = engine
         self.max_batch = max_batch
@@ -139,6 +177,15 @@ class ServeState:
         # from the snapshot stream it converges by (docs/SERVING.md
         # "Snapshots & replica fleets")
         self.read_only = bool(read_only)
+        # the degradation ladder's master switch (docs/SERVING.md
+        # "Degradation ladder"): off, serving has exactly the pre-dial
+        # two gears (exact / brute-force stragglers). The serving CLI
+        # arms it (its warmup runs BEFORE traffic, so steady-state p99
+        # measures real dispatches); in-process embedders — tests
+        # included — opt in, because a cold engine's compile latency
+        # reads as a burn and would downshift answers that callers
+        # pinned as exact.
+        self.ladder_enabled = bool(ladder_enabled)
         self._ready = threading.Event()
         self._ready_gauge = obs.get_registry().gauge("kdtree_serve_ready")
         self._ready_gauge.set(0)
@@ -232,6 +279,7 @@ def build_state(
     read_only: bool = False,
     epoch0: int = 0,
     snapshot_sink=None,
+    ladder_enabled: bool = False,
 ) -> ServeState:
     """Assemble a ready-to-warmup :class:`ServeState` from exactly one
     index source: a loaded ``tree``, a materialized ``points`` array, or
@@ -293,7 +341,8 @@ def build_state(
         from kdtree_tpu.obs import slo as obs_slo
 
         slo_engine = obs_slo.SloEngine(
-            specs=obs_slo.default_specs() + obs_slo.mutable_specs(),
+            specs=(obs_slo.default_specs() + obs_slo.mutable_specs()
+                   + obs_slo.recall_specs()),
             history=obs_history.get_history(),
         )
     return ServeState(
@@ -306,4 +355,5 @@ def build_state(
         history_period_s=history_period_s,
         id_offset=id_offset,
         read_only=read_only,
+        ladder_enabled=ladder_enabled,
     )

@@ -419,6 +419,36 @@ def merge_topk(
     return out_d, out_i, kk
 
 
+def merge_gear(payloads: List[dict]) -> Optional[str]:
+    """The merged answer's gear token (docs/SERVING.md "Degradation
+    ladder") — the recall accounting the (distance, id) merge
+    preserves: every global top-k member lives in exactly ONE shard and
+    sits inside that shard's own top-k, and the merge keeps any found
+    member (at most k-1 candidates can beat it), so the merged recall
+    is bounded below by the worst shard's. The token therefore reports
+    the MINIMUM recall target any shard answered at; exact-everywhere
+    merges carry no gear, and a brute-deadline shard (exact, just slow)
+    surfaces only when no approximate gear outranks it."""
+    worst: Optional[float] = None
+    brute = False
+    for p in payloads:
+        g = p.get("gear")
+        if not isinstance(g, str):
+            continue
+        if g.startswith("approx:"):
+            try:
+                t = float(g.split(":", 1)[1])
+            except ValueError:
+                continue
+            if worst is None or t < worst:
+                worst = t
+        elif g == "brute-deadline":
+            brute = True
+    if worst is not None:
+        return f"approx:{worst:g}"
+    return "brute-deadline" if brute else None
+
+
 class RouterHandler(JsonRequestHandler):
     """Scatter/gather glue; pure host code (no jax anywhere in the
     router process's request path). Serialization + keep-alive timeout
@@ -515,6 +545,18 @@ class RouterHandler(JsonRequestHandler):
         if k is not None and (not isinstance(k, int) or isinstance(k, bool)
                               or k < 1):
             self._send_json(400, {"error": "k must be a positive int"})
+            return
+        # recall_target rides to every shard in the VERBATIM body (the
+        # scatter forwards bytes); reject a malformed one here instead
+        # of fanning out a request every shard will 400 — through the
+        # SAME validator the shards use, so the contracts cannot drift
+        from kdtree_tpu.approx.search import (
+            RECALL_TARGET_ERROR,
+            parse_recall_target,
+        )
+
+        if not parse_recall_target(payload.get("recall_target"))[0]:
+            self._send_json(400, {"error": RECALL_TARGET_ERROR})
             return
         code, out, headers = self.server.route_knn(body, k, trace)
         self._send_json(code, out, extra_headers=headers)
@@ -1023,16 +1065,21 @@ class Router(GracefulHTTPServer):
             degraded = next(
                 (p["degraded"] for p in payloads if p.get("degraded")), None
             )
+            gear = merge_gear(payloads)
             self._count_request("ok")
-            return 200, {
+            out = {
                 "k": kk, "ids": ids, "distances": dists,
                 "degraded": degraded, "trace_id": trace,
                 "shards": {"total": n, "answered": n, "missing": []},
-            }, None
+            }
+            if gear is not None:
+                out["gear"] = gear
+            return 200, out, None
         if len(payloads) >= self.quorum:
             # partial degradation: exact over the answered shards,
             # honestly flagged — never a silent wrong answer
             dists, ids, kk = merge_topk(payloads, k)
+            gear = merge_gear(payloads)
             self._partial.inc()
             self._count_request("partial")
             flight.record(
@@ -1041,13 +1088,16 @@ class Router(GracefulHTTPServer):
                 outcomes={str(i): e.outcome for i, e in errors.items()},
             )
             flight.auto_dump("route-partial")
-            return 200, {
+            out = {
                 "k": kk, "ids": ids, "distances": dists,
                 "degraded": f"partial:{len(payloads)}/{n}",
                 "trace_id": trace,
                 "shards": {"total": n, "answered": len(payloads),
                            "missing": missing},
-            }, None
+            }
+            if gear is not None:
+                out["gear"] = gear
+            return 200, out, None
         self._count_request("unavailable")
         flight.record(
             "route.unavailable", trace=trace, answered=len(payloads),

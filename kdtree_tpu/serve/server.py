@@ -334,6 +334,18 @@ class KnnRequestHandler(JsonRequestHandler):
                     # a burning p99 wants traffic drained elsewhere, not
                     # the replica marked dead (docs/SERVING.md)
                     body["slo"] = state.slo_engine.health_block()
+                ladder = getattr(self.server, "ladder", None)
+                if ladder is not None and ladder.enabled:
+                    spec = ladder.spec()
+                    # the engaged degradation gear: a fleet's gear
+                    # distribution is one /healthz sweep (the loadgen
+                    # capacity block and the router's shard report both
+                    # read it from here)
+                    body["ladder"] = {
+                        "gear": ladder.gear(),
+                        "name": spec.name,
+                        "recall_target": spec.recall_target,
+                    }
                 self._send_json(200, body)
             else:
                 self._send_json(503, {"status": "warming"},
@@ -386,7 +398,7 @@ class KnnRequestHandler(JsonRequestHandler):
         parsed = self._parse_knn_body()
         if parsed is None:
             return  # error response already sent
-        queries, k, deadline_s = parsed
+        queries, k, deadline_s, recall_target = parsed
         state: ServeState = self.server.state
         if not state.ready:
             _count_request("unready")
@@ -442,7 +454,8 @@ class KnnRequestHandler(JsonRequestHandler):
         import time as _time
 
         deadline = (_time.monotonic() + deadline_s) if deadline_s else None
-        req = PendingRequest(queries, k, deadline, trace_id=trace)
+        req = PendingRequest(queries, k, deadline, trace_id=trace,
+                             recall_target=recall_target)
         try:
             self.server.queue.submit(req)
         except QueueFullError:
@@ -471,15 +484,17 @@ class KnnRequestHandler(JsonRequestHandler):
         _count_request("degraded" if req.degraded else "ok")
         self._send_json(
             200, self._result_json(req.d2, req.ids, k, degraded=req.degraded,
-                                   trace_id=trace)
+                                   trace_id=trace, gear=req.gear)
         )
 
     def _parse_knn_body(
         self,
-    ) -> Optional[Tuple[np.ndarray, int, Optional[float]]]:
-        """Validated (queries f32[q, D], k, deadline seconds | None), or
-        None with the 4xx already written. Every rejection names what was
-        wrong — the same crisp-contract idea as the CLI's loaders."""
+    ) -> Optional[Tuple[np.ndarray, int, Optional[float],
+                        Optional[float]]]:
+        """Validated (queries f32[q, D], k, deadline seconds | None,
+        recall_target | None), or None with the 4xx already written.
+        Every rejection names what was wrong — the same crisp-contract
+        idea as the CLI's loaders."""
         state: ServeState = self.server.state
         payload = self._read_json_object()
         if payload is None:
@@ -526,7 +541,24 @@ class KnnRequestHandler(JsonRequestHandler):
                                                "positive number"})
                 return None
             deadline_s = float(deadline_ms) / 1e3
-        return queries, k, deadline_s
+        # the recall dial (docs/SERVING.md "Degradation ladder"):
+        # absent = exact, byte-identical to a server without the dial;
+        # a target in (0, 1) lets this request be answered by the
+        # bounded-visit engine at >= that measured recall; 1.0 is an
+        # explicit way to spell "exact". ONE validator shared with the
+        # router front (approx.parse_recall_target) so the two wire
+        # contracts cannot drift.
+        from kdtree_tpu.approx.search import (
+            RECALL_TARGET_ERROR,
+            parse_recall_target,
+        )
+
+        ok, recall_target = parse_recall_target(
+            payload.get("recall_target"))
+        if not ok:
+            self._send_json(400, {"error": RECALL_TARGET_ERROR})
+            return None
+        return queries, k, deadline_s, recall_target
 
     def _do_write(self, op: str) -> None:
         """``POST /v1/upsert`` / ``/v1/delete``: the mutable-index write
@@ -729,6 +761,7 @@ class KnnRequestHandler(JsonRequestHandler):
     def _result_json(
         self, d2: np.ndarray, ids: np.ndarray, k: int,
         degraded: Optional[str], trace_id: str = "",
+        gear: Optional[str] = None,
     ) -> dict:
         dist = np.sqrt(d2[:, :k].astype(np.float64))
         ids = ids[:, :k]
@@ -738,13 +771,21 @@ class KnnRequestHandler(JsonRequestHandler):
             # by the shard's offset, padding ids stay -1. int64 so a deep
             # shard in a huge partition can't wrap the i32 gid table.
             ids = np.where(ids >= 0, ids.astype(np.int64) + offset, -1)
-        return {
+        out = {
             "k": k,
             "ids": ids.tolist(),
             "distances": dist.tolist(),
             "degraded": degraded,
             "trace_id": trace_id,
         }
+        if gear is not None:
+            # the answering gear (approx.gear_token format): present on
+            # any non-plain-exact answer — including client-REQUESTED
+            # approx, which carries gear WITHOUT degraded (a kept
+            # contract is not a degradation); absent on exact answers
+            # so the default response shape is byte-identical to before
+            out["gear"] = gear
+        return out
 
 
 class KnnServer(GracefulHTTPServer):
@@ -780,11 +821,25 @@ class KnnServer(GracefulHTTPServer):
         self.queue = AdmissionQueue(
             queue_rows if queue_rows is not None else 4 * state.max_batch
         )
+        # the degradation ladder (docs/SERVING.md "Degradation
+        # ladder"): exact → approx(0.99) → approx(0.9) →
+        # brute-force-deadline under sustained burn of the watched
+        # SLOs, one gear per hysteresis window, ticked from the same
+        # sampler tick that evaluates the SLO engine. Disabled
+        # (--no-ladder) it never leaves gear 0 and serving is
+        # byte-identical to before the ladder existed.
+        from kdtree_tpu.approx.ladder import DegradationLadder
+
+        self.ladder = DegradationLadder(
+            state.slo_engine, enabled=state.ladder_enabled,
+        )
         self.batcher = MicroBatcher(
             state.engine, self.queue,
             max_batch=state.max_batch,
             max_wait_ms=max_wait_ms,
             min_bucket=state.min_bucket,
+            ladder=self.ladder,
+            faults=self.faults,
         )
         # the history ring /debug/history serves and the sampler feeds:
         # the SLO engine's own ring when one is wired, else the process
@@ -814,6 +869,9 @@ class KnnServer(GracefulHTTPServer):
         eng = self.state.slo_engine
         if eng is not None:
             eng.evaluate()  # never raises (sampler-thread contract)
+        # the ladder's controller runs on the SAME tick, AFTER the SLO
+        # verdicts it reads were refreshed (never raises either)
+        self.ladder.tick()
 
     def start(self, warmup: bool = True, warmup_buckets=None) -> None:
         """Start the batch worker, the history sampler (+ SLO evaluation

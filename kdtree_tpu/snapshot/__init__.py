@@ -9,6 +9,7 @@ from kdtree_tpu.snapshot.store import (
     SnapshotCorruptError,
     SnapshotError,
     SnapshotSchemaError,
+    list_versions,
     load_snapshot,
     plan_keys_for,
     read_manifest,
@@ -24,6 +25,7 @@ __all__ = [
     "SnapshotError",
     "SnapshotFollower",
     "SnapshotSchemaError",
+    "list_versions",
     "load_snapshot",
     "plan_keys_for",
     "read_manifest",

@@ -183,21 +183,91 @@ def read_manifest(dirpath: str) -> Optional[dict]:
     return man if isinstance(man, dict) else None
 
 
-def _cleanup_stale_segments(dirpath: str, keep_tag: str) -> None:
-    """Best-effort removal of segment files from superseded saves —
-    every save replaces the whole set, so only the manifest's own tag
-    survives (the checkpoint module's crashed-re-save discipline)."""
+def _gen_manifest_name(version: int) -> str:
+    return f"MANIFEST-v{int(version):08d}.json"
+
+
+_GEN_MANIFEST_RE = None
+
+
+def list_versions(dirpath: str) -> List[int]:
+    """Retained generation numbers (ascending) — the versions a
+    ``load_snapshot(..., version=N)`` rollback can still reach."""
+    import re
+
+    global _GEN_MANIFEST_RE
+    if _GEN_MANIFEST_RE is None:
+        _GEN_MANIFEST_RE = re.compile(r"^MANIFEST-v(\d{8})\.json$")
+    dirpath = resolve_dir(dirpath)
+    out = []
     try:
         names = os.listdir(dirpath)
     except OSError:
-        return
+        return out
+    for fname in names:
+        m = _GEN_MANIFEST_RE.match(fname)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def _gc_generations(dirpath: str, keep: int) -> int:
+    """Retention GC (docs/SERVING.md "Snapshots & replica fleets"):
+    keep the newest ``keep`` generation manifests, drop older ones,
+    then remove every ``seg-*.npy`` no RETAINED manifest (the live
+    ``MANIFEST.json`` included) references — segments are refcounted
+    by manifest, so a file shared by two generations survives until
+    both are dropped. Returns the number of generations removed.
+
+    Safety against a concurrent follower load: the live manifest and
+    every retained generation keep their full segment sets, so any
+    reader that saw a retained manifest finds its files. A reader
+    mid-load of a JUST-DROPPED generation can race the unlink — it
+    then fails the missing-segment/checksum check with a NAMED error
+    and retries its poll (the follower's contract); it can never serve
+    a half state. ``keep >= 2`` gives followers a full generation of
+    slack before that race is even reachable."""
+    removed = 0
+    keep = max(int(keep), 1)
+    versions = list_versions(dirpath)
+    for version in versions[:-keep] if len(versions) > keep else []:
+        try:
+            os.remove(os.path.join(dirpath, _gen_manifest_name(version)))
+            removed += 1
+        except OSError:
+            pass
+    referenced = set()
+    manifests = [read_manifest(dirpath)]
+    for version in list_versions(dirpath):
+        try:
+            with open(os.path.join(dirpath,
+                                   _gen_manifest_name(version))) as f:
+                manifests.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    for man in manifests:
+        if not isinstance(man, dict):
+            continue
+        for seg in (man.get("segments") or {}).values():
+            if isinstance(seg, dict) and seg.get("file"):
+                referenced.add(str(seg["file"]))
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return removed
     for fname in names:
         if (fname.startswith("seg-") and fname.endswith(".npy")
-                and f"-{keep_tag}." not in fname):
+                and fname not in referenced):
             try:
                 os.remove(os.path.join(dirpath, fname))
             except OSError:
                 pass
+    if removed:
+        flight.record("snapshot.gc", dir=dirpath, removed=removed,
+                      kept=len(list_versions(dirpath)))
+        obs.get_registry().counter(
+            "kdtree_snapshot_gc_generations_total").inc(removed)
+    return removed
 
 
 def save_snapshot(
@@ -207,9 +277,18 @@ def save_snapshot(
     id_offset: int = 0,
     plan_keys: Optional[List[str]] = None,
     meta: Optional[dict] = None,
+    keep: int = 1,
 ) -> dict:
     """Serialize a built Morton serving index into ``dirpath``; returns
     the manifest dict (its ``version`` is the previous manifest's + 1).
+
+    ``keep`` is the retention depth (``--snapshot-keep``): the newest
+    ``keep`` generations stay loadable — each save also writes a
+    per-generation ``MANIFEST-v*.json``, and the GC drops older
+    generations plus any segment no retained manifest references
+    (refcounted, see :func:`_gc_generations`). ``keep=1`` is the
+    historical behavior: one generation on disk; ``keep=3`` makes
+    ``serve --snapshot DIR --snapshot-version N`` a rollback button.
 
     Only :class:`~kdtree_tpu.ops.morton.MortonTree` is snapshotable —
     it IS the serving representation; adapt other kinds through
@@ -275,19 +354,25 @@ def save_snapshot(
         "created_unix": round(time.time(), 3),
         "meta": dict(meta or {}),
     }
-    tmp = f"{_manifest_path(dirpath)}.tmp-{tag}"
-    try:
-        with open(tmp, "w") as f:
-            json.dump(manifest, f, indent=2, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, _manifest_path(dirpath))
-    except BaseException:
+    # generation manifest FIRST, live MANIFEST.json LAST: a reader that
+    # sees the live manifest sees a complete retained set, and a crash
+    # between the two leaves only an orphan generation file the next
+    # save's GC collects
+    for target in (os.path.join(dirpath, _gen_manifest_name(version)),
+                   _manifest_path(dirpath)):
+        tmp = f"{target}.tmp-{tag}"
         try:
-            os.remove(tmp)
-        except OSError:
-            pass
-        raise
-    _cleanup_stale_segments(dirpath, keep_tag=tag)
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, target)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+    _gc_generations(dirpath, keep=keep)
     dt = time.perf_counter() - t0
     reg = obs.get_registry()
     reg.counter("kdtree_snapshot_saves_total").inc()
@@ -301,8 +386,10 @@ def save_snapshot(
     return manifest
 
 
-def _read_manifest_strict(dirpath: str) -> dict:
-    mpath = _manifest_path(dirpath)
+def _read_manifest_strict(dirpath: str,
+                          version: Optional[int] = None) -> dict:
+    mpath = (_manifest_path(dirpath) if version is None
+             else os.path.join(dirpath, _gen_manifest_name(version)))
     try:
         with open(mpath) as f:
             man = json.load(f)
@@ -336,11 +423,16 @@ def _read_manifest_strict(dirpath: str) -> dict:
 
 
 def load_snapshot(
-    dirpath: str, verify: bool = True,
+    dirpath: str, verify: bool = True, version: Optional[int] = None,
 ) -> Tuple[object, dict]:
     """Load a snapshot into a ready-to-serve
     :class:`~kdtree_tpu.ops.morton.MortonTree`; returns
     ``(tree, manifest)``.
+
+    ``version`` selects a RETAINED generation (``--snapshot-keep``
+    kept it; :func:`list_versions` lists them) instead of the live
+    manifest — the rollback-by-version read path. A version the GC
+    already dropped fails with the named missing-manifest error.
 
     Every segment is checksum-verified BEFORE any of it is handed to
     the engine (``verify=False`` skips the hash for trusted local
@@ -353,7 +445,7 @@ def load_snapshot(
 
     dirpath = resolve_dir(dirpath)
     t0 = time.perf_counter()
-    man = _read_manifest_strict(dirpath)
+    man = _read_manifest_strict(dirpath, version=version)
     sig = man.get("signature", {})
     arrays = {}
     for name in _SEGMENTS:

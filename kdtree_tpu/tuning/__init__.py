@@ -67,6 +67,19 @@ def lookup(
     return prof
 
 
+def profile_for(
+    sig: PlanSignature, store: Optional[PlanStore] = None,
+) -> Optional[dict]:
+    """The RAW (version-checked, launch-knob-free) profile for one
+    signature — the read path for advisory payload like the recall
+    calibration (:mod:`kdtree_tpu.approx`), which may live in a profile
+    no tuner has settled launch knobs into. Does not touch the
+    hit/miss counters: those measure the warm-plan ratio, and a
+    per-batch calibration read would drown it."""
+    store = store if store is not None else default_store()
+    return store.get_raw(sig)
+
+
 __all__ = [
     "ENV_CACHE_DIR",
     "PlanFeedback",
@@ -78,4 +91,5 @@ __all__ = [
     "lookup",
     "make_signature",
     "occupancy_p90_hint",
+    "profile_for",
 ]

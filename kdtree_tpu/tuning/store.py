@@ -144,6 +144,15 @@ class PlanStore:
     def get(self, sig: PlanSignature) -> Optional[dict]:
         """The validated profile for ``sig``, or None on miss / corrupt
         file / stale version / unusable launch knobs."""
+        return self._validate(self.get_raw(sig))
+
+    def get_raw(self, sig: PlanSignature) -> Optional[dict]:
+        """The version-checked profile for ``sig`` WITHOUT the
+        launch-knob requirement — for advisory-payload consumers (the
+        recall calibration, occupancy enrichment) reading a profile no
+        tuner has settled launch knobs into yet. LAUNCHING from a
+        profile still goes through :meth:`get` — same split as
+        :meth:`scan` documents."""
         if not self.enabled:
             return None
         path = self.path_for(sig)
@@ -165,16 +174,23 @@ class PlanStore:
         except OSError:
             return None  # transient read error: retry next call
         else:
-            prof = self._validate(prof)
+            prof = self._version_check(prof)
         _read_memo[path] = (st.st_mtime_ns, st.st_size, prof)
         return prof
 
     @staticmethod
-    def _validate(prof) -> Optional[dict]:
+    def _version_check(prof) -> Optional[dict]:
         if not isinstance(prof, dict):
             return None
         if prof.get("version") != PROFILE_VERSION:
             return None  # stale format: treat as a miss, never guess
+        return prof
+
+    @classmethod
+    def _validate(cls, prof) -> Optional[dict]:
+        prof = cls._version_check(prof)
+        if prof is None:
+            return None
         for field in _REQUIRED_INT_FIELDS:
             v = prof.get(field)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
@@ -206,7 +222,7 @@ class PlanStore:
             os.replace(tmp, path)
             st = os.stat(path)
             _read_memo[path] = (st.st_mtime_ns, st.st_size,
-                                self._validate(rec))
+                                self._version_check(rec))
         except OSError:
             try:
                 os.unlink(tmp)
@@ -255,7 +271,11 @@ class PlanStore:
         call must not rewrite the file each time."""
         if not self.enabled:
             return False
-        existing = self.get(sig) or {}
+        # merge over the RAW profile: an advisory-only profile (e.g. a
+        # recall calibration written before any tuner settled launch
+        # knobs) fails get()'s launch validation, and merging over the
+        # resulting None would silently erase it on the next feedback
+        existing = self.get_raw(sig) or {}
         base = {
             k: v for k, v in existing.items()
             if k not in ("version", "signature", "updated_unix")

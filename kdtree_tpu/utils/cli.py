@@ -792,6 +792,7 @@ def cmd_build(args) -> None:
             args.save, serving, epoch=0,
             plan_keys=snap.plan_keys_for(serving, k=16),
             meta=dict(meta),
+            keep=max(getattr(args, "snapshot_keep", 1) or 1, 1),
         )
         print(f"serving snapshot v{man['version']} (epoch "
               f"{man['epoch']}, n={man['signature']['n_real']}) saved "
@@ -900,6 +901,17 @@ def cmd_serve(args) -> None:
               "secondary adopts snapshots, only the shard primary emits "
               "them", file=sys.stderr)
         sys.exit(1)
+    snap_version = getattr(args, "snapshot_version", None)
+    if snap_version is not None and not snap_dir:
+        print("--snapshot-version needs --snapshot DIR (the retained "
+              "generation to roll back to)", file=sys.stderr)
+        sys.exit(1)
+    if snap_version is not None and follow_s is not None:
+        print("--snapshot-version and --snapshot-follow are exclusive: "
+              "a follower converges to the LIVE manifest, which would "
+              "immediately replace the pinned generation",
+              file=sys.stderr)
+        sys.exit(1)
     tree = points = problem = None
     meta = {}
     epoch0 = 0
@@ -915,7 +927,8 @@ def cmd_serve(args) -> None:
         from kdtree_tpu import snapshot as snap
 
         try:
-            tree, man = snap.load_snapshot(snap_dir)
+            tree, man = snap.load_snapshot(snap_dir,
+                                           version=snap_version)
             epoch0 = int(man.get("epoch", 0))
             loaded_version = int(man.get("version", 0))
             loaded_from_snapshot = True
@@ -996,10 +1009,13 @@ def cmd_serve(args) -> None:
 
         def snapshot_sink(tree_, epoch, _dir=save_dir,
                           _off=id_offset, _k=args.k,
-                          _mb=args.max_batch):
+                          _mb=args.max_batch,
+                          _keep=max(getattr(args, "snapshot_keep", 1)
+                                    or 1, 1)):
             snap.save_snapshot(
                 _dir, tree_, epoch=epoch, id_offset=_off,
                 plan_keys=snap.plan_keys_for(tree_, _k, _mb),
+                keep=_keep,
             )
     try:
         state = lifecycle.build_state(
@@ -1011,6 +1027,7 @@ def cmd_serve(args) -> None:
             read_only=follow_s is not None,
             epoch0=epoch0,
             snapshot_sink=snapshot_sink,
+            ladder_enabled=not getattr(args, "no_ladder", False),
         )
     except TypeError as e:
         # un-servable checkpoint kind — crisp stderr + exit code (C10)
@@ -1068,6 +1085,12 @@ def cmd_serve(args) -> None:
           "rebuild at backlog >= "
           f"{'disabled' if thr is None else thr} rows "
           "(docs/SERVING.md \"Mutable index\")", file=sys.stderr)
+    if state.ladder_enabled:
+        print("degradation ladder armed: exact -> approx(0.99) -> "
+              "approx(0.9) -> brute-force-deadline under sustained "
+              "burn; per-request recall_target on /v1/knn "
+              "(docs/SERVING.md \"Degradation ladder\")",
+              file=sys.stderr)
     print(f"kdtree-tpu serve: binding http://{host}:{port} "
           f"(n={state.engine.tree.n_real}, dim={state.engine.tree.dim}, "
           f"k<={state.engine.k}); warming up...", file=sys.stderr)
@@ -1211,6 +1234,11 @@ def cmd_loadgen(args) -> None:
     except ValueError as e:
         print(f"bad --mix: {e}", file=sys.stderr)
         sys.exit(1)
+    try:
+        recall_mix = lg_schedule.parse_recall_mix(args.recall_target)
+    except ValueError as e:
+        print(f"bad --recall-target: {e}", file=sys.stderr)
+        sys.exit(1)
     if round(args.slo_quantile, 4) not in (0.5, 0.95, 0.99):
         # fail BEFORE the sweep runs: the knee must be judged at a
         # quantile the steps actually report, never silently at p99
@@ -1233,6 +1261,7 @@ def cmd_loadgen(args) -> None:
             rates, args.step_seconds, args.seed, dim, mix=mix,
             regions=args.regions, zipf_s=args.zipf_s, shape=args.shape,
             diurnal_amp=args.diurnal_amp, write_base=write_base,
+            recall_mix=recall_mix,
         )
     except ValueError as e:
         print(f"cannot build schedule: {e}", file=sys.stderr)
@@ -1576,6 +1605,76 @@ def cmd_tune(args) -> None:
     }))
 
 
+def cmd_recall(args) -> None:
+    """The recall harness (docs/SERVING.md "Degradation ladder"):
+    sweep bounded-visit caps over a seeded problem against the exact
+    oracle, print the recall@k-vs-speedup curve, persist the measured
+    recall_target → visit_cap calibration into the plan store (unless
+    --no-calibrate), and emit the curve as the sidecar "recall" block
+    `kdtree-tpu trend` gates on."""
+    from kdtree_tpu import approx, tuning
+    from kdtree_tpu.ops.generate import generate_points_rowwise, generate_queries
+    from kdtree_tpu.ops.morton import build_morton
+
+    if args.generator != "threefry":
+        print("note: recall defines its points by the threefry row "
+              f"stream; --generator {args.generator} does not apply",
+              file=sys.stderr)
+    caps = _parse_int_list(args.caps, "caps")
+    pts = generate_points_rowwise(args.seed, args.dim, args.n)
+    # a distinct seed for the query sample — measuring recall on
+    # query==point geometry would flatter every cap (same idiom as tune)
+    queries = generate_queries(args.seed + 1, args.dim, args.q)
+    tree = build_morton(pts)
+    print(f"recall sweep: n={args.n} dim={args.dim} q={args.q} "
+          f"k={args.k} buckets={tree.num_buckets}", file=sys.stderr)
+
+    def log(row):
+        print(f"  cap={row['visit_cap']:<6d} recall={row['recall']:.4f} "
+              f"{row['qps']:>10.0f} q/s  {row['speedup']:>6.2f}x",
+              file=sys.stderr)
+
+    block = approx.sweep_recall(tree, queries, k=args.k, caps=caps,
+                                log=log)
+    cal = {"recall_caps": {}, "persisted": False, "path": None}
+    if not args.no_calibrate:
+        from kdtree_tpu.approx.recall import persist_calibration
+
+        cal = persist_calibration(tree, args.q, args.dim, args.k, block,
+                                  store=tuning.default_store())
+        if cal["persisted"]:
+            print(f"calibration persisted to {cal['path']}: "
+                  f"{cal['recall_caps']}", file=sys.stderr)
+        elif cal["path"] is None:
+            print("plan store disabled (KDTREE_TPU_PLAN_CACHE=none); "
+                  "calibration not persisted", file=sys.stderr)
+    if args.out:
+        import os
+
+        report = {
+            "recall_report_version": 1,
+            "recall": block,
+            "calibration": cal["recall_caps"],
+        }
+        tmp = f"{args.out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.out)
+        print(f"recall report written to {args.out}", file=sys.stderr)
+    # the telemetry sidecar carries the same block, so one artifact is
+    # a self-contained `kdtree-tpu trend` input (like loadgen's
+    # capacity block)
+    args._telemetry_extra = {"recall": block}
+    print(json.dumps({
+        "exact_qps": block["exact_qps"],
+        "caps": len(block["curve"]),
+        "calibration": cal["recall_caps"],
+        "persisted": cal["persisted"],
+        "out": args.out,
+    }))
+
+
 def _flight_dump_on_failure() -> None:
     """Dump the flight ring on a failed CLI exit (KDTREE_TPU_FLIGHT_DIR
     governs where; =none disables). The dump observes the failure — it
@@ -1663,6 +1762,13 @@ def main(argv=None) -> None:
                          "mmap-load in seconds — the replica-fleet "
                          "cold-start artifact (docs/SERVING.md "
                          "\"Snapshots & replica fleets\")")
+    bu.add_argument("--snapshot-keep", type=int, default=1,
+                    metavar="N",
+                    help="with --save: retain the last N snapshot "
+                         "generations (segments refcounted by "
+                         "manifest; older generations GC'd) — "
+                         "`serve --snapshot DIR --snapshot-version V` "
+                         "rolls back to a retained one (default 1)")
     bu.add_argument("--sharded", action="store_true",
                     help="force the per-device shard checkpoint format "
                          "(forest engines auto-shard above 1 GiB)")
@@ -1760,6 +1866,23 @@ def main(argv=None) -> None:
                          "rebuild from the seeded --seed/--dim/--n "
                          "problem instead of exiting (--points falls "
                          "back automatically)")
+    sv.add_argument("--snapshot-keep", type=int, default=1, metavar="N",
+                    help="with --snapshot-save: retain the last N "
+                         "snapshot generations across epoch emits "
+                         "(rollback-by-version; default 1 — one "
+                         "generation, the historical layout)")
+    sv.add_argument("--snapshot-version", type=int, default=None,
+                    metavar="V",
+                    help="with --snapshot: load a RETAINED generation "
+                         "V instead of the live manifest — the "
+                         "rollback button --snapshot-keep enables")
+    sv.add_argument("--no-ladder", action="store_true",
+                    help="disable the degradation ladder (exact -> "
+                         "approx(0.99) -> approx(0.9) -> brute-force-"
+                         "deadline under sustained SLO burn, "
+                         "docs/SERVING.md \"Degradation ladder\"); "
+                         "without it overload has only the historical "
+                         "two gears")
     sv.add_argument("--debug-faults", action="store_true",
                     help="arm POST /debug/faults (live fault injection, "
                          "docs/SERVING.md) — a remote wedge-this-process "
@@ -1831,6 +1954,13 @@ def main(argv=None) -> None:
     lg.add_argument("--seed", type=int, default=42,
                     help="schedule seed: same seed = identical arrival "
                          "times, ops, and payloads")
+    lg.add_argument("--recall-target", default=None, metavar="MIX",
+                    help="recall dial for the QUERY share of the mix: "
+                         "a single target ('0.99'), or a weighted mix "
+                         "('exact:0.5,0.99:0.3,0.9:0.2') so capacity "
+                         "curves are driven per serving gear; each "
+                         "step records the gear distribution it was "
+                         "answered at (default: all exact)")
     lg.add_argument("--k", type=int, default=4,
                     help="neighbors per query (clamped to the target's "
                          "k_max)")
@@ -1946,6 +2076,35 @@ def main(argv=None) -> None:
                     help="skip the block-shape phase (sweep only the "
                          "(tile, cmax) launch grid)")
     tu.set_defaults(fn=cmd_tune)
+
+    rc = sub.add_parser(
+        "recall",
+        help="recall harness: sweep bounded-visit caps against the "
+             "exact oracle, emit the recall@k-vs-speedup curve (a "
+             "trend-gated sidecar block), and persist the "
+             "recall_target -> visit_cap calibration to the plan "
+             "store (docs/SERVING.md \"Degradation ladder\")",
+    )
+    rc.add_argument("--seed", type=int, default=42)
+    rc.add_argument("--dim", type=int, default=3)
+    rc.add_argument("--n", type=int, default=1 << 20,
+                    help="point count of the seeded problem to measure")
+    rc.add_argument("--q", type=int, default=16384,
+                    help="query-sample size; the calibration persists "
+                         "for every serve batch bucket up to this Q")
+    rc.add_argument("--k", type=int, default=16)
+    rc.add_argument("--caps", default=None, metavar="C1,C2,...",
+                    help="visit caps to sweep (default: powers of two "
+                         "up to the bucket count; the full-cap point "
+                         "pins recall 1.0)")
+    rc.add_argument("--no-calibrate", action="store_true",
+                    help="measure only; do not persist the "
+                         "recall_target -> visit_cap table")
+    rc.add_argument("--out", default="recall_report.json",
+                    metavar="FILE",
+                    help="standalone recall report artifact (a "
+                         "kdtree-tpu trend input); '' disables")
+    rc.set_defaults(fn=cmd_recall)
 
     tr = sub.add_parser(
         "trend",

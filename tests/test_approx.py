@@ -1,0 +1,471 @@
+"""The recall dial (kdtree_tpu/approx/, docs/SERVING.md "Degradation
+ladder").
+
+The contract under test has three layers:
+
+- **search**: recall@k is monotone in visit_cap (truncations of one
+  fixed lb-ascending ranking are nested), and the full cap is
+  byte-identical to the exact tiled engine across shapes — the
+  exactness contract is untouched by default;
+- **calibration**: the harness's measured recall_target → visit_cap
+  table round-trips through the plan store and resolves at serving
+  batch signatures; an uncalibrated target falls back to the
+  documented conservative heuristic;
+- **serving**: a /v1/knn recall_target answers with the gear echoed
+  (NOT flagged degraded — a kept contract is no degradation), requests
+  without one stay byte-identical to the oracle, and the degradation
+  ladder steps down under a deterministic injected dispatch-latency
+  fault and climbs back after it clears — transitions on /metrics and
+  in the flight ring.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import approx, obs
+from kdtree_tpu.approx.ladder import GEARS, DegradationLadder, gear_token
+from kdtree_tpu.approx.recall import (
+    calibrate_caps,
+    persist_calibration,
+    recall_at_k,
+    sweep_recall,
+)
+from kdtree_tpu.approx.search import resolve_visit_cap
+
+SEED = 7
+
+
+@pytest.fixture(scope="module")
+def tree():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+    from kdtree_tpu.ops.morton import build_morton
+
+    return build_morton(generate_points_rowwise(SEED, 3, 20000))
+
+
+@pytest.fixture(scope="module")
+def queries():
+    from kdtree_tpu.ops.generate import generate_queries
+
+    return generate_queries(SEED + 1, 3, 1024)
+
+
+# ---------------------------------------------------------------------------
+# bounded-visit search: monotonicity + full-cap byte-identity
+# ---------------------------------------------------------------------------
+
+
+def test_recall_monotone_in_visit_cap(tree, queries):
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    _, exact_ids = morton_knn_tiled(tree, queries, k=8)
+    exact_ids = np.asarray(exact_ids)
+    prev = 0.0
+    for cap in (1, 2, 4, 8, 16, 32, tree.num_buckets):
+        _, ids = approx.morton_knn_approx(tree, queries, k=8,
+                                          visit_cap=cap)
+        r = recall_at_k(np.asarray(ids), exact_ids)
+        assert r >= prev - 1e-12, (cap, r, prev)
+        prev = r
+    assert prev == 1.0  # the full cap finds everything
+
+
+@pytest.mark.parametrize("dim,n,k", [(2, 3000, 1), (3, 20000, 8),
+                                     (4, 6000, 16)])
+def test_full_cap_byte_identical_across_shapes(dim, n, k):
+    from kdtree_tpu.ops.generate import (
+        generate_points_rowwise,
+        generate_queries,
+    )
+    from kdtree_tpu.ops.morton import build_morton
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    t = build_morton(generate_points_rowwise(SEED, dim, n))
+    q = generate_queries(SEED + 1, dim, 512)
+    d2e, ide = morton_knn_tiled(t, q, k=k)
+    d2a, ida = approx.morton_knn_approx(t, q, k=k,
+                                        visit_cap=t.num_buckets)
+    assert np.array_equal(np.asarray(d2a), np.asarray(d2e))
+    assert np.array_equal(np.asarray(ida), np.asarray(ide))
+
+
+def test_approx_answers_are_exact_over_visited_points(tree, queries):
+    """Approximate distances are never estimates: every returned
+    (distance, id) pair is a true pair — the only error mode is a
+    missing member."""
+    _, ids = approx.morton_knn_approx(tree, queries, k=4, visit_cap=4)
+    d2, _ = approx.morton_knn_approx(tree, queries, k=4, visit_cap=4)
+    flat_pts = np.asarray(tree.bucket_pts).reshape(-1, tree.dim)
+    flat_gid = np.asarray(tree.bucket_gid).reshape(-1)
+    by_gid = {int(g): flat_pts[i] for i, g in enumerate(flat_gid)
+              if g >= 0}
+    q = np.asarray(queries)
+    ids = np.asarray(ids)
+    d2 = np.asarray(d2)
+    for qi in (0, 17, 1023):
+        for j in range(4):
+            gid = int(ids[qi, j])
+            if gid < 0:
+                continue
+            true_d2 = float(((q[qi] - by_gid[gid]) ** 2).sum())
+            assert d2[qi, j] == pytest.approx(true_d2, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# recall_at_k semantics
+# ---------------------------------------------------------------------------
+
+
+def test_recall_at_k_padding_and_empty_truth():
+    exact = np.array([[1, 2, -1], [-1, -1, -1]])
+    found = np.array([[1, -1, -1], [-1, -1, -1]])
+    # row 0: 1 of 2 real ids found; row 1: nothing to find = 1.0
+    assert recall_at_k(found, exact) == pytest.approx((0.5 + 1.0) / 2)
+    with pytest.raises(ValueError):
+        recall_at_k(np.zeros((2, 3)), np.zeros((2, 4)))
+
+
+# ---------------------------------------------------------------------------
+# resolution: calibration first, heuristic fallback
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_exact_for_none_and_full_target():
+    assert resolve_visit_cap(None, 256, 8, 64) is None
+    assert resolve_visit_cap(1.0, 256, 8, 64) is None
+
+
+def test_resolve_prefers_smallest_covering_calibrated_cap():
+    prof = {"recall_caps": {"0.9": 12, "0.99": 40, "0.5": 4}}
+    assert resolve_visit_cap(0.9, 256, 8, 64, profile=prof) == 12
+    assert resolve_visit_cap(0.95, 256, 8, 64, profile=prof) == 40
+    # below every calibrated target: the smallest covering one wins
+    assert resolve_visit_cap(0.4, 256, 8, 64, profile=prof) == 4
+
+
+def test_resolve_heuristic_fallback_and_k_floor():
+    # no calibration: conservative fraction of the bucket count
+    assert resolve_visit_cap(0.99, 256, 8, 64) == 128
+    assert resolve_visit_cap(0.9, 256, 8, 64) == 64
+    # k floor: enough buckets to even hold k real candidates
+    cap = resolve_visit_cap(0.5, 256, 200, 16)
+    assert cap is not None and cap * 16 >= 200
+    # a cap that reaches the bucket count IS exact
+    assert resolve_visit_cap(0.99, 2, 8, 64) is None
+
+
+def test_resolve_ignores_malformed_calibration_entries():
+    prof = {"recall_caps": {"bogus": 3, "0.95": "x", "0.99": True}}
+    # nothing usable: falls back to the heuristic
+    assert resolve_visit_cap(0.9, 256, 8, 64, profile=prof) == 64
+
+
+# ---------------------------------------------------------------------------
+# the harness: sweep + calibration persistence
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_block_monotone_and_calibration(tree, queries):
+    block = sweep_recall(tree, queries, k=8, caps=(2, 8, 32,
+                                                   tree.num_buckets))
+    assert block["recall_version"] == 1
+    curve = block["curve"]
+    assert [r["visit_cap"] for r in curve] == sorted(
+        r["visit_cap"] for r in curve)
+    recalls = [r["recall"] for r in curve]
+    assert recalls == sorted(recalls)
+    assert recalls[-1] == 1.0
+    caps = calibrate_caps(curve, targets=(0.5, 0.99, 1.0))
+    # smallest measured cap per reached target; every value is a
+    # swept cap
+    swept = {r["visit_cap"] for r in curve}
+    assert set(caps.values()) <= swept
+    assert caps["1"] == tree.num_buckets
+
+
+def test_calibrate_caps_omits_unreached_targets():
+    curve = [{"visit_cap": 2, "recall": 0.4},
+             {"visit_cap": 8, "recall": 0.8}]
+    caps = calibrate_caps(curve, targets=(0.5, 0.99))
+    assert caps == {"0.5": 8}  # 0.99 never reached: absent, not lied
+
+
+def test_calibration_roundtrips_to_serving_buckets(tree, queries,
+                                                   tmp_path,
+                                                   monkeypatch):
+    from kdtree_tpu import tuning
+
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE", str(tmp_path))
+    block = sweep_recall(tree, queries, k=8,
+                         caps=(4, 16, tree.num_buckets))
+    out = persist_calibration(tree, queries.shape[0], 3, 8, block)
+    assert out["persisted"]
+    # the calibration resolves at a serving BATCH signature (pow2
+    # bucket well below the sweep's Q), through the raw-profile path
+    sig = tuning.make_signature(8, 3, tree.n_real, 8, tree.bucket_size,
+                                tree.num_buckets, devices=1)
+    prof = tuning.profile_for(sig)
+    assert prof is not None and prof["recall_caps"] == out["recall_caps"]
+    cap = resolve_visit_cap(0.5, tree.num_buckets, 8, tree.bucket_size,
+                            profile=prof)
+    assert cap == int(out["recall_caps"]["0.5"])
+    # a later feedback-style merge must not erase the calibration
+    store = tuning.default_store()
+    store.record(sig, cmax=64)
+    assert tuning.profile_for(sig)["recall_caps"] == out["recall_caps"]
+
+
+# ---------------------------------------------------------------------------
+# the ladder state machine
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_steps_down_and_recovers_with_hysteresis():
+    lad = DegradationLadder(slo_engine=None, down_after=2, up_after=3)
+    assert lad.gear() == 0
+    assert lad.tick(burning=True) == 0   # one PAGE tick: not yet
+    assert lad.tick(burning=True) == 1   # two: downshift
+    assert lad.tick(burning=True) == 1
+    assert lad.tick(burning=True) == 2
+    for _ in range(10):
+        lad.tick(burning=True)
+    assert lad.gear() == len(GEARS) - 1  # parked at the floor, no wrap
+    assert lad.spec().brute
+    # recovery: up_after consecutive OK ticks per gear, one at a time
+    assert lad.tick(burning=False) == len(GEARS) - 1
+    assert lad.tick(burning=False) == len(GEARS) - 1
+    assert lad.tick(burning=False) == len(GEARS) - 2
+    for _ in range(3 * len(GEARS)):
+        lad.tick(burning=False)
+    assert lad.gear() == 0
+
+
+def test_ladder_disabled_never_shifts_and_gauges_export():
+    reg = obs.get_registry()
+    lad = DegradationLadder(slo_engine=None, enabled=False)
+    for _ in range(10):
+        assert lad.tick(burning=True) == 0
+    on = DegradationLadder(slo_engine=None, down_after=1)
+    on.tick(burning=True)
+    snap = reg.snapshot()
+    assert snap["gauges"]["kdtree_recall_gear"] == 1.0
+    assert snap["gauges"]["kdtree_recall_estimate"] == pytest.approx(
+        0.99)
+    assert snap["counters"][
+        'kdtree_recall_ladder_transitions_total{to="approx-0.99"}'] >= 1
+
+
+def test_gear_tokens():
+    assert gear_token(GEARS[0]) is None
+    assert gear_token(GEARS[1]) == "approx:0.99"
+    assert gear_token(GEARS[2]) == "approx:0.9"
+    assert gear_token(GEARS[3]) == "brute-deadline"
+
+
+def test_router_merge_gear_accounting():
+    from kdtree_tpu.serve.router import merge_gear
+
+    assert merge_gear([{"gear": None}, {}]) is None
+    assert merge_gear([{"gear": "approx:0.99"}, {}]) == "approx:0.99"
+    # the merged recall bound is the WORST shard's target
+    assert merge_gear([{"gear": "approx:0.99"},
+                       {"gear": "approx:0.9"}]) == "approx:0.9"
+    assert merge_gear([{"gear": "brute-deadline"}]) == "brute-deadline"
+    assert merge_gear([{"gear": "brute-deadline"},
+                       {"gear": "approx:0.9"}]) == "approx:0.9"
+
+
+# ---------------------------------------------------------------------------
+# serving e2e: the dial on /v1/knn + the ladder under injected overload
+# ---------------------------------------------------------------------------
+
+
+def _url(httpd, path):
+    return f"http://127.0.0.1:{httpd.server_address[1]}{path}"
+
+
+def _post(httpd, payload, timeout=120.0):
+    req = urllib.request.Request(
+        _url(httpd, "/v1/knn"), data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(httpd, path, timeout=30.0):
+    with urllib.request.urlopen(_url(httpd, path), timeout=timeout) as r:
+        return r.read().decode()
+
+
+@pytest.fixture()
+def dial_server(tree, tmp_path, monkeypatch):
+    """A server with a persisted calibration, the ladder armed over a
+    test-scale SLO window, and a mutable fault set."""
+    from kdtree_tpu.obs import history as obs_history
+    from kdtree_tpu.obs import slo as obs_slo
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.serve import lifecycle, server as srv
+    from kdtree_tpu.serve.faults import FaultSet
+
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE", str(tmp_path))
+    qs = generate_queries(SEED + 1, 3, 512)
+    block = sweep_recall(tree, qs, k=4, caps=(4, 16, tree.num_buckets))
+    persist_calibration(tree, 512, 3, 4, block)
+    # test-scale burn windows so the ladder reacts (and recovers)
+    # within seconds instead of SRE minutes
+    spec = obs_slo.SloSpec(
+        name="request-p99-latency",
+        objective="test: p99 within 150 ms",
+        target=0.99, kind="latency",
+        hist='kdtree_serve_request_seconds{phase="total"}',
+        threshold=0.15,
+        fast=obs_slo.BurnWindow(long_s=1.5, short_s=0.5, max_burn=2.0),
+        slow=obs_slo.BurnWindow(long_s=3.0, short_s=1.0, max_burn=2.0),
+    )
+    engine = obs_slo.SloEngine(specs=[spec],
+                               history=obs_history.MetricHistory())
+    state = lifecycle.build_state(tree=tree, k=4, max_batch=64,
+                                  slo_engine=engine,
+                                  history_period_s=0.05,
+                                  ladder_enabled=True)
+    faults = FaultSet("")
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0,
+                            faults=faults)
+    httpd.start(warmup_buckets=[8])
+    try:
+        yield httpd, faults
+    finally:
+        httpd.stop()
+
+
+def test_recall_target_request_echoes_gear_not_degraded(dial_server,
+                                                        tree):
+    httpd, _ = dial_server
+    q = np.asarray([[0.5, 0.5, 0.5], [0.1, 0.9, 0.2]], dtype=np.float32)
+    status, body = _post(httpd, {"queries": q.tolist(), "k": 4,
+                                 "recall_target": 0.5})
+    assert status == 200
+    assert body["degraded"] is None  # a kept contract, not degradation
+    assert body["gear"] == "approx:0.5"
+    # an explicit 1.0 (and absent) stay exact: no gear field at all
+    for payload in ({"queries": q.tolist(), "k": 4},
+                    {"queries": q.tolist(), "k": 4,
+                     "recall_target": 1.0}):
+        status, body = _post(httpd, payload)
+        assert status == 200 and "gear" not in body
+    # exact answers are byte-identical to the oracle, with approx
+    # traffic interleaved on the same server
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+    d2, ids = morton_knn_tiled(tree, jnp.asarray(q), k=4)
+    assert body["ids"] == np.asarray(ids).tolist()
+    assert body["distances"] == np.sqrt(
+        np.asarray(d2).astype(np.float64)).tolist()
+
+
+def test_recall_target_validation(dial_server):
+    httpd, _ = dial_server
+    for bad in (0.0, -0.5, 1.5, "0.9", True):
+        status, body = _post(httpd, {"queries": [[0.1, 0.2, 0.3]],
+                                     "recall_target": bad})
+        assert status == 400, bad
+        assert "recall_target" in body["error"]
+
+
+@pytest.mark.slow
+def test_ladder_steps_down_and_recovers_under_injected_latency(
+        dial_server):
+    """The acceptance drill: a deterministic dispatch-latency fault
+    burns the watched p99 SLO, the ladder steps down (transitions on
+    /metrics and in the flight ring, forced answers flagged degraded),
+    and after the fault clears the ladder climbs back to exact."""
+    httpd, faults = dial_server
+
+    def gear():
+        for line in _get(httpd, "/metrics").splitlines():
+            if line.startswith("kdtree_recall_gear "):
+                return int(float(line.split()[1]))
+        return None
+
+    assert gear() == 0
+    faults.set_spec("batch=latency:400")
+    q = [[0.4, 0.4, 0.4]]
+    saw_forced = None
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        status, body = _post(httpd, {"queries": q, "k": 2})
+        if status == 200 and body.get("degraded"):
+            saw_forced = body
+            break
+        time.sleep(0.02)
+    assert saw_forced is not None, "ladder never stepped down"
+    assert saw_forced["degraded"].startswith(("approx:",
+                                              "brute-deadline"))
+    assert gear() >= 1
+    flight_dump = json.loads(_get(httpd, "/debug/flight"))
+    shifts = [e for e in flight_dump["events"]
+              if e.get("type") == "ladder.shift"]
+    assert shifts and shifts[0]["to"].startswith("approx")
+    # clear the fault: cheap exact traffic, the burn ages out of the
+    # short windows, and the ladder climbs back gear by gear
+    faults.clear()
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        status, body = _post(httpd, {"queries": q, "k": 2})
+        if status == 200 and not body.get("degraded") and gear() == 0:
+            break
+        time.sleep(0.05)
+    assert gear() == 0, "ladder never recovered"
+    flight_dump = json.loads(_get(httpd, "/debug/flight"))
+    ups = [e for e in flight_dump["events"]
+           if e.get("type") == "ladder.shift"
+           and e.get("reason") == "recovered"]
+    assert ups, "no recovery transition recorded"
+    metrics = _get(httpd, "/metrics")
+    assert 'kdtree_recall_ladder_transitions_total{to="approx-0.99"}' \
+        in metrics
+
+
+# ---------------------------------------------------------------------------
+# review-pass pins
+# ---------------------------------------------------------------------------
+
+
+def test_parse_recall_target_shared_wire_contract():
+    from kdtree_tpu.approx.search import parse_recall_target
+
+    assert parse_recall_target(None) == (True, None)
+    assert parse_recall_target(1.0) == (True, None)  # explicit exact
+    assert parse_recall_target(1) == (True, None)
+    assert parse_recall_target(0.9) == (True, 0.9)
+    for bad in (0.0, -0.5, 1.5, "0.9", True, False):
+        assert parse_recall_target(bad)[0] is False, bad
+
+
+def test_client_requested_approx_never_moves_the_slo_gauge(dial_server):
+    """The served-recall SLO watches the LADDER's engaged gear, never a
+    client-requested target: steady recall_target=0.5 traffic is a
+    kept contract and must not park kdtree_recall_estimate below the
+    SLO floor (which would page on traffic doing exactly what it
+    asked)."""
+    httpd, _ = dial_server
+    q = [[0.3, 0.3, 0.3]]
+    for _ in range(3):
+        status, body = _post(httpd, {"queries": q, "k": 2,
+                                     "recall_target": 0.5})
+        assert status == 200 and body["gear"] == "approx:0.5"
+    snap = obs.get_registry().snapshot()
+    assert snap["gauges"]["kdtree_recall_estimate"] == 1.0

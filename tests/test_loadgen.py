@@ -409,3 +409,84 @@ def test_cli_rejects_unsupported_slo_quantile(capsys):
     err = capsys.readouterr().err
     assert "--slo-quantile must be 0.5, 0.95, or 0.99" in err
     assert "0.9" in err
+
+
+# ---------------------------------------------------------------------------
+# the recall dial in the harness (PR 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_recall_mix_forms_and_validation():
+    from kdtree_tpu.loadgen.schedule import parse_recall_mix
+
+    assert parse_recall_mix(None) is None
+    assert parse_recall_mix("") is None
+    assert parse_recall_mix("exact") is None
+    assert parse_recall_mix("0.99") == [(0.99, 1.0)]
+    mix = parse_recall_mix("exact:1,0.99:2,0.9:1")
+    assert [t for t, _ in mix] == [None, 0.99, 0.9]
+    assert sum(w for _, w in mix) == pytest.approx(1.0)
+    assert dict(mix)[0.99] == pytest.approx(0.5)
+    for bad in ("1.2", "0.99:x", "nope:1", "0.99:-1", "exact:0"):
+        with pytest.raises(ValueError):
+            parse_recall_mix(bad)
+
+
+def test_recall_mix_is_seeded_and_only_on_queries():
+    from kdtree_tpu.loadgen.schedule import parse_recall_mix
+
+    mix = parse_recall_mix("exact:0.5,0.9:0.5")
+    a = build_schedule([200], 1.0, 3, 3, recall_mix=mix)
+    b = build_schedule([200], 1.0, 3, 3, recall_mix=mix)
+    assert a.keys() == b.keys()  # still a pure function of the seed
+    targets = Counter(ar.recall for ar in a.arrivals
+                      if ar.op == "query")
+    assert set(targets) == {None, 0.9}
+    assert min(targets.values()) > 0  # both gears actually drawn
+    for ar in a.arrivals:
+        if ar.op != "query":
+            assert ar.recall is None  # writes carry no dial
+    assert a.describe()["recall_mix"] == [["exact", 0.5], [0.9, 0.5]]
+    # a recall mix is part of schedule identity: with vs without differ
+    c = build_schedule([200], 1.0, 3, 3)
+    assert a.keys() != c.keys()
+
+
+def test_runner_sends_recall_target_and_records_gear_distribution():
+    """The capacity block's per-step gear distribution: the runner
+    forwards each query's recall_target and tallies the response's
+    gear token (exact when a 200 carries none)."""
+    from kdtree_tpu.loadgen.schedule import parse_recall_mix
+
+    class Handler(_StubHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if self.path == "/v1/knn":
+                out = {"ids": [[0]], "distances": [[0.0]],
+                       "degraded": None}
+                rt = payload.get("recall_target")
+                if rt is not None:
+                    out["gear"] = f"approx:{rt:g}"
+                self._answer(200, out)
+            else:
+                self._answer(200, {"applied": 1})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    target = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        sched = build_schedule(
+            [80], 1.0, 5, 3, mix=MixSpec(1, 0, 0),
+            recall_mix=parse_recall_mix("exact:0.5,0.9:0.5"),
+        )
+        rep = lg_runner.run_load(target, sched, scrape=False)
+        step = rep["capacity"]["steps"][0]
+        gears = step["gears"]
+        assert set(gears) == {"exact", "approx:0.9"}
+        assert sum(gears.values()) == step["ok"]
+        # gear-echoed answers are NOT degraded: a kept contract
+        assert step["degraded"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -638,3 +638,58 @@ def test_blue_green_fleet_converges_under_traffic(tree, points, tmp_path):
             f.stop()
         for s in servers:
             s.stop()
+
+
+# ---------------------------------------------------------------------------
+# retention GC + rollback-by-version (PR 14 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_keep_retains_generations_for_rollback(tree, points,
+                                                        tmp_path):
+    """`--snapshot-keep 2`: the newest two generations stay loadable
+    (per-generation manifests, segments refcounted), older ones are
+    GC'd, and a retained generation loads byte-identically — the
+    rollback button."""
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree, epoch=0, keep=2)
+    snap.save_snapshot(d, tree, epoch=1, keep=2)
+    snap.save_snapshot(d, tree, epoch=2, keep=2)
+    assert snap.list_versions(d) == [2, 3]
+    # retained segment files: one set per kept generation, nothing else
+    segs = [f for f in os.listdir(d) if f.startswith("seg-")]
+    assert len(segs) == 2 * 4
+    # rollback: the retained older generation loads and answers
+    old_tree, old_man = snap.load_snapshot(d, version=2)
+    assert old_man["version"] == 2 and old_man["epoch"] == 1
+    q = points[:32]
+    od2, oids = _tiled(tree, q)
+    ld2, lids = _tiled(old_tree, q)
+    assert np.array_equal(od2, ld2) and np.array_equal(oids, lids)
+    # the live manifest is still the newest generation
+    _, live_man = snap.load_snapshot(d)
+    assert live_man["version"] == 3
+    # a GC'd generation is a NAMED error, not a half-read
+    with pytest.raises(snap.SnapshotError):
+        snap.load_snapshot(d, version=1)
+
+
+def test_snapshot_keep_one_is_the_historical_layout(tree, tmp_path):
+    d = str(tmp_path / "snap")
+    snap.save_snapshot(d, tree, epoch=0)
+    snap.save_snapshot(d, tree, epoch=1)
+    assert snap.list_versions(d) == [2]
+    segs = [f for f in os.listdir(d) if f.startswith("seg-")]
+    assert len(segs) == 4  # one generation on disk, as before
+
+
+def test_snapshot_keep_widens_and_narrows(tree, tmp_path):
+    d = str(tmp_path / "snap")
+    for epoch in range(4):
+        snap.save_snapshot(d, tree, epoch=epoch, keep=3)
+    assert snap.list_versions(d) == [2, 3, 4]
+    # narrowing the retention GCs down on the next save
+    snap.save_snapshot(d, tree, epoch=4, keep=1)
+    assert snap.list_versions(d) == [5]
+    segs = [f for f in os.listdir(d) if f.startswith("seg-")]
+    assert len(segs) == 4

@@ -347,3 +347,121 @@ def test_platform_fallback_not_masked_by_capacity_only_run(tmp_path):
     fb = next(f for f in findings if f["rule"] == "platform-fallback")
     # the verdict names the real accelerator round, not the capacity run
     assert fb["from"] == runs[0]["label"]
+
+
+# ---------------------------------------------------------------------------
+# recall-drop (PR 14 satellite: a recall regression fails CI like a
+# throughput drop)
+# ---------------------------------------------------------------------------
+
+
+def _recall_report(recalls, caps=(4, 16, 64)):
+    return {
+        "recall_report_version": 1,
+        "recall": {
+            "recall_version": 1, "n": 50000, "q": 4096, "k": 8,
+            "nbp": 256, "exact_qps": 1500.0, "exact_seconds": 2.7,
+            "curve": [
+                {"visit_cap": c, "recall": r, "qps": 5000.0,
+                 "speedup": 3.0, "seconds": 0.8}
+                for c, r in zip(caps, recalls)
+            ],
+        },
+    }
+
+
+def test_recall_drop_flagged_absolute_band_and_grandfatherable(tmp_path):
+    runs = [
+        tr.load_run(_write(tmp_path, "r1.json",
+                           _recall_report([0.6, 0.95, 1.0]))),
+        tr.load_run(_write(tmp_path, "r2.json",
+                           _recall_report([0.6, 0.80, 1.0]))),
+    ]
+    findings, _ = tr.analyze(runs)
+    assert [f["rule"] for f in findings] == ["recall-drop"]
+    assert findings[0]["metric"] == "recall:cap16"
+    # linter-style grandfathering works for the new rule too
+    base_path = str(tmp_path / "base.json")
+    tr.save_baseline(base_path, findings)
+    assert tr.partition(findings, tr.load_baseline(base_path)) == []
+    # a drop inside the absolute band (and any IMPROVEMENT) is clean
+    runs2 = [
+        tr.load_run(_write(tmp_path, "r3.json",
+                           _recall_report([0.6, 0.95, 1.0]))),
+        tr.load_run(_write(tmp_path, "r4.json",
+                           _recall_report([0.59, 0.99, 1.0]))),
+    ]
+    findings2, _ = tr.analyze(runs2)
+    assert findings2 == []
+
+
+def test_recall_compares_across_interleaved_runs_and_versioning(tmp_path):
+    paths = [
+        _write(tmp_path, "ra.json", _recall_report([0.9, 0.99, 1.0])),
+        _write(tmp_path, "bench.json", _headline(1000)),
+        _write(tmp_path, "rb.json", _recall_report([0.5, 0.99, 1.0])),
+    ]
+    runs = [tr.load_run(p) for p in paths]
+    findings, _ = tr.analyze(runs)
+    assert [f["rule"] for f in findings] == ["recall-drop"]
+    assert findings[0]["from"] == "ra" and findings[0]["to"] == "rb"
+    human = tr.render_human(runs, findings, findings, 0.5)
+    assert "recall curve" in human and "recall-drop" in human
+    rep = json.loads(tr.render_json(runs, findings, findings, 0.5))
+    assert rep["runs"][0]["recall_caps"] == [4, 16, 64]
+    assert rep["runs"][1]["recall_caps"] is None
+    # unknown future recall_version -> not comparable, never a crash
+    fut = _recall_report([0.9, 0.99, 1.0])
+    fut["recall"]["recall_version"] = 99
+    run = tr.load_run(_write(tmp_path, "fut.json", fut))
+    assert run["recall"] is None
+
+
+def test_sidecar_with_recall_block_carries_headline_too(tmp_path):
+    side = {
+        "headline": _headline(500),
+        "counters": {},
+        "platform": "cpu",
+        **_recall_report([0.9, 0.99, 1.0]),
+    }
+    run = tr.load_run(_write(tmp_path, "side.json", side))
+    assert run["metrics"][tr.HEADLINE_KEY]["value"] == 500.0
+    assert run["recall"]["curve"][16] == 0.99
+
+
+def test_capacity_knee_not_compared_across_changed_gear_mix(tmp_path):
+    """A knee measured half-approximate meets the latency SLO at rates
+    an all-exact run cannot — changing the loadgen --recall-target mix
+    between rounds must make the knees incommensurable, not a false
+    capacity-drop. Pre-gear artifacts (no 'gears' key) compare as
+    before."""
+    def with_gears(report, gears):
+        for s in report["capacity"]["steps"]:
+            s["gears"] = gears
+        return report
+
+    runs = [
+        tr.load_run(_write(tmp_path, "ga.json", with_gears(
+            _loadgen_report(120.0), {"approx:0.9": 10, "exact": 10}))),
+        tr.load_run(_write(tmp_path, "gb.json", with_gears(
+            _loadgen_report(60.0), {"exact": 20}))),
+    ]
+    findings, _ = tr.analyze(runs, band=0.3)
+    assert findings == []  # incommensurable, not a drop
+    # same mix: a real drop still flags
+    runs2 = [
+        tr.load_run(_write(tmp_path, "gc.json", with_gears(
+            _loadgen_report(120.0), {"exact": 20}))),
+        tr.load_run(_write(tmp_path, "gd.json", with_gears(
+            _loadgen_report(60.0), {"exact": 20}))),
+    ]
+    findings2, _ = tr.analyze(runs2, band=0.3)
+    assert [f["rule"] for f in findings2] == ["capacity-drop"]
+    # old artifacts without gear info keep the historical comparison
+    runs3 = [
+        tr.load_run(_write(tmp_path, "ge.json", _loadgen_report(120.0))),
+        tr.load_run(_write(tmp_path, "gf.json", with_gears(
+            _loadgen_report(60.0), {"exact": 20}))),
+    ]
+    findings3, _ = tr.analyze(runs3, band=0.3)
+    assert [f["rule"] for f in findings3] == ["capacity-drop"]
