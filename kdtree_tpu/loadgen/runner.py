@@ -155,6 +155,22 @@ class _WorkerConn:
             self.conn = None
 
 
+def _write_base_of(detail: Dict) -> int:
+    """The first id fresh upserts can mint against one shard without
+    colliding with its served rows. A spatially-partitioned shard
+    serves GLOBAL morton-rank ids at ``id_offset`` 0 — its occupied
+    span is the ``spatial.id_range``, not ``[0, n)`` (offset + n would
+    collide with a sibling shard's ids)."""
+    spatial = detail.get("spatial")
+    if isinstance(spatial, dict):
+        id_range = spatial.get("id_range")
+        try:
+            return int(id_range[1])
+        except (TypeError, ValueError, IndexError):
+            pass
+    return int(detail.get("id_offset", 0)) + int(detail.get("n", 0))
+
+
 def discover(
     target: str, timeout_s: float = 5.0, retries: int = 60,
     retry_sleep_s: float = 0.5,
@@ -176,13 +192,11 @@ def discover(
             continue
         if status == 200 and isinstance(body, dict):
             if "dim" in body:
-                off = int(body.get("id_offset", 0))
-                n = int(body.get("n", 0))
                 return {
                     "dim": int(body["dim"]),
-                    "n": n,
+                    "n": int(body.get("n", 0)),
                     "k_max": int(body.get("k_max", 1)),
-                    "write_base": off + n,
+                    "write_base": _write_base_of(body),
                 }
             if "shards" in body:
                 dims, kmaxs, bases, total = [], [], [0], 0
@@ -202,8 +216,7 @@ def discover(
                         dims.append(int(detail["dim"]))
                         kmaxs.append(int(detail.get("k_max", 1)))
                         total += int(detail.get("n", 0))
-                        bases.append(int(detail.get("id_offset", 0))
-                                     + int(detail.get("n", 0)))
+                        bases.append(_write_base_of(detail))
                 if dims:
                     return {
                         "dim": dims[0],
@@ -228,7 +241,7 @@ class _StepAcc:
     the lock guards list/int updates only, never I/O)."""
 
     __slots__ = ("rate", "intended", "sent", "latencies_ms",
-                 "send_lag_ms", "counts", "gears")
+                 "send_lag_ms", "counts", "gears", "fanout")
 
     def __init__(self, rate: float) -> None:
         self.rate = float(rate)
@@ -245,6 +258,11 @@ class _StepAcc:
         # "brute-deadline" — the response's gear token, so a capacity
         # step says WHICH gear its goodput was measured at
         self.gears: Dict[str, int] = {}
+        # per-answered-query fan-out samples (contacted / total from a
+        # router response's shards block; empty against a plain shard
+        # target) — the selective fan-out evidence (docs/SERVING.md
+        # "Spatial sharding & selective fan-out")
+        self.fanout: List[float] = []
 
 
 def _classify(op: str, status: int, body: Optional[dict]) -> List[str]:
@@ -273,6 +291,26 @@ def _gear_of(op: str, status: int, body: Optional[dict]) -> Optional[str]:
         return None
     gear = (body or {}).get("gear")
     return gear if isinstance(gear, str) else "exact"
+
+
+def _fanout_of(op: str, status: int,
+               body: Optional[dict]) -> Optional[float]:
+    """Contacted-shard fraction of one answered QUERY exchange — the
+    router's ``shards`` block (contacted / total). None for plain
+    shard targets (no block), writes, and failures. Pre-selective
+    routers carry no ``contacted`` key; their ``answered`` stands in
+    (contacted == answered under full scatter)."""
+    if op != "query" or status != 200:
+        return None
+    shards = (body or {}).get("shards")
+    if not isinstance(shards, dict):
+        return None
+    total = shards.get("total")
+    contacted = shards.get("contacted", shards.get("answered"))
+    if not isinstance(total, int) or not isinstance(contacted, int) \
+            or total < 1:
+        return None
+    return contacted / total
 
 
 def _quantiles_ms(vals: List[float]) -> Dict[str, Optional[float]]:
@@ -446,7 +484,8 @@ def run_load(
 
     def record(arrival, intended: float, tags: List[str],
                done: float, actual_send: float,
-               gear: Optional[str] = None) -> None:
+               gear: Optional[str] = None,
+               fanout: Optional[float] = None) -> None:
         acc = accs[arrival.step]
         with lock:
             acc.sent += 1
@@ -457,6 +496,8 @@ def run_load(
                 acc.counts[tag] += 1
             if gear is not None:
                 acc.gears[gear] = acc.gears.get(gear, 0) + 1
+            if fanout is not None:
+                acc.fanout.append(fanout)
 
     def do_request(conn: _WorkerConn, arrival, intended: float,
                    seq: int) -> None:
@@ -478,11 +519,12 @@ def run_load(
                 "points": [arrival.point.tolist()]}
         else:
             path, body = "/v1/delete", {"ids": [int(arrival.gid)]}
-        gear = None
+        gear = fanout = None
         try:
             status, resp = conn.request(path, body, headers)
             tags = _classify(arrival.op, status, resp)
             gear = _gear_of(arrival.op, status, resp)
+            fanout = _fanout_of(arrival.op, status, resp)
         except TimeoutError:
             # socket.timeout IS TimeoutError: the request outlived its
             # client budget — the open-loop analog of a deadline miss
@@ -490,7 +532,7 @@ def run_load(
         except (http.client.HTTPException, OSError):
             tags = ["errors"]
         record(arrival, intended, tags, time.monotonic(), actual_send,
-               gear)
+               gear, fanout)
 
     def worker() -> None:
         conn = _WorkerConn(target, timeout_s)
@@ -562,11 +604,18 @@ def run_load(
             # served at — a capacity point is only comparable to
             # another measured at the same gears
             "gears": dict(sorted(acc.gears.items())),
+            # mean contacted-shard fraction of the step's answered
+            # routed queries (None against a plain shard target): the
+            # selective fan-out evidence the trend gate's
+            # fanout-growth rule watches
+            "fanout_frac": (round(float(np.mean(acc.fanout)), 4)
+                            if acc.fanout else None),
         }
         steps.append(row)
     knee = compute_knee(steps, slo_ms=slo_ms, slo_quantile=slo_quantile,
                         max_bad_frac=max_bad_frac)
     server_block = scrape_server_block(target) if scrape else None
+    all_fanout = [f for acc in accs for f in acc.fanout]
     capacity = {
         "capacity_version": CAPACITY_VERSION,
         "offered_unit": "req/s",
@@ -574,6 +623,11 @@ def run_load(
         "slo_quantile": float(slo_quantile),
         "max_bad_frac": float(max_bad_frac),
         "knee_rate": knee,
+        # run-level mean fan-out fraction (additive key, same
+        # versioning posture as the per-step gears): a regression back
+        # toward full scatter fails trend like a throughput cliff
+        "fanout_frac": (round(float(np.mean(all_fanout)), 4)
+                        if all_fanout else None),
         "steps": steps,
         "server": server_block,
     }

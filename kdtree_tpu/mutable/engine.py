@@ -114,6 +114,15 @@ class _EpochState:
         self.delta = DeltaBuffer(inner.tree.dim, min_capacity=min_cap)
         self.dead: set = set()  # masked main ids: deleted or superseded
         self.dead_sorted = np.empty(0, dtype=np.int64)
+        # the epoch's live bounding box, seeded from the tree's root
+        # AABB (ServeEngine fetched it at construction) and EXPANDED by
+        # every upsert so the published box is never stale-exclusive of
+        # a delta point. Deletes never shrink it — a conservative box
+        # only costs the router pruning opportunity, a tight-but-wrong
+        # one costs answers. The next epoch's recompute (its own tree's
+        # root box) is where deletions tighten it.
+        self.box_lo = np.array(inner.box_lo, dtype=np.float32)  # kdt-lint: disable=KDT201 inner.box_lo/hi are HOST arrays (fetched once at ServeEngine construction); this is a defensive host copy
+        self.box_hi = np.array(inner.box_hi, dtype=np.float32)  # kdt-lint: disable=KDT201 inner.box_lo/hi are HOST arrays (fetched once at ServeEngine construction); this is a defensive host copy
         # masked flat storage starts as the tree's own flat views; each
         # mask batch produces new device arrays via .at[].set (async
         # dispatch, no host sync)
@@ -330,6 +339,15 @@ class MutableEngine:
     @property
     def epoch(self) -> int:
         return self._state.epoch
+
+    def bounds(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The live bounding box /healthz publishes: the current
+        epoch's root AABB expanded by every delta upsert — recomputed
+        (and thereby tightened past deletions) at each epoch swap,
+        never stale-exclusive in between."""
+        with self._lock:
+            st = self._state
+            return st.box_lo.copy(), st.box_hi.copy()
 
     def _snapshot(self) -> _Snapshot:
         with self._lock:
@@ -561,6 +579,13 @@ class MutableEngine:
 
     def _apply_upsert(self, st: _EpochState, ids: np.ndarray,
                       points: np.ndarray) -> Dict:
+        # expand the epoch's box FIRST (cheap host math under the lock):
+        # a /healthz probe racing this write may publish the grown box
+        # before the delta row serves, never the reverse — the box
+        # contract is "never stale-exclusive" (docs/SERVING.md "Spatial
+        # sharding & selective fan-out")
+        st.box_lo = np.minimum(st.box_lo, points.min(axis=0))
+        st.box_hi = np.maximum(st.box_hi, points.max(axis=0))
         pos = st.lookup(ids)
         fresh = 0
         masks: List[int] = []

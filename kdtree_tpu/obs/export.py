@@ -243,6 +243,14 @@ METRIC_HELP = {
     "kdtree_router_replica_requests_total":
         "attempts dispatched per replica (shard x replica) — the "
         "read-spread evidence for replica sets",
+    # selective fan-out (docs/SERVING.md "Spatial sharding & selective
+    # fan-out")
+    "kdtree_router_shards_contacted":
+        "shard sets contacted per routed knn request (mean = selective "
+        "fan-out; equals the shard count under full scatter)",
+    "kdtree_router_shards_pruned_total":
+        "shard sets skipped because their bounding-box lower bound "
+        "provably cleared the running k-th best distance",
     # snapshots & replica fleets (docs/SERVING.md)
     "kdtree_snapshot_saves_total": "serving snapshots written",
     "kdtree_snapshot_loads_total": "serving snapshots loaded",
@@ -312,8 +320,18 @@ METRIC_HELP = {
         "degradation-ladder gear shifts, by destination gear",
     "kdtree_recall_sweeps_total":
         "recall-harness sweeps run (kdtree-tpu recall)",
+    "kdtree_recall_sampled":
+        "online-sampled MEASURED served recall (EWMA over shadow "
+        "re-answered approx batches; serve --recall-sample) — the "
+        "sampled-recall SLO's gauge",
+    "kdtree_recall_samples_total":
+        "approx batches shadow-answered exactly by the online recall "
+        "sampler",
     "kdtree_snapshot_gc_generations_total":
         "retained snapshot generations removed by --snapshot-keep GC",
+    "kdtree_snapshot_plan_seeded_total":
+        "plan profiles seeded into the local store from a snapshot "
+        "manifest's pre-shipped plan_profiles payload",
     # SLOs + metric history (docs/OBSERVABILITY.md "SLOs & burn rates")
     "kdtree_slo_state":
         "SLO state by spec: 0 OK, 1 WARN, 2 PAGE (multi-window burn rate)",
@@ -458,6 +476,10 @@ def _capacity_lines(cap: Dict) -> list:
                 f"{(s.get('shed_frac') or 0):>6.1%}  "
                 f"{(s.get('bad_frac') or 0):>6.1%}"
             )
+    fanout = cap.get("fanout_frac")
+    if fanout is not None:
+        out.append(f"fan-out fraction:    {fanout:.1%} of shards "
+                   "contacted per routed query (selective fan-out)")
     server = cap.get("server")
     if server:
         for op, stats in (server.get("write_latency_ms") or {}).items():

@@ -245,6 +245,25 @@ def recall_specs() -> List[SloSpec]:
             fast=BurnWindow(long_s=60.0, short_s=10.0, max_burn=4.0),
             slow=BurnWindow(long_s=600.0, short_s=60.0, max_burn=1.5),
         ),
+        SloSpec(
+            name="sampled-recall",
+            objective="shadow-sampled MEASURED served recall (every "
+                      "Nth approx batch re-answered exactly) stays "
+                      ">= 0.9",
+            target=0.90,
+            kind="gauge_min",
+            # the online recall sampler's gauge (serve --recall-sample,
+            # docs/SERVING.md "Degradation ladder"): unlike
+            # served-recall above this watches a measurement, not a
+            # calibration promise — a calibration that lies shows up
+            # HERE first. Registered lazily: no samples = no data = OK
+            # (idle is not violating), exactly like the rebuild-impact
+            # gauge.
+            gauge="kdtree_recall_sampled",
+            threshold=0.895,
+            fast=BurnWindow(long_s=60.0, short_s=10.0, max_burn=4.0),
+            slow=BurnWindow(long_s=600.0, short_s=60.0, max_burn=1.5),
+        ),
     ]
 
 

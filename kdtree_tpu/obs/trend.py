@@ -29,6 +29,16 @@ series of bench artifacts and flags exactly that class of silent decay:
   the throughput noise band does not apply — so the band here is a
   small absolute tolerance for shape drift, and a genuine quality
   regression of the dial fails CI exactly like a throughput cliff.
+- **fanout-growth**: the router's mean contacted-shard fraction (the
+  loadgen capacity block's ``fanout_frac`` — docs/SERVING.md "Spatial
+  sharding & selective fan-out") GROWING more than
+  ``FANOUT_GROWTH_BAND`` absolute vs the previous fanout-bearing run:
+  a regression back toward full scatter — a broken box contract, a
+  partitioner that stopped separating regions, or a widening rule
+  gone timid — costs the fleet its sub-linear scaling exactly like a
+  throughput cliff, and fails CI the same way. Fractions are in
+  [0, 1] and deterministic for a seeded schedule against a fixed
+  fleet shape, so the band is absolute, like recall's.
 
 The noise band is fitted from ``--pair`` runs when any input carries a
 ``pair_first`` block (two same-process passes bound the run-to-run
@@ -67,6 +77,9 @@ KNOWN_RECALL_VERSIONS = (1,)
 # recall@cap is deterministic for a seeded shape; this absolute
 # tolerance absorbs intentional small shape drift, not noise
 RECALL_DROP_BAND = 0.02
+# fan-out fraction is deterministic for a seeded schedule against a
+# fixed fleet shape; absolute tolerance for minor query-mix drift
+FANOUT_GROWTH_BAND = 0.15
 
 
 # --------------------------------------------------------------------------
@@ -194,8 +207,17 @@ def _capacity_facts(cap) -> Optional[dict]:
         if isinstance(s.get("gears"), dict):
             gears_known = True
             gears.update(s["gears"])
+    fanout = cap.get("fanout_frac")
+    try:
+        fanout = None if fanout is None else float(fanout)
+    except (TypeError, ValueError):
+        fanout = None
     return {"knee_rate": knee, "steps": steps,
             "slo_ms": cap.get("slo_ms"),
+            # mean contacted-shard fraction of the run's routed
+            # queries (None for pre-fanout artifacts and plain shard
+            # targets): the fanout-growth rule's input
+            "fanout_frac": fanout,
             # the gear classes the run's answered queries came back at
             # (None for pre-gear artifacts): the knee comparison must
             # not cross a changed mix — a knee measured half-approx is
@@ -390,6 +412,28 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     "load than it used to",
                 ))
         prev_cap = (cur, cap)
+    # fan-out compares against the previous FANOUT-bearing run — its
+    # own cursor, like recall's: a plain-shard loadgen artifact (which
+    # carries a capacity block but no fan-out) interposed between two
+    # router runs must neither be compared nor reset the baseline
+    prev_fan = None
+    for cur in runs:
+        cfan = (cur.get("capacity") or {}).get("fanout_frac")
+        if cfan is None:
+            continue
+        if prev_fan is not None:
+            pfan = prev_fan[1]
+            if cfan - pfan > FANOUT_GROWTH_BAND:
+                findings.append(_finding(
+                    "fanout-growth", "capacity:fanout", prev_fan[0],
+                    cur,
+                    f"mean contacted-shard fraction grew {pfan:.3f} -> "
+                    f"{cfan:.3f} (band {FANOUT_GROWTH_BAND:g} "
+                    "absolute): the router is regressing toward full "
+                    "scatter — selective fan-out's sub-linear scaling "
+                    "is eroding",
+                ))
+        prev_fan = (cur, cfan)
     # recall curves compare against the PREVIOUS recall-bearing run
     # (same interleaving tolerance as capacity), at matching visit
     # caps, with the ABSOLUTE band — recall on a seeded shape is

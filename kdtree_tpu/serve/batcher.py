@@ -84,6 +84,7 @@ class MicroBatcher:
         min_bucket: int = MIN_BUCKET,
         ladder=None,
         faults=None,
+        recall_sample: float = 0.0,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -140,6 +141,20 @@ class MicroBatcher:
             for gear in ("exact", "approx", "brute-deadline")
         }
         self._errors = reg.counter("kdtree_serve_batch_errors_total")
+        # the online recall sampler (docs/SERVING.md "Degradation
+        # ladder"): every Nth APPROXIMATE batch is shadow-answered
+        # exactly and the measured recall@k published as
+        # kdtree_recall_sampled — the served-recall SLO's sampled twin
+        # watches a MEASUREMENT, not a gear's calibration promise.
+        # Deterministic every-Nth (not random — KDT104, and a seeded
+        # drill must sample reproducibly); 0 disables, the default for
+        # in-process embedders (the serve CLI arms it).
+        self.recall_sample = max(float(recall_sample), 0.0)
+        self._sample_every = (int(round(1.0 / self.recall_sample))
+                              if self.recall_sample > 0 else 0)
+        self._sample_tick = 0
+        self._sampled_ewma: Optional[float] = None
+        self._samples = reg.counter("kdtree_recall_samples_total")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -324,6 +339,44 @@ class MicroBatcher:
                 device_ms=round((done - r.dispatched_at) * 1e3, 3),
                 total_ms=round((done - r.enqueued_at) * 1e3, 3),
             )
+        if visit_cap is not None and self._sample_every:
+            # shadow-sample AFTER the answers left: the exact re-answer
+            # delays the next batch pickup by one dispatch, never the
+            # requests it measures (the cost is bounded by the sample
+            # fraction — docs/SERVING.md "Degradation ladder")
+            self._sample_tick += 1
+            if self._sample_tick >= self._sample_every:
+                self._sample_tick = 0
+                self._shadow_sample(q, rows, ids, estimate)
+
+    def _shadow_sample(self, q: np.ndarray, rows: int,
+                       approx_ids: np.ndarray, estimate: float) -> None:
+        """One online recall sample: re-answer the (already padded)
+        batch EXACTLY and publish the measured recall@k of the approx
+        answer that actually served. Never raises — sampling observes
+        serving, it must not fail a batch that already answered. The
+        gauge is an EWMA (alpha 0.3) so one tiny batch's quantized
+        recall (a 1-row batch measures 0 or 1) does not whipsaw the
+        SLO; it is registered LAZILY so it reads absent — not a
+        spurious 0 — until something was actually measured."""
+        try:
+            from kdtree_tpu.approx.recall import recall_at_k
+
+            _, exact_ids, _ = self.engine.knn_batch(q)
+            measured = recall_at_k(approx_ids[:rows], exact_ids[:rows])
+        except Exception as e:
+            flight.record("recall.sample_error", error=repr(e)[:200])
+            return
+        prev = self._sampled_ewma
+        self._sampled_ewma = (measured if prev is None
+                              else 0.7 * prev + 0.3 * measured)
+        obs.get_registry().gauge("kdtree_recall_sampled").set(
+            round(self._sampled_ewma, 6))
+        self._samples.inc()
+        flight.record("recall.sample", rows=rows,
+                      measured=round(measured, 6),
+                      estimate=round(float(estimate), 6),
+                      ewma=round(self._sampled_ewma, 6))
 
     def _run_fallback(self, req: PendingRequest, reason: str) -> None:
         """Answer one straggler (or, at the ladder's floor gear, every

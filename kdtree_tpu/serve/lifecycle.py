@@ -47,6 +47,13 @@ class ServeEngine:
         # to id -1 through the gid table
         self._flat_pts = tree.bucket_pts.reshape(-1, tree.dim)
         self._flat_gid = tree.bucket_gid.reshape(-1)
+        # the index's bounding box = the tree's own root AABB (node 0),
+        # already computed by the build's masked reductions. Fetched ONCE
+        # at construction (bootstrap / rebuild thread, pre-serving) — the
+        # shard's published /healthz box, which the router prunes against
+        # (docs/SERVING.md "Spatial sharding & selective fan-out")
+        self.box_lo = np.asarray(tree.node_lo[0], dtype=np.float32)  # kdt-lint: disable=KDT201 once-per-engine [D]-sized root-box fetch at construction, off the serving hot path
+        self.box_hi = np.asarray(tree.node_hi[0], dtype=np.float32)  # kdt-lint: disable=KDT201 once-per-engine [D]-sized root-box fetch at construction, off the serving hot path
         # facts about the LAST knn_batch dispatch (batch worker is the
         # only steady-state caller — same single-reader contract as the
         # mutable engine's last_answer_epoch): which visit cap answered
@@ -55,6 +62,11 @@ class ServeEngine:
         # otherwise, 1.0 for exact)
         self.last_visit_cap: Optional[int] = None
         self.last_recall_estimate: float = 1.0
+
+    def bounds(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The index's AABB as host f32[D] arrays — what /healthz
+        publishes as the shard's box."""
+        return self.box_lo, self.box_hi
 
     def knn_batch(
         self, queries: np.ndarray,

@@ -71,6 +71,23 @@ Two fleet-facing extras ride on the same shard table:
   format requires families contiguous). Unreachable shards are
   reported as ``kdtree_router_federated_up{shard=...} 0`` instead of
   failing the scrape.
+
+**Selective fan-out** (docs/SERVING.md "Spatial sharding & selective
+fan-out"): when shards publish bounding boxes on ``/healthz`` (every
+serve process does; a spatial partition — ``kdtree-tpu partition`` —
+makes them disjoint and tight), the router applies PAPER.md's own
+pruning argument one level up: rank shard sets by point-to-box lower
+bound, contact the nearest few, and widen only while some query's
+running k-th best distance does not strictly beat the next shard's
+box bound (:mod:`kdtree_tpu.serve.spatial`). Two waves always
+suffice, answers are byte-identical to the full fan-out oracle, and
+a ``recall_target`` instead stops widening once the guaranteed-query
+fraction reaches the target (the PR 14 gear contract, spatially).
+Shards without a box — a legacy fleet, or one not yet probed — are
+ALWAYS contacted: no box, no pruning argument. Writes route
+spatially too when every shard publishes its Morton code range:
+upserts go to the region owner (plus stale-copy deletes of moved
+ids elsewhere), deletes broadcast-resolve by id.
 """
 
 from __future__ import annotations
@@ -83,9 +100,12 @@ import time
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
+import numpy as np
+
 from kdtree_tpu import obs
 from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import flight
+from kdtree_tpu.serve import spatial
 from kdtree_tpu.serve.server import (
     GracefulHTTPServer,
     JsonRequestHandler,
@@ -107,6 +127,10 @@ _ROUTER_LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0,
 )
+# shard sets contacted per routed request (the fan-out histogram the
+# selectivity acceptance reads: mean = _sum / _count)
+_FANOUT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+FANOUT_MODES = ("selective", "full")
 
 # breaker states, exported as the kdtree_router_breaker_state gauge
 CLOSED, OPEN, HALF_OPEN = 0, 1, 2
@@ -259,6 +283,15 @@ class ShardState:
         # body and kept across later probe failures — ownership is
         # topology, not liveness
         self.id_offset: Optional[int] = None
+        # spatial topology, learned from the same /healthz body and
+        # kept across failures exactly like id_offset: the replica's
+        # published bounding box (the selective fan-out's pruning
+        # input) and — for spatially-partitioned fleets — the shared
+        # quantization grid plus this shard's owned Morton code range
+        # (the spatial write-ownership source)
+        self.box: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.grid = None  # spatial.SpatialGrid
+        self.code_range: Optional[Tuple[int, int]] = None
 
     # -- latency / hedging ---------------------------------------------------
 
@@ -315,6 +348,15 @@ class ReplicaSet:
         self.replicas = replicas
         self._rr = 0
         self._lock = lockwatch.make_lock("route.replica")
+        # router-side box expansion (docs/SERVING.md "Spatial sharding
+        # & selective fan-out"): a routed upsert expands the cached box
+        # IMMEDIATELY, covering the window until the next health probe
+        # re-reads the shard's own (also already expanded) box — the
+        # cached box is never stale-exclusive of a write this router
+        # routed. Cleared once a probed box has caught up (contains it),
+        # so a long-gone expansion cannot pin the box stale-large past
+        # the epoch swap that tightened it.
+        self._box_ext: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     @property
     def primary(self) -> ShardState:
@@ -352,6 +394,56 @@ class ReplicaSet:
         return any(r.healthy and r.breaker.state != OPEN
                    for r in self.replicas)
 
+    # -- spatial topology ----------------------------------------------------
+
+    def box(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """The set's effective bounding box: the UNION over its
+        replicas' learned boxes (replicas can lag each other by an
+        epoch; a union is conservative for all of them) plus any
+        router-side write expansion still ahead of the probes. None
+        until some replica published one — a box-less set is never
+        pruned."""
+        probed = spatial.box_union([r.box for r in self.replicas])
+        # read-check-clear UNDER the set lock: a concurrent
+        # expand_box merging a routed write into _box_ext between an
+        # unlocked read and the clear would be LOST — exactly the
+        # stale-exclusive window the expansion exists to close
+        with self._lock:
+            ext = self._box_ext
+            if ext is None:
+                return probed
+            if probed is not None and bool(
+                np.all(probed[0] <= ext[0])
+                and np.all(probed[1] >= ext[1])
+            ):
+                # the probed box caught up with every routed write —
+                # the expansion has served its purpose
+                self._box_ext = None
+                return probed
+        return spatial.box_union([probed, ext])
+
+    def expand_box(self, lo: np.ndarray, hi: np.ndarray) -> None:
+        with self._lock:
+            ext = self._box_ext
+            if ext is None:
+                self._box_ext = (np.array(lo, dtype=np.float32),  # kdt-lint: disable=KDT201 router process holds no jax: lo/hi are host numpy from the write path
+                                 np.array(hi, dtype=np.float32))  # kdt-lint: disable=KDT201 router process holds no jax: lo/hi are host numpy from the write path
+            else:
+                self._box_ext = (np.minimum(ext[0], lo),
+                                 np.maximum(ext[1], hi))
+
+    def spatial_grid(self):
+        for r in self.replicas:
+            if r.grid is not None:
+                return r.grid
+        return None
+
+    def code_range_known(self) -> Optional[Tuple[int, int]]:
+        for r in self.replicas:
+            if r.code_range is not None:
+                return r.code_range
+        return None
+
 
 class RouterConfig:
     """The routing knobs (CLI flags map 1:1; docs/SERVING.md)."""
@@ -367,7 +459,18 @@ class RouterConfig:
         breaker_failures: int = DEFAULT_BREAKER_FAILURES,
         breaker_reset_s: float = DEFAULT_BREAKER_RESET_S,
         health_period_s: float = DEFAULT_HEALTH_PERIOD_S,
+        fanout: str = "selective",
     ) -> None:
+        if fanout not in FANOUT_MODES:
+            raise ValueError(
+                f"fanout must be one of {FANOUT_MODES}, got {fanout!r}"
+            )
+        # "selective" is the default because it is NOT a trade: with no
+        # boxes learned it degrades to full fan-out, and with boxes it
+        # is byte-identical by the lb argument. "full" exists for the
+        # A/B (bench both, commit the pair) and as the operator's
+        # big-red-switch if a fleet's boxes are ever suspect.
+        self.fanout = fanout
         self.deadline_s = float(deadline_s)
         self.retries = max(int(retries), 0)
         self.backoff_base_s = float(backoff_base_s)
@@ -558,7 +661,7 @@ class RouterHandler(JsonRequestHandler):
         if not parse_recall_target(payload.get("recall_target"))[0]:
             self._send_json(400, {"error": RECALL_TARGET_ERROR})
             return
-        code, out, headers = self.server.route_knn(body, k, trace)
+        code, out, headers = self.server.route_knn(body, payload, k, trace)
         self._send_json(code, out, extra_headers=headers)
 
 
@@ -631,6 +734,13 @@ class Router(GracefulHTTPServer):
             buckets=_ROUTER_LATENCY_BUCKETS,
         )
         self._partial = reg.counter("kdtree_router_partial_total")
+        # selective fan-out evidence (docs/SERVING.md "Spatial sharding
+        # & selective fan-out"): per-request contacted-set size and the
+        # running pruned-shard count — mean fan-out = _sum / _count
+        self._contacted = reg.histogram(
+            "kdtree_router_shards_contacted", buckets=_FANOUT_BUCKETS,
+        )
+        self._pruned = reg.counter("kdtree_router_shards_pruned_total")
         self.slo_engine = slo_engine
         self._serve_thread: Optional[threading.Thread] = None
         self._health_thread: Optional[threading.Thread] = None
@@ -1023,31 +1133,183 @@ class Router(GracefulHTTPServer):
 
     # -- the scatter/gather core --------------------------------------------
 
-    def route_knn(
-        self, body: bytes, k: Optional[int], trace: str,
-    ) -> Tuple[int, dict, Optional[dict]]:
-        """Fan one validated request out to every shard, gather inside
-        the deadline, merge. Returns (status, response body, headers)."""
-        t0 = time.monotonic()
-        deadline = t0 + self.config.deadline_s
-        n = len(self.shard_sets)
-        results: List[Optional[object]] = [None] * n
+    def _scatter_start(
+        self, indices: List[int], body: bytes, deadline: float,
+        trace: str, results: List[Optional[object]],
+    ) -> List[threading.Thread]:
+        """Launch one concurrent scatter wave over the named shard
+        sets; results land in ``results`` by set index (waves touch
+        disjoint index sets, so there is no write overlap). The caller
+        joins via :meth:`_scatter_join` — possibly earlier than the
+        request deadline, so a hung wave-1 shard cannot starve the
+        widening wave of its budget (stragglers keep running against
+        the full deadline and are harvested by the final join)."""
         threads = []
-        for sset in self.shard_sets:
-            def task(s=sset):
-                results[s.index] = self._shard_task(s, body, deadline, trace)
+        for i in indices:
+            def task(s=self.shard_sets[i]):
+                results[s.index] = self._shard_task(s, body, deadline,
+                                                    trace)
 
             t = threading.Thread(target=task, name="kdtree-route-scatter")
             t.start()
             threads.append(t)
+        return threads
+
+    @staticmethod
+    def _scatter_join(threads: List[threading.Thread],
+                      by: float) -> None:
         for t in threads:
-            t.join(timeout=max(deadline - time.monotonic(), 0.0) + 0.25)
+            t.join(timeout=max(by - time.monotonic(), 0.0))
+
+    @staticmethod
+    def _spatial_inputs(payload):
+        """(queries f32[Q, D] | None, recall_target | None) for the
+        fan-out selection. The handler already validated the payload
+        shape for the wire contract; anything that fails to parse here
+        simply disables pruning for this request (full fan-out — the
+        shards then issue the authoritative 400)."""
+        from kdtree_tpu.approx.search import parse_recall_target
+
+        queries = None
+        try:
+            q = np.asarray(payload.get("queries"), dtype=np.float32)  # kdt-lint: disable=KDT201 router process holds no jax: queries are parsed JSON
+            if q.ndim == 2 and q.shape[0] >= 1 and \
+                    bool(np.isfinite(q).all()):
+                queries = q
+        except (TypeError, ValueError):
+            pass
+        ok, target = parse_recall_target(payload.get("recall_target"))
+        return queries, (target if ok else None)
+
+    @staticmethod
+    def _lb_dists(queries: np.ndarray, box) -> np.ndarray:
+        """Per-query lower-bound DISTANCES (float64 sqrt of the f32
+        box d2 — the same value space as the shards' response
+        distances, so the strict-tie pruning rule compares like with
+        like)."""
+        return np.sqrt(
+            spatial.box_lower_bounds(queries, box[0], box[1])
+            .astype(np.float64)
+        )
+
+    @staticmethod
+    def _running_worst(
+        payloads: List[dict], nq: int, k: Optional[int],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-query running k-th best DISTANCE over the answered
+        payloads (+inf where fewer than k real candidates merged), and
+        the fewer-than-k mask — the widening decision's inputs."""
+        if not payloads:
+            return (np.full(nq, np.inf), np.ones(nq, dtype=bool))
+        kk = min(p["k"] for p in payloads) if k is None else int(k)
+        dists = []
+        idss = []
+        for p in payloads:
+            d = np.asarray(p["distances"], dtype=np.float64)[:, :kk]
+            i = np.asarray(p["ids"], dtype=np.int64)[:, :kk]
+            dists.append(d)
+            idss.append(i)
+        d = np.concatenate(dists, axis=1)
+        ids = np.concatenate(idss, axis=1)
+        d = np.where(ids >= 0, d, np.inf)
+        d.sort(axis=1)
+        worst = (d[:, kk - 1] if d.shape[1] >= kk
+                 else np.full(nq, np.inf))
+        return worst, ~np.isfinite(worst)
+
+    @staticmethod
+    def _spatial_gear(gear: Optional[str],
+                      target: Optional[float]) -> Optional[str]:
+        """Fold a spatial truncation into the merged gear token: the
+        widening stopped at the recall target, so the batch recall is
+        bounded below by it — the answer's gear is the MIN of that and
+        whatever the contacted shards already reported."""
+        if target is None:
+            return gear
+        if isinstance(gear, str) and gear.startswith("approx:"):
+            try:
+                return f"approx:{min(float(gear.split(':', 1)[1]), target):g}"
+            except ValueError:
+                pass
+        return f"approx:{target:g}"
+
+    def route_knn(
+        self, body: bytes, payload: dict, k: Optional[int], trace: str,
+    ) -> Tuple[int, dict, Optional[dict]]:
+        """Fan one validated request out — to every shard, or (with
+        learned boxes) to the lb-ranked nearest few, widening only
+        until exactness (or the recall target) is proven — gather
+        inside the deadline, merge. Returns (status, response body,
+        headers)."""
+        t0 = time.monotonic()
+        deadline = t0 + self.config.deadline_s
+        n = len(self.shard_sets)
+        results: List[Optional[object]] = [None] * n
+        queries, recall_target = self._spatial_inputs(payload)
+        boxes = [s.box() for s in self.shard_sets]
+        selective = (
+            self.config.fanout == "selective" and n > 1
+            and queries is not None
+            and any(b is not None and b[0].size == queries.shape[1]
+                    for b in boxes)
+        )
+        spatial_cut = 0
+        if selective:
+            # per-set lower-bound distances; None = legacy/unprobed set
+            # (no box, no pruning argument — ALWAYS contacted)
+            lbs = [
+                self._lb_dists(queries, b)
+                if b is not None and b[0].size == queries.shape[1]
+                else None
+                for b in boxes
+            ]
+            wave1 = spatial.initial_wave(lbs)
+            contacted = sorted(wave1)
+            threads = self._scatter_start(wave1, body, deadline, trace,
+                                          results)
+            remaining = [i for i in range(n) if i not in set(wave1)]
+            if remaining:
+                # wave 1 gets at most HALF the remaining budget while
+                # a widening wave may still need the rest: one hung
+                # wave-1 shard must not convert a request full fan-out
+                # would answer as a partial 200 into a 503. A shard
+                # still unanswered at the cut reads as worst=inf for
+                # its queries — the widening only gets MORE
+                # conservative, and its late answer still merges (the
+                # final join below harvests stragglers).
+                now = time.monotonic()
+                self._scatter_join(threads,
+                                   min(deadline, now + (deadline - now) / 2))
+                payloads1 = [results[i] for i in contacted
+                             if isinstance(results[i], dict)]
+                worst, short = self._running_worst(
+                    payloads1, queries.shape[0], k)
+                wave2, spatial_cut = spatial.widen_wave(
+                    lbs, remaining, worst, short, recall_target)
+                if wave2:
+                    threads += self._scatter_start(wave2, body, deadline,
+                                                   trace, results)
+                    contacted = sorted(set(contacted) | set(wave2))
+        else:
+            contacted = list(range(n))
+            threads = self._scatter_start(contacted, body, deadline,
+                                          trace, results)
+        self._scatter_join(threads, deadline + 0.25)
+        m = len(contacted)
+        pruned = n - m
+        self._contacted.observe(m)
+        if pruned:
+            self._pruned.inc(pruned)
+            flight.record("route.fanout", trace=trace, contacted=m,
+                          total=n, pruned=pruned,
+                          spatial_cut=spatial_cut)
         # ONE snapshot: a laggard task finishing between two reads of
         # `results` must not let the merge and the missing-list disagree
         snapshot = list(results)
-        payloads = [r for r in snapshot if isinstance(r, dict)]
-        errors = {i: r for i, r in enumerate(snapshot)
-                  if isinstance(r, ShardError)}
+        payloads = [snapshot[i] for i in contacted
+                    if isinstance(snapshot[i], dict)]
+        errors = {i: snapshot[i] for i in contacted
+                  if isinstance(snapshot[i], ShardError)}
         # a 4xx from a shard means the REQUEST is bad — propagate it
         # verbatim rather than merging around it or retrying it
         for err in errors.values():
@@ -1058,59 +1320,72 @@ class Router(GracefulHTTPServer):
                 return err.status or 400, out, None
         elapsed = time.monotonic() - t0
         self._req_lat.observe(elapsed)
-        missing = sorted(set(range(n)) - {i for i, r in enumerate(snapshot)
-                                          if isinstance(r, dict)})
-        if len(payloads) == n:
+        missing = sorted(set(contacted)
+                         - {i for i in contacted
+                            if isinstance(snapshot[i], dict)})
+        answered = len(payloads)
+        # an uncontacted (pruned) shard is NOT missing: the lb argument
+        # proved it cannot contribute, so completeness — and the quorum
+        # bar — is judged against the contacted set
+        required = min(self.quorum, m)
+
+        def shards_block() -> dict:
+            return {"total": n, "contacted": m, "answered": answered,
+                    "missing": missing, "pruned": pruned}
+
+        if answered == m:
             dists, ids, kk = merge_topk(payloads, k)
             degraded = next(
                 (p["degraded"] for p in payloads if p.get("degraded")), None
             )
-            gear = merge_gear(payloads)
+            gear = self._spatial_gear(
+                merge_gear(payloads),
+                recall_target if spatial_cut else None)
             self._count_request("ok")
             out = {
                 "k": kk, "ids": ids, "distances": dists,
                 "degraded": degraded, "trace_id": trace,
-                "shards": {"total": n, "answered": n, "missing": []},
+                "shards": shards_block(),
             }
             if gear is not None:
                 out["gear"] = gear
             return 200, out, None
-        if len(payloads) >= self.quorum:
+        if answered >= required:
             # partial degradation: exact over the answered shards,
             # honestly flagged — never a silent wrong answer
             dists, ids, kk = merge_topk(payloads, k)
-            gear = merge_gear(payloads)
+            gear = self._spatial_gear(
+                merge_gear(payloads),
+                recall_target if spatial_cut else None)
             self._partial.inc()
             self._count_request("partial")
             flight.record(
-                "route.partial", trace=trace, answered=len(payloads),
-                total=n, missing=missing,
+                "route.partial", trace=trace, answered=answered,
+                total=n, contacted=m, missing=missing,
                 outcomes={str(i): e.outcome for i, e in errors.items()},
             )
             flight.auto_dump("route-partial")
             out = {
                 "k": kk, "ids": ids, "distances": dists,
-                "degraded": f"partial:{len(payloads)}/{n}",
+                "degraded": f"partial:{answered}/{m}",
                 "trace_id": trace,
-                "shards": {"total": n, "answered": len(payloads),
-                           "missing": missing},
+                "shards": shards_block(),
             }
             if gear is not None:
                 out["gear"] = gear
             return 200, out, None
         self._count_request("unavailable")
         flight.record(
-            "route.unavailable", trace=trace, answered=len(payloads),
-            total=n, quorum=self.quorum, missing=missing,
+            "route.unavailable", trace=trace, answered=answered,
+            total=n, contacted=m, quorum=self.quorum, missing=missing,
             outcomes={str(i): e.outcome for i, e in errors.items()},
         )
         flight.auto_dump("route-unavailable")
         return 503, {
-            "error": f"only {len(payloads)}/{n} shards answered "
-                     f"(quorum {self.quorum}); failing shards: {missing}",
+            "error": f"only {answered}/{m} contacted shards answered "
+                     f"(quorum {required}); failing shards: {missing}",
             "trace_id": trace,
-            "shards": {"total": n, "answered": len(payloads),
-                       "missing": missing},
+            "shards": shards_block(),
         }, {"Retry-After": str(int(max(self.config.breaker_reset_s, 1.0)))}
 
     # -- write passthrough (mutable index) -----------------------------------
@@ -1173,42 +1448,144 @@ class Router(GracefulHTTPServer):
             count("client_error")
             return 400, {"error": '"points" must be a list matching '
                                   '"ids"', "trace_id": trace}
-        table = self._owner_table()
-        if table is None:
-            count("unavailable")
-            return 503, {"error": "shard id ranges unknown — health "
-                                  "probes have not yet read every "
-                                  "shard's id_offset",
-                         "trace_id": trace}
-        if min(ids) < table[0][0]:
-            count("client_error")
-            return 400, {"error": f"ids below the first shard's "
-                                  f"id_offset {table[0][0]} are owned "
-                                  "by no shard", "trace_id": trace}
-        offsets = [o for o, _ in table]
-        parts: Dict[int, List[int]] = {}
-        import bisect
+        # ownership mode: SPATIAL when every shard set published its
+        # Morton code range (the kdtree-tpu partition contract) —
+        # upserts then go to the shard whose REGION contains the point,
+        # with stale-copy deletes broadcast to the other shards so a
+        # moved id can never serve from two places; deletes
+        # broadcast-resolve by id (unknown ids are idempotent no-ops at
+        # the engines). Id-range fleets keep today's behavior exactly.
+        grid = next((s.spatial_grid() for s in self.shard_sets
+                     if s.spatial_grid() is not None), None)
+        ranges = [s.code_range_known() for s in self.shard_sets]
+        spatial_mode = grid is not None and all(
+            r is not None for r in ranges)
+        # jobs: (shard set, op, sub-payload, counts_toward_applied)
+        jobs: List[Tuple[ReplicaSet, str, dict, bool]] = []
+        if spatial_mode:
+            if op == "upsert":
+                try:
+                    pts = np.asarray(points, dtype=np.float32)
+                except (TypeError, ValueError):
+                    count("client_error")
+                    return 400, {"error": '"points" must be a [m, d] '
+                                          "number array",
+                                 "trace_id": trace}
+                if pts.shape != (len(ids), grid.dim) or \
+                        not bool(np.isfinite(pts).all()):
+                    count("client_error")
+                    return 400, {"error": f'"points" must be finite '
+                                          f"[{len(ids)}, {grid.dim}] "
+                                          "to match ids and the "
+                                          "fleet's partition grid",
+                                 "trace_id": trace}
+                # owner_of's searchsorted needs ASCENDING range lows,
+                # but self.shard_sets is the operator's --shard flag
+                # order — sort, resolve, then map back (the same
+                # invariant the id-range path's sorted owner table
+                # re-establishes). A point no range covers (a fleet
+                # mixing partitions, or a partial topology) must be a
+                # crisp refusal, never a guessed owner: a misrouted
+                # upsert's stale-delete broadcast would DELETE the id
+                # from its real owner while applying it nowhere.
+                order = sorted(range(len(ranges)),
+                               key=lambda i: ranges[i][0])
+                idx = spatial.owner_of(pts, grid,
+                                       [ranges[i] for i in order])
+                lut = np.asarray(order + [-1], dtype=np.int64)
+                owners = lut[idx]  # idx -1 stays -1 via the sentinel
+                if bool((owners < 0).any()):
+                    count("unavailable")
+                    return 503, {
+                        "error": "shard code ranges do not cover some "
+                                 "points (mixed or partial spatial "
+                                 "topology) — refusing to guess a "
+                                 "write owner",
+                        "trace_id": trace,
+                    }
+                parts: Dict[int, List[int]] = {}
+                for pos, owner in enumerate(owners.tolist()):
+                    parts.setdefault(int(owner), []).append(pos)
+                for s_idx, sset in enumerate(self.shard_sets):
+                    rows = parts.get(s_idx)
+                    if rows:
+                        sub = {"ids": [ids[i] for i in rows],
+                               "points": [points[i] for i in rows]}
+                        jobs.append((sset, "upsert", sub, True))
+                        # expand the cached box NOW: a query racing the
+                        # next health probe must not prune the shard
+                        # that just took this point
+                        sub_pts = pts[rows]
+                        sset.expand_box(sub_pts.min(axis=0),
+                                        sub_pts.max(axis=0))
+                    stale = [ids[i] for i in range(len(ids))
+                             if int(owners[i]) != s_idx]
+                    if stale:
+                        jobs.append((sset, "delete", {"ids": stale},
+                                     False))
+            else:
+                jobs = [(sset, "delete", {"ids": list(ids)}, True)
+                        for sset in self.shard_sets]
+        else:
+            table = self._owner_table()
+            if table is None:
+                count("unavailable")
+                return 503, {"error": "shard id ranges unknown — health "
+                                      "probes have not yet read every "
+                                      "shard's id_offset",
+                             "trace_id": trace}
+            if min(ids) < table[0][0]:
+                count("client_error")
+                return 400, {"error": f"ids below the first shard's "
+                                      f"id_offset {table[0][0]} are owned "
+                                      "by no shard", "trace_id": trace}
+            offsets = [o for o, _ in table]
+            parts = {}
+            import bisect
 
-        for pos, gid in enumerate(ids):
-            owner = bisect.bisect_right(offsets, gid) - 1
-            parts.setdefault(owner, []).append(pos)
+            for pos, gid in enumerate(ids):
+                owner = bisect.bisect_right(offsets, gid) - 1
+                parts.setdefault(owner, []).append(pos)
+            for owner, rows in sorted(parts.items()):
+                sub = {"ids": [ids[i] for i in rows]}
+                if points is not None:
+                    sub["points"] = [points[i] for i in rows]
+                    # the box contract is mode-independent: an id-range
+                    # fleet's shards publish boxes too, and a selective
+                    # read racing the next health probe must not prune
+                    # the shard that just took this write (malformed
+                    # points skip the expansion — the shard 400s them)
+                    try:
+                        sub_pts = np.asarray(sub["points"],
+                                             dtype=np.float32)
+                        if sub_pts.ndim == 2 and \
+                                bool(np.isfinite(sub_pts).all()):
+                            table[owner][1].expand_box(
+                                sub_pts.min(axis=0), sub_pts.max(axis=0))
+                    except (TypeError, ValueError):
+                        pass
+                jobs.append((table[owner][1], op, sub, True))
         deadline = time.monotonic() + self.config.deadline_s
         shard_out: Dict[str, dict] = {}
         applied = 0
         failures = client_error = None
-        ordered = sorted(parts.items())
-        for n_done, (owner, rows) in enumerate(ordered):
+        primary_jobs = sum(1 for j in jobs if j[3])
+        for n_done, (sset, job_op, sub, counts) in enumerate(jobs):
             # writes go ONLY to the shard PRIMARY (replica 0): the
             # secondaries are snapshot-following read replicas — they
             # 403 writes, and converge to this write's effect through
             # the primary's next epoch snapshot (blue/green)
-            shard = table[owner][1].primary
+            shard = sset.primary
+            # a stale-copy delete rides under a namespaced key so it
+            # can never collide with the same shard's primary outcome
+            out_key = (str(shard.index) if counts or job_op == op
+                       else f"{shard.index}:{job_op}")
             # the reads' fail-fast policy applies to writes too: an
             # ejected or breaker-open shard answers immediately instead
             # of burning budget the remaining partitions need
             if not shard.healthy:
                 self._count_attempt(shard, "breaker_open")
-                shard_out[str(shard.index)] = {
+                shard_out[out_key] = {
                     "error": f"shard {shard.index}: ejected (unhealthy)",
                     "outcome": "breaker_open",
                 }
@@ -1216,29 +1593,24 @@ class Router(GracefulHTTPServer):
                 continue
             if not shard.breaker.allow():
                 self._count_attempt(shard, "breaker_open")
-                shard_out[str(shard.index)] = {
+                shard_out[out_key] = {
                     "error": f"shard {shard.index}: circuit breaker open",
                     "outcome": "breaker_open",
                 }
                 failures = failures or "breaker_open"
                 continue
-            sub = {"ids": [ids[i] for i in rows]}
-            if points is not None:
-                sub["points"] = [points[i] for i in rows]
             # split the remaining budget evenly over the remaining
-            # partitions: one hung shard must not starve the healthy
+            # jobs: one hung shard must not starve the healthy
             # owners behind it into "deadline exhausted"
-            budget = (deadline - time.monotonic()) / (len(ordered)
-                                                      - n_done)
+            budget = (deadline - time.monotonic()) / (len(jobs) - n_done)
             if budget <= 0:
-                shard_out[str(shard.index)] = {"error": "deadline "
-                                                        "exhausted"}
+                shard_out[out_key] = {"error": "deadline exhausted"}
                 failures = failures or "timeout"
                 continue
             try:
                 res = self._call_shard(
                     shard, json.dumps(sub).encode("utf-8"), budget,
-                    trace, path=f"/v1/{op}",
+                    trace, path=f"/v1/{job_op}",
                 )
             except ShardError as e:
                 # mirror the read path's breaker contract: a 4xx is the
@@ -1249,34 +1621,41 @@ class Router(GracefulHTTPServer):
                 else:
                     shard.breaker.record_success()
                 self._count_attempt(shard, e.outcome)
-                shard_out[str(shard.index)] = {
+                shard_out[out_key] = {
                     "error": str(e), "outcome": e.outcome,
                     "status": e.status,
                 }
                 if e.body is not None:
-                    shard_out[str(shard.index)]["body"] = e.body
+                    shard_out[out_key]["body"] = e.body
                 if not e.retryable:
                     client_error = e
                 failures = failures or e.outcome
                 continue
             shard.breaker.record_success()
             self._count_attempt(shard, "ok")
-            applied += int(res.get("applied", 0))
-            shard_out[str(shard.index)] = {
+            if counts:
+                applied += int(res.get("applied", 0))
+            shard_out[out_key] = {
                 "applied": res.get("applied"),
                 "delta_rows": res.get("delta_rows"),
                 "tombstones": res.get("tombstones"),
                 "epoch": res.get("epoch"),
                 "rebuilding": res.get("rebuilding"),
             }
+            if job_op != op:
+                shard_out[out_key]["op"] = job_op
         out = {"op": op, "requested": len(ids), "applied": applied,
                "shards": shard_out, "trace_id": trace}
+        if spatial_mode:
+            out["routing"] = "spatial"
         flight.record("route.write", op=op, trace=trace, ids=len(ids),
-                      applied=applied, failed=failures is not None)
+                      applied=applied, failed=failures is not None,
+                      routing="spatial" if spatial_mode else "range")
         if failures is None:
             count("ok")
             return 200, out
-        if client_error is not None and len(parts) == 1:
+        if client_error is not None and len(jobs) == 1 and \
+                primary_jobs == 1:
             # the single owning shard rejected the request itself:
             # propagate its verdict verbatim (nothing was applied
             # anywhere, so this is a clean 4xx, not a partial write)
@@ -1457,6 +1836,7 @@ class Router(GracefulHTTPServer):
                     off = detail.get("id_offset")
                     if isinstance(off, int) and not isinstance(off, bool):
                         shard.id_offset = off
+                    self._learn_spatial(shard, detail)
                     healthy = detail.get("slo", {}).get("state") != "PAGE"
                     if not healthy:
                         detail = {"ejected": "slo PAGE"}
@@ -1480,6 +1860,42 @@ class Router(GracefulHTTPServer):
                           shard=shard.index, detail=detail)
             if not healthy:
                 flight.auto_dump("route-eject")
+
+    @staticmethod
+    def _learn_spatial(shard: ShardState, detail: dict) -> None:
+        """Absorb the spatial topology a /healthz body publishes: the
+        replica's bounding box (pruning input — refreshed every probe,
+        so an epoch swap's tightened box takes effect within one health
+        period) and, for spatially-partitioned fleets, the shared grid
+        + owned Morton code range (write-ownership input — topology,
+        kept across later failures like id_offset). Malformed blocks
+        read as absent, never as a crash: boxes are advisory for
+        SELECTIVITY; correctness never depends on them (a box-less
+        shard is simply always contacted)."""
+        box = detail.get("box")
+        if isinstance(box, dict):
+            try:
+                lo = np.asarray([float(x) for x in box["lo"]],
+                                dtype=np.float32)
+                hi = np.asarray([float(x) for x in box["hi"]],
+                                dtype=np.float32)
+                if lo.shape == hi.shape and lo.size and \
+                        bool(np.isfinite(lo).all()
+                             and np.isfinite(hi).all()):
+                    shard.box = (lo, hi)
+            except (KeyError, TypeError, ValueError):
+                pass
+        sp = detail.get("spatial")
+        if isinstance(sp, dict):
+            grid = spatial.SpatialGrid.from_json(sp.get("grid"))
+            cr = sp.get("code_range")
+            try:
+                cr = (int(cr[0]), int(cr[1]))
+            except (TypeError, ValueError, IndexError):
+                cr = None
+            if grid is not None and cr is not None and cr[0] < cr[1]:
+                shard.grid = grid
+                shard.code_range = cr
 
     def _probe_health_safe(self, shard: ShardState) -> None:
         try:

@@ -313,6 +313,27 @@ class KnnRequestHandler(JsonRequestHandler):
                     # owns global ids in [id_offset, next shard's offset)
                     "id_offset": state.id_offset,
                 }
+                if hasattr(state.engine, "bounds"):
+                    # the shard's bounding box — the router's selective
+                    # fan-out prunes against it (docs/SERVING.md
+                    # "Spatial sharding & selective fan-out"). Expanded
+                    # live by delta upserts, recomputed at every epoch
+                    # swap; only published while finite (JSON Infinity
+                    # is not portable, and an infinite box prunes
+                    # nothing anyway).
+                    blo, bhi = state.engine.bounds()
+                    if np.isfinite(blo).all() and np.isfinite(bhi).all():
+                        body["box"] = {
+                            "lo": [float(x) for x in blo],
+                            "hi": [float(x) for x in bhi],
+                        }
+                if "spatial" in state.meta:
+                    # the spatial-partition contract this shard was cut
+                    # with (grid + owned Morton code range): the
+                    # router's write routing learns region ownership
+                    # from here, exactly as id_offset carries id-range
+                    # ownership
+                    body["spatial"] = state.meta["spatial"]
                 if hasattr(state.engine, "stats"):
                     mut = state.engine.stats()
                     body["mutable"] = mut
@@ -800,6 +821,7 @@ class KnnServer(GracefulHTTPServer):
         queue_rows: Optional[int] = None,
         faults=None,
         debug_faults: Optional[bool] = None,
+        recall_sample: float = 0.0,
     ) -> None:
         super().__init__(address, KnnRequestHandler)
         self.state = state
@@ -840,6 +862,10 @@ class KnnServer(GracefulHTTPServer):
             min_bucket=state.min_bucket,
             ladder=self.ladder,
             faults=self.faults,
+            # the online recall sampler (every Nth approx batch shadow-
+            # answered exactly, measured recall published) — 0 off, the
+            # serve CLI arms its default fraction
+            recall_sample=recall_sample,
         )
         # the history ring /debug/history serves and the sampler feeds:
         # the SLO engine's own ring when one is wired, else the process
@@ -926,9 +952,11 @@ def make_server(
     queue_rows: Optional[int] = None,
     faults=None,
     debug_faults: Optional[bool] = None,
+    recall_sample: float = 0.0,
 ) -> KnnServer:
     """Bind (port 0 = ephemeral; read ``server_address[1]``) but do not
     start — callers decide when the accept loop and warmup run."""
     return KnnServer((host, port), state, max_wait_ms=max_wait_ms,
                      queue_rows=queue_rows, faults=faults,
-                     debug_faults=debug_faults)
+                     debug_faults=debug_faults,
+                     recall_sample=recall_sample)

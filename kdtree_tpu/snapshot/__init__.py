@@ -9,12 +9,14 @@ from kdtree_tpu.snapshot.store import (
     SnapshotCorruptError,
     SnapshotError,
     SnapshotSchemaError,
+    collect_plan_profiles,
     list_versions,
     load_snapshot,
     plan_keys_for,
     read_manifest,
     resolve_dir,
     save_snapshot,
+    seed_plan_store,
 )
 
 __all__ = [
@@ -25,10 +27,12 @@ __all__ = [
     "SnapshotError",
     "SnapshotFollower",
     "SnapshotSchemaError",
+    "collect_plan_profiles",
     "list_versions",
     "load_snapshot",
     "plan_keys_for",
     "read_manifest",
     "resolve_dir",
     "save_snapshot",
+    "seed_plan_store",
 ]

@@ -30,6 +30,7 @@ from kdtree_tpu.snapshot.store import (
     load_snapshot,
     read_manifest,
     resolve_dir,
+    seed_plan_store,
 )
 
 DEFAULT_POLL_S = 2.0
@@ -118,6 +119,13 @@ class SnapshotFollower:
         version = int(man.get("version", version))
         epoch = int(man.get("epoch", 0))
         try:
+            # seed the local plan store from the manifest's pre-shipped
+            # profiles BEFORE the pre-warm below dispatches: adopt_tree's
+            # warmup ladder then resolves the primary's settled plans
+            # warm instead of locally re-settling them (fill-misses-only
+            # — seed_plan_store never overwrites local knowledge; and
+            # never raises past its own store tolerance)
+            seeded = seed_plan_store(man)
             # pre-warm + swap: adopt_tree compiles the new epoch's
             # batch shapes on THIS thread before the atomic handoff, so
             # serving never dispatches cold (the epoch rebuilder's own
@@ -134,7 +142,7 @@ class SnapshotFollower:
         self._adopts.inc()
         flight.record("snapshot.follow_swap", dir=self.dir,
                       version=version, epoch=epoch,
-                      n=int(tree.n_real))
+                      n=int(tree.n_real), plans_seeded=seeded)
         if self._on_adopt is not None:
             try:
                 self._on_adopt(man)

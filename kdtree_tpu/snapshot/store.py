@@ -171,6 +171,77 @@ def plan_keys_for(tree, k: int, max_batch: int = 1024,
     ]
 
 
+def collect_plan_profiles(
+    plan_keys: Optional[List[str]],
+) -> Dict[str, dict]:
+    """The local plan store's raw profiles for ``plan_keys`` — the
+    payload a snapshot PRE-SHIPS to replicas (docs/SERVING.md
+    "Snapshots & replica fleets"). Only keys the local store has
+    actually settled are included (a manifest must never ship a guess);
+    a disabled or unreadable store yields an empty dict. Profiles stay
+    version-checked raw dicts, signature included, so the seeding side
+    can reconstruct the exact store key."""
+    from kdtree_tpu.tuning.store import default_store
+
+    store = default_store()
+    out: Dict[str, dict] = {}
+    for key in plan_keys or []:
+        prof = store.raw_for_key(str(key))
+        if prof is not None:
+            out[str(key)] = prof
+    return out
+
+
+def seed_plan_store(manifest) -> int:
+    """Seed the LOCAL plan store from a manifest's pre-shipped
+    ``plan_profiles`` (the other half of :func:`collect_plan_profiles`)
+    — called by ``serve --snapshot`` and the blue/green follower BEFORE
+    the warmup ladder runs, so adoption compiles warm instead of
+    locally re-settling every launch plan. Returns how many profiles
+    were written.
+
+    Fill-misses-only: a key the local store already holds is skipped —
+    local knowledge (possibly tuned on THIS host) outranks the
+    primary's. Malformed entries are skipped silently (advisory
+    metadata, the plan-store trust model: a wrong profile can only
+    cost speed, and the overflow-retry contract still guards every
+    batch)."""
+    from kdtree_tpu.tuning.store import PlanSignature, default_store
+
+    profiles = (manifest or {}).get("plan_profiles")
+    if not isinstance(profiles, dict) or not profiles:
+        return 0
+    store = default_store()
+    if not store.enabled:
+        return 0
+    seeded = 0
+    for key, prof in profiles.items():
+        if not isinstance(prof, dict):
+            continue
+        sig_d = prof.get("signature")
+        if not isinstance(sig_d, dict):
+            continue
+        try:
+            sig = PlanSignature(**{f: sig_d[f]
+                                   for f in PlanSignature._fields})
+        except (KeyError, TypeError):
+            continue
+        if sig.key != key:
+            continue  # the key must name the profile it claims to
+        if store.get_raw(sig) is not None:
+            continue
+        body = {k: v for k, v in prof.items()
+                if k not in ("version", "signature", "updated_unix")}
+        if store.put(sig, body):
+            seeded += 1
+    if seeded:
+        obs.get_registry().counter(
+            "kdtree_snapshot_plan_seeded_total").inc(seeded)
+        flight.record("snapshot.plan_seed", seeded=seeded,
+                      shipped=len(profiles))
+    return seeded
+
+
 def read_manifest(dirpath: str) -> Optional[dict]:
     """Parse the manifest, or None when the directory holds none (or a
     torn/unparseable one — the follower treats that as 'nothing new
@@ -276,6 +347,7 @@ def save_snapshot(
     epoch: int = 0,
     id_offset: int = 0,
     plan_keys: Optional[List[str]] = None,
+    plan_profiles: Optional[Dict[str, dict]] = None,
     meta: Optional[dict] = None,
     keep: int = 1,
 ) -> dict:
@@ -351,6 +423,11 @@ def save_snapshot(
         },
         "segments": segments,
         "plan_keys": list(plan_keys or []),
+        # the pre-shipped warm-plan payload (collect_plan_profiles):
+        # replicas seed their store from it before warmup, so adoption
+        # compiles warm instead of locally re-tuning (the PR 13 open
+        # half — plan_keys used to be advisory key names only)
+        "plan_profiles": dict(plan_profiles or {}),
         "created_unix": round(time.time(), 3),
         "meta": dict(meta or {}),
     }

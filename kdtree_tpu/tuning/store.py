@@ -178,6 +178,23 @@ class PlanStore:
         _read_memo[path] = (st.st_mtime_ns, st.st_size, prof)
         return prof
 
+    def raw_for_key(self, key: str) -> Optional[dict]:
+        """Version-checked raw profile by signature KEY — for consumers
+        that hold key strings rather than signatures (the snapshot
+        manifest's ``plan_profiles`` payload ships profiles under their
+        keys). One implementation of the file naming and version gate,
+        shared with the signature-keyed read path; no memo (callers are
+        once-per-save, not per-query)."""
+        if not self.enabled:
+            return None
+        try:
+            with open(os.path.join(self.cache_dir,
+                                   f"plan-{key}.json")) as f:
+                prof = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return self._version_check(prof)
+
     @staticmethod
     def _version_check(prof) -> Optional[dict]:
         if not isinstance(prof, dict):

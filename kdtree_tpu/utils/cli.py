@@ -788,15 +788,110 @@ def cmd_build(args) -> None:
         except TypeError as e:
             print(f"cannot snapshot: {e}", file=sys.stderr)
             sys.exit(1)
+        keys = snap.plan_keys_for(serving, k=16)
         man = snap.save_snapshot(
             args.save, serving, epoch=0,
-            plan_keys=snap.plan_keys_for(serving, k=16),
+            plan_keys=keys,
+            # pre-ship any locally settled plan profiles for those keys
+            # so replicas cold-starting from this snapshot seed their
+            # store and warm up without re-tuning (docs/SERVING.md
+            # "Snapshots & replica fleets")
+            plan_profiles=snap.collect_plan_profiles(keys),
             meta=dict(meta),
             keep=max(getattr(args, "snapshot_keep", 1) or 1, 1),
         )
         print(f"serving snapshot v{man['version']} (epoch "
               f"{man['epoch']}, n={man['signature']['n_real']}) saved "
               f"to {snap.resolve_dir(args.save)}")
+
+
+def cmd_partition(args) -> None:
+    """Spatial partitioner (docs/SERVING.md "Spatial sharding &
+    selective fan-out"): cut one point cloud into N contiguous
+    Morton-range shards — each written as a ready-to-serve snapshot
+    whose manifest carries the shard's region (grid + code range) and
+    whose global ids are the Morton ranks, so the shard's id set AND
+    its region are both contiguous. A fleet served from these shards
+    gives the router disjoint, tight bounding boxes to prune against:
+    the sub-linear fan-out ROADMAP direction 3 names."""
+    import os
+
+    import jax.numpy as jnp
+
+    from kdtree_tpu import snapshot as snap
+    from kdtree_tpu.ops.morton import morton_view
+    from kdtree_tpu.serve import spatial as sp
+
+    if args.shards < 2:
+        print(f"--shards must be >= 2 (got {args.shards}); one shard "
+              "needs no partition", file=sys.stderr)
+        sys.exit(1)
+    if args.points:
+        pts = np.asarray(_load_array(args.points, "points"),
+                         dtype=np.float32)
+        src_meta = {"generator": "file", "points": args.points}
+    else:
+        if args.generator != "threefry":
+            print("note: partition's seeded problem is the threefry "
+                  f"row stream; --generator {args.generator} does not "
+                  "apply", file=sys.stderr)
+        from kdtree_tpu.ops.generate import generate_points_rowwise
+
+        pts = np.asarray(
+            generate_points_rowwise(args.seed, args.dim, args.n),
+            dtype=np.float32,
+        )
+        src_meta = {"seed": args.seed, "generator": "threefry"}
+    try:
+        plan = sp.plan_partition(pts, args.shards, bits=args.bits)
+    except ValueError as e:
+        print(f"cannot partition: {e}", file=sys.stderr)
+        sys.exit(1)
+    base = snap.resolve_dir(args.out_dir)
+    os.makedirs(base, exist_ok=True)
+    keep = max(getattr(args, "snapshot_keep", 1) or 1, 1)
+    shard_dirs = []
+    n_total = pts.shape[0]
+    for i, ((s, e), (c0, c1), (blo, bhi)) in enumerate(
+        zip(plan["bounds"], plan["code_ranges"], plan["boxes"])
+    ):
+        rows = plan["order"][s:e]
+        # global ids ARE the morton ranks: shard i owns ids [s, e) —
+        # contiguous ids and a contiguous code range, by construction
+        tree = morton_view(
+            jnp.asarray(pts[rows]),
+            gid=jnp.asarray(np.arange(s, e, dtype=np.int32)),
+            n_real=int(e - s),
+        )
+        sdir = os.path.join(base, f"shard-{i:02d}")
+        plan_keys = snap.plan_keys_for(tree, k=args.k,
+                                       max_batch=args.max_batch)
+        snap.save_snapshot(
+            sdir, tree, epoch=0, id_offset=0,
+            plan_keys=plan_keys,
+            plan_profiles=snap.collect_plan_profiles(plan_keys),
+            meta={**src_meta, "spatial": {
+                "grid": plan["grid"].to_json(),
+                "code_range": [int(c0), int(c1)],
+                "id_range": [int(s), int(e)],
+                "shard": i,
+                "shards": int(args.shards),
+            }},
+            keep=keep,
+        )
+        shard_dirs.append(sdir)
+        box = ", ".join(f"[{float(a):g}, {float(b):g}]"
+                        for a, b in zip(blo, bhi))
+        print(f"shard {i}: n={e - s} ids [{s}, {e}) "
+              f"code [{c0}, {c1})  box {box}")
+    man_path = sp.write_fleet_manifest(base, plan, shard_dirs)
+    print(f"partitioned {n_total} points into {args.shards} "
+          f"Morton-range shards under {base} ({man_path})")
+    print("serve each with: kdtree-tpu serve --snapshot "
+          f"{shard_dirs[0]} --port 0 ...  (id_offset stays 0 — shard "
+          "trees answer GLOBAL morton-rank ids directly); then route "
+          "them and the router prunes by their /healthz boxes",
+          file=sys.stderr)
 
 
 def cmd_query(args) -> None:
@@ -944,6 +1039,21 @@ def cmd_serve(args) -> None:
                 "role": ("secondary" if follow_s is not None
                          else "primary" if save_dir else "static"),
             }}
+            if isinstance(man.get("meta"), dict) and \
+                    "spatial" in man["meta"]:
+                # a spatially-partitioned shard (kdtree-tpu partition):
+                # surface the region contract (grid + owned Morton code
+                # range) on /healthz so the router can learn write
+                # ownership and prune reads by box
+                meta["spatial"] = man["meta"]["spatial"]
+            seeded = snap.seed_plan_store(man)
+            if seeded:
+                # pre-shipped plan profiles (the manifest rode them from
+                # the primary's store): the warmup ladder below now
+                # resolves them warm instead of re-settling locally
+                print(f"plan store seeded with {seeded} pre-shipped "
+                      "profile(s) from the snapshot manifest",
+                      file=sys.stderr)
             print(f"snapshot loaded: v{loaded_version} epoch {epoch0} "
                   f"(n={tree.n_real}) from {snap.resolve_dir(snap_dir)}",
                   file=sys.stderr)
@@ -1011,10 +1121,19 @@ def cmd_serve(args) -> None:
                           _off=id_offset, _k=args.k,
                           _mb=args.max_batch,
                           _keep=max(getattr(args, "snapshot_keep", 1)
-                                    or 1, 1)):
+                                    or 1, 1),
+                          _spatial=(meta.get("spatial")
+                                    if isinstance(meta, dict) else None)):
+            keys = snap.plan_keys_for(tree_, _k, _mb)
             snap.save_snapshot(
                 _dir, tree_, epoch=epoch, id_offset=_off,
-                plan_keys=snap.plan_keys_for(tree_, _k, _mb),
+                plan_keys=keys,
+                # pre-ship this primary's settled plan profiles so a
+                # snapshot-follow secondary adopts WARM (PR 13's open
+                # half): by emit time the warmup ladder has settled
+                # every key into the local store
+                plan_profiles=snap.collect_plan_profiles(keys),
+                meta=({"spatial": _spatial} if _spatial else None),
                 keep=_keep,
             )
     try:
@@ -1053,6 +1172,8 @@ def cmd_serve(args) -> None:
             state, host=args.host, port=args.port,
             max_wait_ms=args.max_wait_ms, queue_rows=args.queue_depth,
             debug_faults=args.debug_faults,
+            recall_sample=max(getattr(args, "recall_sample", 0.0) or 0.0,
+                              0.0),
         )
     except srv.FaultSpecError as e:
         # a typo'd KDTREE_TPU_FAULTS must fail the drill at startup,
@@ -1171,6 +1292,7 @@ def cmd_route(args) -> None:
             breaker_failures=args.breaker_failures,
             breaker_reset_s=args.breaker_reset_s,
             health_period_s=args.health_period_s,
+            fanout=args.fanout,
         )
         from kdtree_tpu.obs import slo as obs_slo
 
@@ -1774,6 +1896,40 @@ def main(argv=None) -> None:
                          "(forest engines auto-shard above 1 GiB)")
     bu.set_defaults(fn=cmd_build)
 
+    pa = sub.add_parser(
+        "partition",
+        help="spatial partitioner: cut one point cloud into N "
+             "contiguous Morton-range shard snapshots (global ids = "
+             "morton ranks; each manifest carries the shard's region) "
+             "for the router's selective fan-out (docs/SERVING.md "
+             "\"Spatial sharding & selective fan-out\")",
+    )
+    pa.add_argument("--points", default=None, metavar="FILE",
+                    help="partition user data ([N, D] .npy/.npz) "
+                         "instead of a seeded problem")
+    pa.add_argument("--seed", type=int, default=42)
+    pa.add_argument("--dim", type=int, default=3)
+    pa.add_argument("--n", type=int, default=1 << 20)
+    pa.add_argument("--shards", type=int, required=True,
+                    help="how many Morton-range shards to cut (>= 2)")
+    pa.add_argument("--out-dir", required=True, metavar="DIR",
+                    help="output directory: one serving snapshot per "
+                         "shard (shard-00/, shard-01/, ...) plus a "
+                         "PARTITION.json fleet summary (relative paths "
+                         "resolve under KDTREE_TPU_SNAPSHOT_DIR)")
+    pa.add_argument("--bits", type=int, default=None,
+                    help="Morton quantization bits per axis (default: "
+                         "the shared default_bits rule for this D)")
+    pa.add_argument("--k", type=int, default=16,
+                    help="the k the shard servers will serve at (plan "
+                         "keys/profiles in each manifest are computed "
+                         "for it)")
+    pa.add_argument("--max-batch", type=int, default=1024,
+                    help="the serve --max-batch the plan keys cover")
+    pa.add_argument("--snapshot-keep", type=int, default=1, metavar="N",
+                    help="snapshot generations each shard dir retains")
+    pa.set_defaults(fn=cmd_partition)
+
     q = sub.add_parser("query", help="load a tree and run the 10 protocol queries")
     q.add_argument("--tree", required=True)
     q.add_argument("--seed", type=int, default=None,
@@ -1876,6 +2032,13 @@ def main(argv=None) -> None:
                     help="with --snapshot: load a RETAINED generation "
                          "V instead of the live manifest — the "
                          "rollback button --snapshot-keep enables")
+    sv.add_argument("--recall-sample", type=float, default=0.02,
+                    metavar="FRAC",
+                    help="online recall sampler: shadow-answer this "
+                         "fraction of approximate-gear batches exactly "
+                         "and publish the MEASURED served recall "
+                         "(kdtree_recall_sampled — the sampled-recall "
+                         "SLO watches it); 0 disables (default 0.02)")
     sv.add_argument("--no-ladder", action="store_true",
                     help="disable the degradation ladder (exact -> "
                          "approx(0.99) -> approx(0.9) -> brute-force-"
@@ -1930,6 +2093,15 @@ def main(argv=None) -> None:
                          "probe")
     ro.add_argument("--health-period-s", type=float, default=1.0,
                     help="per-shard /healthz poll period for ejection")
+    ro.add_argument("--fanout", choices=["selective", "full"],
+                    default="selective",
+                    help="selective (default) prunes shards whose "
+                         "/healthz bounding box provably cannot hold a "
+                         "top-k member (byte-identical answers, fewer "
+                         "contacts — docs/SERVING.md \"Spatial "
+                         "sharding & selective fan-out\"); full "
+                         "restores the contact-every-shard scatter "
+                         "(the A/B baseline)")
     ro.set_defaults(fn=cmd_route)
 
     lg = sub.add_parser(

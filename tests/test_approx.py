@@ -469,3 +469,97 @@ def test_client_requested_approx_never_moves_the_slo_gauge(dial_server):
         assert status == 200 and body["gear"] == "approx:0.5"
     snap = obs.get_registry().snapshot()
     assert snap["gauges"]["kdtree_recall_estimate"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# online recall sampler (ISSUE 15 satellite: the served-recall SLO's
+# measured twin — docs/SERVING.md "Degradation ladder")
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sampled_server(tree, tmp_path, monkeypatch):
+    """A server with the sampler at fraction 1.0 (every approx batch
+    shadow-answered) — deterministic for the assertions below."""
+    from kdtree_tpu.serve import lifecycle, server as srv
+
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE", str(tmp_path))
+    state = lifecycle.build_state(tree=tree, k=4, max_batch=64)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0,
+                            recall_sample=1.0)
+    httpd.start(warmup_buckets=[8])
+    try:
+        yield httpd
+    finally:
+        httpd.stop()
+
+
+def test_recall_sampler_measures_approx_batches_only(sampled_server):
+    """Every approx batch is shadow-answered exactly: the samples
+    counter advances, the measured-recall gauge appears (EWMA in
+    [0, 1]), and EXACT batches are never sampled (nothing to
+    measure)."""
+    httpd = sampled_server
+
+    def counters():
+        snap = obs.get_registry().snapshot()
+        return (snap["counters"].get("kdtree_recall_samples_total", 0.0),
+                snap["gauges"].get("kdtree_recall_sampled"))
+
+    before, gauge_before = counters()
+    # exact traffic first: no sampling
+    status, body = _post(httpd, {"queries": [[0.5, 0.5, 0.5]], "k": 2})
+    assert status == 200 and "gear" not in body
+    mid, _ = counters()
+    assert mid == before
+    # approx traffic: each batch sampled (fraction 1.0)
+    for i in range(3):
+        status, body = _post(httpd, {
+            "queries": [[0.1 * i, 0.2, 0.3]], "k": 4,
+            "recall_target": 0.5})
+        assert status == 200 and body["gear"] == "approx:0.5"
+    # the shadow dispatch runs AFTER the sampled batch's answers left
+    # (that is the point: sampling must not delay what it measures), so
+    # poll briefly for the third sample to land
+    import time as _time
+
+    deadline = _time.monotonic() + 30.0
+    while _time.monotonic() < deadline:
+        after, gauge = counters()
+        if after >= mid + 3:
+            break
+        _time.sleep(0.05)
+    assert after >= mid + 3
+    assert gauge is not None and 0.0 <= gauge <= 1.0
+    # the flight ring carries the per-sample evidence
+    import urllib.request as _rq
+
+    with _rq.urlopen(_url(httpd, "/debug/flight"), timeout=30) as r:
+        ring = json.loads(r.read())
+    samples = [e for e in ring["events"]
+               if e.get("type") == "recall.sample"]
+    assert samples and all("measured" in e and "estimate" in e
+                           for e in samples)
+
+
+def test_recall_sampler_defaults_off():
+    """In-process embedders get no sampler unless they opt in (the
+    serve CLI arms its default) — same posture as the ladder."""
+    from kdtree_tpu.serve.batcher import MicroBatcher
+
+    assert MicroBatcher.__init__.__defaults__[
+        MicroBatcher.__init__.__code__.co_varnames.index("recall_sample")
+        - 3] == 0.0  # (engine, queue) have no defaults; offset by them
+
+
+def test_sampled_recall_slo_spec_armed():
+    """recall_specs carries the sampled-recall gauge_min spec next to
+    the estimate-watching one, on the same floor."""
+    from kdtree_tpu.obs import slo as obs_slo
+
+    specs = {s.name: s for s in obs_slo.recall_specs()}
+    assert "sampled-recall" in specs and "served-recall" in specs
+    spec = specs["sampled-recall"]
+    assert spec.kind == "gauge_min"
+    assert spec.gauge == "kdtree_recall_sampled"
+    assert spec.threshold == specs["served-recall"].threshold

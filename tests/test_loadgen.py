@@ -490,3 +490,96 @@ def test_runner_sends_recall_target_and_records_gear_distribution():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# fan-out fraction (ISSUE 15 satellite: the selective fan-out evidence
+# in the capacity block — docs/SERVING.md "Spatial sharding")
+# ---------------------------------------------------------------------------
+
+
+def test_fanout_of_parses_router_and_shard_shapes():
+    fo = lg_runner._fanout_of
+    router_body = {"shards": {"total": 4, "contacted": 2,
+                              "answered": 2}}
+    assert fo("query", 200, router_body) == 0.5
+    # pre-selective routers carry no contacted key: answered stands in
+    assert fo("query", 200,
+              {"shards": {"total": 3, "answered": 3}}) == 1.0
+    assert fo("query", 200, {"ids": [[1]]}) is None  # plain shard
+    assert fo("upsert", 200, router_body) is None    # writes: no gear
+    assert fo("query", 429, router_body) is None     # failures
+    assert fo("query", 200, {"shards": {"total": 0}}) is None
+
+
+def test_fanout_frac_lands_in_steps_and_capacity():
+    """A router-shaped stub answering a shards block: the per-step
+    fanout_frac and the capacity-level mean are recorded; a plain
+    shard target records None (absent, not 1.0)."""
+
+    class RouterishStub(_StubHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            self._answer(200, {
+                "ids": [[0]], "distances": [[0.0]], "degraded": None,
+                "shards": {"total": 4, "contacted": 1, "answered": 1,
+                           "missing": [], "pruned": 3},
+            })
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), RouterishStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    target = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        sched = build_schedule([30], 1.0, 5, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=False)
+        step = rep["capacity"]["steps"][0]
+        assert step["fanout_frac"] == 0.25
+        assert rep["capacity"]["fanout_frac"] == 0.25
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # plain shard stub: no shards block -> fraction absent
+    httpd, target = _stub_server()
+    try:
+        sched = build_schedule([30], 1.0, 5, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=False)
+        assert rep["capacity"]["steps"][0]["fanout_frac"] is None
+        assert rep["capacity"]["fanout_frac"] is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_discover_write_base_respects_spatial_id_range():
+    """A spatial shard serves GLOBAL morton-rank ids at id_offset 0:
+    its occupied span is spatial.id_range, so write_base must clear
+    the FLEET's id space, not this shard's [0, n)."""
+    assert lg_runner._write_base_of(
+        {"id_offset": 0, "n": 500,
+         "spatial": {"id_range": [1500, 2000]}}) == 2000
+    assert lg_runner._write_base_of({"id_offset": 100, "n": 50}) == 150
+    # malformed spatial blocks fall back to offset + n
+    assert lg_runner._write_base_of(
+        {"id_offset": 0, "n": 7, "spatial": {"id_range": "x"}}) == 7
+
+    class SpatialRouterStub(_StubHandler):
+        def do_GET(self):
+            self._answer(200, {"status": "ok", "shards": [
+                {"detail": {"dim": 3, "n": 500, "k_max": 4,
+                            "id_offset": 0,
+                            "spatial": {"id_range": [0, 500]}}},
+                {"detail": {"dim": 3, "n": 500, "k_max": 4,
+                            "id_offset": 0,
+                            "spatial": {"id_range": [500, 1000]}}},
+            ]})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), SpatialRouterStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        facts = lg_runner.discover(
+            f"http://127.0.0.1:{httpd.server_address[1]}", retries=3)
+        assert facts["write_base"] == 1000 and facts["n"] == 1000
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

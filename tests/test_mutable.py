@@ -944,3 +944,48 @@ def test_rebuild_impact_gauge_lands_on_metrics(base_points):
             if ln.startswith("kdtree_mutable_rebuild_p99_delta_ms ")]
     assert line, "gauge missing after a measured rebuild window"
     assert float(line[0].split()[-1]) > 0
+
+
+# ---------------------------------------------------------------------------
+# published bounding box (ISSUE 15: the selective fan-out's pruning
+# input — never stale-exclusive, tightened at epoch swaps)
+# ---------------------------------------------------------------------------
+
+
+def test_bounds_expand_on_upsert_never_shrink_on_delete(base_points):
+    eng = fresh_engine(base_points)
+    lo0, hi0 = eng.bounds()
+    assert (lo0 <= base_points.min(axis=0) + 1e-6).all()
+    # an upsert OUTSIDE the box expands it immediately (pre-probe: the
+    # /healthz box is never stale-exclusive of a delta point)
+    far = (base_points.max(axis=0) + np.float32(50.0)).reshape(1, -1)
+    eng.upsert(np.array([900000]), far.astype(np.float32))
+    lo1, hi1 = eng.bounds()
+    assert (hi1 >= far[0] - 1e-6).all() and (lo1 == lo0).all()
+    # deleting it does NOT shrink the box (conservative until the next
+    # epoch recompute — a tight-but-wrong box would cost answers)
+    eng.delete(np.array([900000]))
+    lo2, hi2 = eng.bounds()
+    assert (hi2 == hi1).all() and (lo2 == lo1).all()
+    eng.close()
+
+
+def test_bounds_tighten_at_epoch_swap(base_points):
+    eng = fresh_engine(base_points, max_delta_rows=4)
+    _, hi0 = eng.bounds()
+    far = (base_points.max(axis=0) + np.float32(50.0)).reshape(1, -1)
+    eng.upsert(np.array([900000]), far.astype(np.float32))
+    eng.delete(np.array([900000]))
+    # churn past the threshold: the compaction drops the far point and
+    # the NEW epoch's recomputed box tightens back
+    for j in range(4):
+        eng.upsert(
+            np.array([900100 + j]),
+            base_points[j].reshape(1, -1).astype(np.float32))
+    deadline = time.monotonic() + 60.0
+    while eng.epoch == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert eng.epoch >= 1
+    _, hi2 = eng.bounds()
+    assert (hi2 <= hi0 + 1e-6).all()
+    eng.close()

@@ -277,7 +277,9 @@ def test_routed_matches_oracle_in_process(shards, oracle_tree):
             assert out["distances"] == dist
             assert out["degraded"] is None
             assert out["shards"] == {"total": N_SHARDS,
-                                     "answered": N_SHARDS, "missing": []}
+                                     "contacted": N_SHARDS,
+                                     "answered": N_SHARDS, "missing": [],
+                                     "pruned": 0}
 
 
 def test_router_trace_id_threads_to_shards(shards):
@@ -1256,3 +1258,470 @@ def test_cross_replica_hedge_win_fails_over_wedged_replica(
     finally:
         replicas.faults[1].clear()
         time.sleep(0.4)  # let the breaker cooldown pass for later tests
+
+
+# ---------------------------------------------------------------------------
+# spatial sharding + selective fan-out (ISSUE 15,
+# docs/SERVING.md "Spatial sharding & selective fan-out")
+# ---------------------------------------------------------------------------
+
+
+SP_SHARDS = 4
+SP_CENTERS = np.array(
+    [[-60.0, -60.0, -60.0], [60.0, 60.0, 60.0],
+     [-60.0, 60.0, 0.0], [60.0, -60.0, 0.0]], dtype=np.float32,
+)
+
+
+class SpatialFleet:
+    """A 4-shard spatially-partitioned in-process fleet over a
+    clustered cloud: each shard serves a Morton-range partition with
+    GLOBAL morton-rank gids (id_offset 0) and publishes its box +
+    region on /healthz — exactly what ``kdtree-tpu partition`` + serve
+    produce, minus the disk round-trip. Tracks the live cloud
+    host-side so any moment's single-index oracle is reconstructible
+    byte-for-byte."""
+
+    def __init__(self, max_delta_rows=8):
+        from kdtree_tpu.serve import spatial as sp
+
+        rng = np.random.default_rng(17)
+        pts = np.concatenate([
+            c + rng.normal(0.0, 3.0, (400, 3)) for c in SP_CENTERS
+        ]).astype(np.float32)
+        self.plan = sp.plan_partition(pts, SP_SHARDS)
+        order = self.plan["order"]
+        # the live cloud, keyed by GLOBAL id (= morton rank at build)
+        self.cloud = {int(i): pts[order[i]].copy()
+                      for i in range(pts.shape[0])}
+        self.n0 = pts.shape[0]
+        self.servers = []
+        self.urls = []
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops.morton import morton_view
+
+        for i, ((s, e), (c0, c1)) in enumerate(
+            zip(self.plan["bounds"], self.plan["code_ranges"])
+        ):
+            tree = morton_view(
+                jnp.asarray(pts[order[s:e]]),
+                gid=jnp.asarray(np.arange(s, e, dtype=np.int32)),
+                n_real=int(e - s),
+            )
+            state = lifecycle.build_state(
+                tree=tree, k=K, max_batch=64,
+                max_delta_rows=max_delta_rows,
+                meta={"spatial": {
+                    "grid": self.plan["grid"].to_json(),
+                    "code_range": [int(c0), int(c1)],
+                    "id_range": [int(s), int(e)],
+                    "shard": i, "shards": SP_SHARDS,
+                }},
+            )
+            httpd = srv.make_server(state, port=0)
+            httpd.start(warmup_buckets=[8])
+            self.servers.append(httpd)
+            self.urls.append(
+                f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    def oracle(self, queries, k):
+        """Single-index oracle over the CURRENT live cloud (original
+        global ids preserved) — the byte-identity reference."""
+        import jax.numpy as jnp
+
+        from kdtree_tpu.ops.morton import morton_view
+        from kdtree_tpu.ops.tile_query import morton_knn_tiled
+
+        ids = sorted(self.cloud)
+        pts = np.stack([self.cloud[i] for i in ids])
+        tree = morton_view(
+            jnp.asarray(pts),
+            gid=jnp.asarray(np.asarray(ids, dtype=np.int32)),
+            n_real=len(ids),
+        )
+        kk = min(k, len(ids))
+        d2, gids = morton_knn_tiled(tree, jnp.asarray(queries), k=kk)
+        return (
+            np.sqrt(np.asarray(d2).astype(np.float64)).tolist(),
+            np.asarray(gids).tolist(),
+        )
+
+    def stop(self):
+        for httpd in self.servers:
+            httpd.stop()
+
+
+@pytest.fixture(scope="module")
+def spatial_fleet():
+    fleet = SpatialFleet()
+    yield fleet
+    fleet.stop()
+
+
+@contextlib.contextmanager
+def spatial_router(fleet, fanout="selective", health_loop=True, **cfg):
+    defaults = dict(deadline_s=30.0, retries=1, backoff_base_s=0.01,
+                    health_period_s=0.1, fanout=fanout)
+    defaults.update(cfg)
+    router = rt.make_router(fleet.urls,
+                            config=rt.RouterConfig(**defaults))
+    router.start(health_loop=health_loop)
+    try:
+        if health_loop:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if all(ss.box() is not None
+                       and ss.code_range_known() is not None
+                       for ss in router.shard_sets):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("fleet topology never learned")
+        yield router
+    finally:
+        router.stop()
+
+
+def _near(center, seed, rows=1, spread=2.0):
+    rng = np.random.default_rng(seed)
+    return (center + rng.normal(0.0, spread, (rows, 3))).astype(
+        np.float32)
+
+
+def test_spatial_selective_byte_identical_and_prunes(spatial_fleet):
+    """The tentpole pin: on a spatially-partitioned 4-shard fleet,
+    selective answers are byte-identical (distances AND ids) to the
+    single-index oracle AND to a full-fan-out router, while contacting
+    fewer shards for clustered queries — with the pruning visible on
+    the metrics."""
+    pruned_before = _counter("kdtree_router_shards_pruned_total")
+    contacts = []
+    with spatial_router(spatial_fleet) as sel_router, \
+            spatial_router(spatial_fleet, fanout="full") as full_router:
+        for si, center in enumerate(SP_CENTERS):
+            q = _near(center, seed=40 + si)
+            payload = {"queries": q.tolist(), "k": K}
+            status, out = _post(sel_router, payload)
+            assert status == 200 and out["degraded"] is None
+            dist, ids = spatial_fleet.oracle(q, K)
+            assert out["ids"] == ids
+            assert out["distances"] == dist
+            status_f, out_f = _post(full_router, payload)
+            assert status_f == 200
+            assert out_f["ids"] == ids and out_f["distances"] == dist
+            # full mode contacts everything; selective prunes
+            assert out_f["shards"]["contacted"] == SP_SHARDS
+            assert out_f["shards"]["pruned"] == 0
+            contacts.append(out["shards"]["contacted"])
+            assert out["shards"]["contacted"] + \
+                out["shards"]["pruned"] == SP_SHARDS
+    # the acceptance selectivity bar: mean contacted <= 50% of shards
+    # on the clustered smoke shape
+    assert np.mean(contacts) <= 0.5 * SP_SHARDS, contacts
+    assert _counter("kdtree_router_shards_pruned_total") > pruned_before
+
+
+def test_spatial_batch_spanning_clusters_stays_exact(spatial_fleet):
+    rng = np.random.default_rng(77)
+    q = np.concatenate([
+        _near(SP_CENTERS[0], 50, rows=2),
+        _near(SP_CENTERS[1], 51, rows=2),
+        (rng.random((2, 3)) * 300.0 - 150.0).astype(np.float32),
+    ])
+    with spatial_router(spatial_fleet) as router:
+        status, out = _post(router, {"queries": q.tolist(), "k": K})
+    assert status == 200
+    dist, ids = spatial_fleet.oracle(q, K)
+    assert out["ids"] == ids and out["distances"] == dist
+
+
+def test_spatial_heterogeneous_legacy_shard_never_pruned(spatial_fleet):
+    """A fleet mixing box-publishing and legacy (no-box) shards must
+    degrade to full fan-out for the legacy ones — they are ALWAYS
+    contacted, never silently pruned."""
+    legacy = 2
+    with spatial_router(spatial_fleet, health_loop=False) as router:
+        for shard in router.shards:
+            router._probe_health(shard)
+        # strip one set's spatial evidence: a legacy serve build that
+        # never published a box looks exactly like this
+        for rep in router.shard_sets[legacy].replicas:
+            rep.box = None
+        router.shard_sets[legacy]._box_ext = None
+        attempts_key = ('kdtree_router_replica_requests_total'
+                        '{replica="0",shard="%d"}')
+        before = {i: _counter(attempts_key % i)
+                  for i in range(SP_SHARDS)}
+        n_req = 0
+        for si, center in enumerate(SP_CENTERS):
+            q = _near(center, seed=60 + si)
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+            assert status == 200
+            dist, ids = spatial_fleet.oracle(q, K)
+            assert out["ids"] == ids and out["distances"] == dist
+            n_req += 1
+        after = {i: _counter(attempts_key % i)
+                 for i in range(SP_SHARDS)}
+        # the legacy shard was contacted by EVERY request...
+        assert after[legacy] - before[legacy] == n_req
+        # ...while boxed shards still got pruned when provably useless
+        assert sum(after[i] - before[i]
+                   for i in range(SP_SHARDS)) < n_req * SP_SHARDS
+
+
+def test_spatial_write_routing_upsert_move_delete(spatial_fleet):
+    """Spatial write routing: a fresh upsert lands ONLY on the shard
+    whose region contains the point; a moved id dies on its old shard
+    (stale-copy delete broadcast); deletes broadcast-resolve by id.
+    Answers stay byte-identical to the oracle at every step."""
+    from kdtree_tpu.serve import spatial as sp
+
+    fleet = spatial_fleet
+    with spatial_router(fleet) as router:
+        # fresh insert near cluster 1
+        new_id = fleet.n0 + 1000
+        p_new = (SP_CENTERS[1] + np.float32(1.5)).astype(np.float32)
+        owner = int(sp.owner_of(p_new.reshape(1, 3), fleet.plan["grid"],
+                                fleet.plan["code_ranges"])[0])
+        status, out = _post_path(router, "/v1/upsert", {
+            "ids": [new_id], "points": [p_new.tolist()]})
+        assert status == 200 and out["applied"] == 1
+        assert out["routing"] == "spatial"
+        assert out["shards"][str(owner)]["applied"] == 1
+        # the stale-copy broadcast rode along, applying nothing
+        for i in range(SP_SHARDS):
+            if i != owner:
+                assert out["shards"][f"{i}:delete"]["applied"] == 0
+        fleet.cloud[new_id] = p_new
+        q = p_new.reshape(1, 3)
+        status, out = _post(router, {"queries": q.tolist(), "k": K})
+        dist, ids = fleet.oracle(q, K)
+        assert out["ids"] == ids and out["distances"] == dist
+        assert out["ids"][0][0] == new_id
+        # MOVE an existing id from cluster 0's region into cluster 1's:
+        # the upsert routes to the NEW owner, the old copy dies by the
+        # stale-copy delete on its old shard
+        moved = 0  # morton rank 0 lives in some region; move it far
+        p_moved = (SP_CENTERS[1] - np.float32(1.5)).astype(np.float32)
+        status, out = _post_path(router, "/v1/upsert", {
+            "ids": [moved], "points": [p_moved.tolist()]})
+        assert status == 200 and out["applied"] == 1
+        old_pos = fleet.cloud[moved]
+        fleet.cloud[moved] = p_moved
+        for q in (p_moved.reshape(1, 3), old_pos.reshape(1, 3),
+                  _near(SP_CENTERS[0], 70)):
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+            assert status == 200
+            dist, ids = fleet.oracle(q, K)
+            assert out["ids"] == ids and out["distances"] == dist
+        # DELETE broadcast-resolves by id
+        status, out = _post_path(router, "/v1/delete",
+                                 {"ids": [new_id, moved]})
+        assert status == 200 and out["routing"] == "spatial"
+        assert out["applied"] == 2
+        del fleet.cloud[new_id]
+        del fleet.cloud[moved]
+        q = p_new.reshape(1, 3)
+        status, out = _post(router, {"queries": q.tolist(), "k": K})
+        dist, ids = fleet.oracle(q, K)
+        assert out["ids"] == ids and out["distances"] == dist
+        assert new_id not in out["ids"][0]
+
+
+def test_spatial_exact_across_epoch_swap_with_live_writes(spatial_fleet):
+    """The acceptance's hardest pin: byte-identity to the oracle holds
+    across an epoch swap triggered by live routed upserts (the shard's
+    box is recomputed at the swap; the delta-expanded and router-side
+    boxes cover the window before it)."""
+    from kdtree_tpu.serve import spatial as sp
+
+    fleet = spatial_fleet
+    # 12 candidate points that provably share ONE owning region (the
+    # Z-curve can split even close neighbors across shard cuts, so pick
+    # by computed ownership instead of proximity)
+    rng = np.random.default_rng(93)
+    cands = (SP_CENTERS[3] + rng.normal(0.0, 1.0, (64, 3))).astype(
+        np.float32)
+    owners = sp.owner_of(cands, fleet.plan["grid"],
+                         fleet.plan["code_ranges"])
+    owner = int(np.bincount(owners).argmax())
+    cands = cands[owners == owner][:12]
+    assert cands.shape[0] == 12
+    with spatial_router(fleet) as router:
+        base = fleet.n0 + 2000
+        epochs_before = [
+            json.loads(urllib.request.urlopen(
+                u + "/healthz", timeout=10).read())["epoch"]
+            for u in fleet.urls
+        ]
+        for j in range(12):  # > max_delta_rows=8 on the owning shard
+            p = cands[j]
+            status, out = _post_path(router, "/v1/upsert", {
+                "ids": [base + j], "points": [p.tolist()]})
+            assert status == 200, out
+            fleet.cloud[base + j] = p
+            q = p.reshape(1, 3)
+            status, out = _post(router, {"queries": q.tolist(),
+                                         "k": K})
+            assert status == 200
+            dist, ids = fleet.oracle(q, K)
+            assert out["ids"] == ids and out["distances"] == dist
+        # some shard compacted: its epoch moved past the bootstrap one
+        deadline = time.monotonic() + 30.0
+        swapped = False
+        while time.monotonic() < deadline and not swapped:
+            epochs = [
+                json.loads(urllib.request.urlopen(
+                    u + "/healthz", timeout=10).read())["epoch"]
+                for u in fleet.urls
+            ]
+            swapped = any(e > b for e, b in zip(epochs, epochs_before))
+            if not swapped:
+                time.sleep(0.1)
+        assert swapped, "no epoch swap despite 12 routed upserts"
+        # post-swap: still byte-identical, still selective
+        for si, c in enumerate(SP_CENTERS):
+            q = _near(c, seed=90 + si)
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+            assert status == 200
+            dist, ids = fleet.oracle(q, K)
+            assert out["ids"] == ids and out["distances"] == dist
+
+
+def test_spatial_recall_target_stops_widening_with_gear(spatial_fleet):
+    """A recall_target lets the router stop widening once the
+    guaranteed-query fraction reaches the target: fewer contacts than
+    exact mode, the spatial truncation echoed in the gear token, and
+    absent target = exact (no gear) — the PR 14 contract spatially."""
+    fleet = spatial_fleet
+    # 3 queries deep inside cluster 2 + 1 in the dead middle: the
+    # middle query is the one whose exactness needs extra shards
+    q = np.concatenate([
+        _near(SP_CENTERS[2], 80, rows=3, spread=1.0),
+        np.zeros((1, 3), dtype=np.float32),
+    ])
+    with spatial_router(fleet) as router:
+        status, exact_out = _post(router, {"queries": q.tolist(),
+                                           "k": K})
+        assert status == 200 and "gear" not in exact_out
+        dist, ids = fleet.oracle(q, K)
+        assert exact_out["ids"] == ids
+        status, approx_out = _post(router, {
+            "queries": q.tolist(), "k": K, "recall_target": 0.7})
+        assert status == 200
+        m_exact = exact_out["shards"]["contacted"]
+        m_approx = approx_out["shards"]["contacted"]
+        assert m_approx <= m_exact
+        if m_approx < m_exact:
+            # widening actually stopped early: the response must say so
+            assert approx_out["gear"] == "approx:0.7"
+            # the 3 guaranteed queries' rows are still the exact rows
+            for row in range(3):
+                assert approx_out["ids"][row] == ids[row]
+
+
+def test_router_config_fanout_validation():
+    with pytest.raises(ValueError, match="fanout"):
+        rt.RouterConfig(fanout="nope")
+    assert rt.RouterConfig(fanout="full").fanout == "full"
+    assert rt.RouterConfig().fanout == "selective"
+
+
+def test_spatial_gear_combination():
+    assert rt.Router._spatial_gear(None, None) is None
+    assert rt.Router._spatial_gear(None, 0.8) == "approx:0.8"
+    assert rt.Router._spatial_gear("approx:0.5", 0.8) == "approx:0.5"
+    assert rt.Router._spatial_gear("approx:0.9", 0.8) == "approx:0.8"
+    assert rt.Router._spatial_gear("brute-deadline", 0.8) == "approx:0.8"
+
+
+def test_spatial_write_owner_correct_under_shuffled_shard_order(
+        spatial_fleet):
+    """Review-pass pin: the operator's --shard flag order is arbitrary,
+    but owner_of's searchsorted needs ascending code-range lows — the
+    router must sort and map back, or a shuffled fleet mints wrong
+    owners (and the stale-delete broadcast would delete the id from
+    its REAL owner while applying it nowhere)."""
+    from kdtree_tpu.serve import spatial as sp
+
+    fleet = spatial_fleet
+    shuffled = list(reversed(fleet.urls))
+    router = rt.make_router(shuffled, config=rt.RouterConfig(
+        deadline_s=30.0, health_period_s=0.1))
+    router.start(health_loop=True)
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(ss.code_range_known() is not None
+                   for ss in router.shard_sets):
+                break
+            time.sleep(0.05)
+        new_id = fleet.n0 + 3000
+        p = (SP_CENTERS[0] - np.float32(1.0)).astype(np.float32)
+        plan_owner = int(sp.owner_of(p.reshape(1, 3),
+                                     fleet.plan["grid"],
+                                     fleet.plan["code_ranges"])[0])
+        router_owner = len(fleet.urls) - 1 - plan_owner  # reversed
+        status, out = _post_path(router, "/v1/upsert", {
+            "ids": [new_id], "points": [p.tolist()]})
+        assert status == 200 and out["applied"] == 1, out
+        assert out["shards"][str(router_owner)]["applied"] == 1, out
+        fleet.cloud[new_id] = p
+        status, out = _post(router, {"queries": [p.tolist()], "k": K})
+        dist, ids = fleet.oracle(p.reshape(1, 3), K)
+        assert out["ids"] == ids and out["distances"] == dist
+        assert out["ids"][0][0] == new_id
+        # restore the module fleet's state
+        status, out = _post_path(router, "/v1/delete", {"ids": [new_id]})
+        assert status == 200 and out["applied"] == 1
+        del fleet.cloud[new_id]
+    finally:
+        router.stop()
+
+
+def test_spatial_hung_wave1_shard_still_answers_partial_200(
+        spatial_fleet):
+    """Review-pass pin: wave 1 gets at most HALF the budget when a
+    widening wave may follow — a hung nearest shard degrades the
+    answer to a flagged partial over the others instead of burning the
+    whole deadline and 503ing a request full fan-out would answer."""
+    from kdtree_tpu.serve import spatial as sp
+
+    fleet = spatial_fleet
+    center = SP_CENTERS[0]
+    owner = int(sp.owner_of(center.reshape(1, 3), fleet.plan["grid"],
+                            fleet.plan["code_ranges"])[0])
+    fleet.servers[owner].faults.set_spec("knn=hang")
+    try:
+        with spatial_router(fleet, deadline_s=3.0, retries=0) as router:
+            status, out = _post(router, {
+                "queries": [center.tolist()], "k": K})
+            assert status == 200, out
+            assert out["degraded"] == "partial:3/4", out
+            assert out["shards"]["contacted"] == SP_SHARDS
+            assert out["shards"]["missing"] == [owner]
+    finally:
+        fleet.servers[owner].faults.clear()
+        time.sleep(0.1)
+
+
+def test_idrange_routed_upsert_expands_cached_box(write_shards):
+    """Review-pass pin: the box contract is mode-independent — an
+    id-range routed upsert expands the owner set's cached box too, so
+    selective reads racing the next health probe can never prune the
+    shard that just acknowledged the write."""
+    _, urls = write_shards
+    with write_router(urls) as router:
+        sset = router.shard_sets[1]
+        far = np.asarray([500.0, 500.0, 500.0], np.float32)
+        box0 = sset.box()  # probed: the shard's own data box
+        assert box0 is not None and not bool((box0[1] >= far).all())
+        status, out = _post_path(router, "/v1/upsert", {
+            "ids": [1500], "points": [far.tolist()]})
+        assert status == 200, out
+        box = sset.box()
+        assert (box[0] <= far + 1e-6).all()
+        assert (box[1] >= far - 1e-6).all()
+        # clean up the write so sibling tests see the fixture state
+        _post_path(router, "/v1/delete", {"ids": [1500]})

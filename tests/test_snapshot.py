@@ -693,3 +693,118 @@ def test_snapshot_keep_widens_and_narrows(tree, tmp_path):
     assert snap.list_versions(d) == [5]
     segs = [f for f in os.listdir(d) if f.startswith("seg-")]
     assert len(segs) == 4
+
+
+# ---------------------------------------------------------------------------
+# pre-shipped plan profiles (ISSUE 15 satellite: PR 13's open half —
+# a snapshot carries the primary's settled launch plans, and adopters
+# seed their store from it BEFORE the warmup ladder)
+# ---------------------------------------------------------------------------
+
+
+def _settled_profile(tree, q=8):
+    """One settled plan-store profile under a real serve-bucket key for
+    ``tree`` — written into the CURRENT (conftest-isolated) store."""
+    import jax
+
+    from kdtree_tpu.tuning.store import default_store, make_signature
+
+    sig = make_signature(q, tree.dim, tree.n_real, K, tree.bucket_size,
+                         tree.num_buckets, devices=1,
+                         backend=jax.default_backend())
+    store = default_store()
+    assert store.put(sig, {"tile": 64, "cmax": 32, "seeds": 2})
+    return sig
+
+
+def test_manifest_carries_collected_plan_profiles(tree, tmp_path):
+    sig = _settled_profile(tree)
+    keys = snap.plan_keys_for(tree, k=K, max_batch=8)
+    assert sig.key in keys
+    profiles = snap.collect_plan_profiles(keys)
+    # only the key the local store has actually settled ships
+    assert set(profiles) == {sig.key}
+    assert profiles[sig.key]["tile"] == 64
+    man = snap.save_snapshot(str(tmp_path / "snapdir"), tree,
+                             plan_keys=keys, plan_profiles=profiles)
+    assert man["plan_profiles"][sig.key]["cmax"] == 32
+    # and it round-trips through the on-disk manifest
+    on_disk = snap.read_manifest(snap.resolve_dir(str(tmp_path /
+                                                      "snapdir")))
+    assert on_disk["plan_profiles"][sig.key]["seeds"] == 2
+
+
+def test_seed_plan_store_fills_misses_only(tree, tmp_path, monkeypatch):
+    from kdtree_tpu.tuning.store import PlanSignature, default_store
+
+    sig = _settled_profile(tree)
+    keys = snap.plan_keys_for(tree, k=K, max_batch=8)
+    man = snap.save_snapshot(
+        str(tmp_path / "s1"), tree, plan_keys=keys,
+        plan_profiles=snap.collect_plan_profiles(keys))
+    # a FRESH store (the adopting replica's): seeding fills the miss
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE",
+                       str(tmp_path / "replica-store"))
+    assert snap.seed_plan_store(man) == 1
+    got = default_store().get(
+        PlanSignature(**man["plan_profiles"][sig.key]["signature"]))
+    assert got is not None and got["tile"] == 64
+    # idempotent: the second seeding writes nothing (key now present)
+    assert snap.seed_plan_store(man) == 0
+    # local knowledge wins: a locally-settled different profile is NOT
+    # overwritten by a re-seed
+    store = default_store()
+    local_sig = PlanSignature(
+        **man["plan_profiles"][sig.key]["signature"])
+    store.put(local_sig, {"tile": 128, "cmax": 64, "seeds": 4})
+    assert snap.seed_plan_store(man) == 0
+    assert default_store().get(local_sig)["tile"] == 128
+
+
+def test_seed_plan_store_tolerates_malformed_payloads(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE",
+                       str(tmp_path / "store2"))
+    assert snap.seed_plan_store({}) == 0
+    assert snap.seed_plan_store({"plan_profiles": "nope"}) == 0
+    assert snap.seed_plan_store({"plan_profiles": {
+        "k1": "not-a-dict",
+        "k2": {"tile": 8},                      # no signature
+        "k3": {"signature": {"q_bucket": 8}},   # incomplete signature
+        # key does not name the profile it claims to: refused
+        "wrong-key": {"tile": 8, "cmax": 8, "seeds": 1,
+                      "signature": {
+                          "q_bucket": 8, "dim": 3, "n_bucket": 4096,
+                          "k": 4, "bucket_size": 256,
+                          "num_buckets": 16, "backend": "cpu",
+                          "devices": 1}},
+    }}) == 0
+
+
+def test_follower_adopt_seeds_plan_store(tree, points, tmp_path,
+                                         monkeypatch):
+    """The blue/green path: a follower's adopt seeds the pre-shipped
+    profiles before its pre-warm dispatches — the follow_swap flight
+    event carries the count."""
+    from kdtree_tpu.obs import flight
+    from kdtree_tpu.tuning.store import PlanSignature, default_store
+
+    sig = _settled_profile(tree)
+    d = str(tmp_path / "bg")
+    keys = snap.plan_keys_for(tree, k=K, max_batch=8)
+    snap.save_snapshot(d, tree, epoch=3, plan_keys=keys,
+                       plan_profiles=snap.collect_plan_profiles(keys))
+    # the replica process: fresh store, engine bootstrapped elsewhere
+    monkeypatch.setenv("KDTREE_TPU_PLAN_CACHE",
+                       str(tmp_path / "follower-store"))
+    state = lifecycle.build_state(points=np.asarray(points[:256]), k=K,
+                                  max_batch=8)
+    follower = SnapshotFollower(state.engine, d, start_version=0)
+    assert follower.poll_once() is True
+    assert state.engine.epoch == 3
+    got = default_store().get(PlanSignature(**snap.read_manifest(
+        snap.resolve_dir(d))["plan_profiles"][sig.key]["signature"]))
+    assert got is not None and got["tile"] == 64
+    swaps = [e for e in flight.recorder().snapshot()
+             if e.get("type") == "snapshot.follow_swap"]
+    assert swaps and swaps[-1]["plans_seeded"] == 1

@@ -1,0 +1,342 @@
+"""Spatial sharding + selective fan-out (ISSUE 15).
+
+Three layers, cheapest first:
+
+- **coder/partition units**: the numpy Morton coder is bit-identical
+  to the device coder (one grid, one cell assignment — the partition
+  and the router's write ownership cannot disagree), partitions cover
+  the cloud with tiling code ranges and tight boxes, and ``owner_of``
+  agrees with the partition's own assignment.
+- **selection units**: the widening policy's tie rule (lb == worst is
+  CONTACTED — an equal-distance lower-id candidate would displace the
+  incumbent), legacy no-box shards are never prunable, short-of-k
+  queries always force widening, and the recall-target stop honors
+  the guaranteed-fraction bound.
+- **the property test** (the ISSUE acceptance): over random seeds and
+  both clustered and uniform clouds, simulate the router's exact
+  two-wave algorithm against per-shard answers computed the way the
+  wire computes them (f32 d2, f64 sqrt) and assert the selective
+  merge is BYTE-IDENTICAL to the full fan-out merge — while
+  contacting measurably fewer shards on clustered clouds. The
+  recall-target mode's mean recall is asserted against its bound.
+
+The live-fleet HTTP end-to-end (epoch swaps, router writes,
+heterogeneous fleets) rides in tests/test_router.py next to the other
+fleet tests.
+"""
+
+import numpy as np
+import pytest
+
+from kdtree_tpu.serve import spatial as sp
+
+# ---------------------------------------------------------------------------
+# coder + partition
+# ---------------------------------------------------------------------------
+
+
+def _cloud(seed, n, dim, kind):
+    rng = np.random.default_rng(seed)
+    if kind == "uniform":
+        return (rng.random((n, dim)) * 200.0 - 100.0).astype(np.float32)
+    centers = (rng.random((4, dim)) * 160.0 - 80.0).astype(np.float32)
+    parts = [c + rng.normal(0.0, 3.0, (n // 4, dim)) for c in centers]
+    return np.concatenate(parts).astype(np.float32)
+
+
+def test_numpy_coder_bit_identical_to_device_coder():
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import default_bits, morton_codes
+
+    rng = np.random.default_rng(0)
+    for dim in (2, 3, 5):
+        pts = (rng.random((4096, dim)) * 200.0 - 100.0).astype(np.float32)
+        bits = default_bits(dim)
+        lo, hi = pts.min(axis=0), pts.max(axis=0)
+        device = np.asarray(
+            morton_codes(jnp.asarray(pts), bits, lo=jnp.asarray(lo),
+                         hi=jnp.asarray(hi))
+        )
+        host = sp.morton_codes_np(pts, sp.SpatialGrid(lo, hi, bits))
+        assert (device == host).all(), f"coder drift at dim {dim}"
+
+
+def test_plan_partition_covers_tiles_and_bounds():
+    pts = _cloud(1, 8000, 3, "clustered")
+    plan = sp.plan_partition(pts, 4)
+    bounds = plan["bounds"]
+    # contiguous cover of all morton ranks
+    assert bounds[0][0] == 0 and bounds[-1][1] == pts.shape[0]
+    for (_, e0), (s1, _) in zip(bounds, bounds[1:]):
+        assert e0 == s1
+    # code ranges tile the whole code space half-open
+    ranges = plan["code_ranges"]
+    assert ranges[0][0] == 0
+    assert ranges[-1][1] == sp.code_space(3, plan["grid"].bits)
+    for (_, h0), (l1, _) in zip(ranges, ranges[1:]):
+        assert h0 == l1
+    # per-shard boxes contain exactly their points
+    order = plan["order"]
+    for (s, e), (blo, bhi) in zip(bounds, plan["boxes"]):
+        sub = pts[order[s:e]]
+        assert (sub >= blo - 1e-6).all() and (sub <= bhi + 1e-6).all()
+
+
+def test_owner_of_agrees_with_partition_assignment():
+    for kind in ("uniform", "clustered"):
+        pts = _cloud(2, 4000, 3, kind)
+        plan = sp.plan_partition(pts, 5)
+        owners = sp.owner_of(pts, plan["grid"], plan["code_ranges"])
+        for i, (s, e) in enumerate(plan["bounds"]):
+            assert (owners[plan["order"][s:e]] == i).all()
+    # a far-outside point clamps into some cell: exactly one owner
+    far = np.array([[1e6, 1e6, 1e6]], dtype=np.float32)
+    assert sp.owner_of(far, plan["grid"], plan["code_ranges"])[0] >= 0
+    # non-finite rows clamp to the top cell (the device coder's
+    # sort-to-the-end convention): the LAST shard owns them — shard
+    # validation rejects the points themselves, ownership stays total
+    nan = np.array([[np.nan, 0, 0]], dtype=np.float32)
+    last = len(plan["code_ranges"]) - 1
+    assert sp.owner_of(nan, plan["grid"], plan["code_ranges"])[0] == last
+
+
+def test_plan_partition_never_splits_a_code_and_rejects_collapse():
+    # 3000 copies of ONE point: a single code value cannot be split, so
+    # any multi-shard cut must fail crisply instead of minting a shard
+    # with an empty (unownable) region
+    pts = np.ones((3000, 3), dtype=np.float32)
+    with pytest.raises(ValueError, match="shard"):
+        sp.plan_partition(pts, 2)
+    # two distinct values support exactly 2 shards, cut on the boundary
+    pts = np.concatenate([np.zeros((100, 3)), np.ones((5, 3))]).astype(
+        np.float32)
+    plan = sp.plan_partition(pts, 2)
+    assert plan["bounds"] == [(0, 100), (100, 105)]
+
+
+def test_grid_json_roundtrip_and_malformed():
+    grid = sp.SpatialGrid([-1.0, 0.0], [2.0, 3.0], 8)
+    back = sp.SpatialGrid.from_json(grid.to_json())
+    assert back is not None and back.bits == 8
+    assert (back.lo == grid.lo).all() and (back.hi == grid.hi).all()
+    for bad in (None, 42, {}, {"lo": [0], "hi": "x", "bits": 8},
+                {"lo": [], "hi": [], "bits": 8},
+                {"lo": [0.0], "hi": [1.0], "bits": "wide"}):
+        assert sp.SpatialGrid.from_json(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# bounds + selection units
+# ---------------------------------------------------------------------------
+
+
+def test_box_lower_bound_is_a_true_lower_bound():
+    rng = np.random.default_rng(3)
+    pts = _cloud(3, 500, 3, "uniform")
+    lo, hi = pts.min(axis=0), pts.max(axis=0)
+    inside = (rng.random((20, 3)) * (hi - lo) + lo).astype(np.float32)
+    assert (sp.box_lower_bounds(inside, lo, hi) == 0.0).all()
+    queries = (rng.random((50, 3)) * 600.0 - 300.0).astype(np.float32)
+    lb = sp.box_lower_bounds(queries, lo, hi).astype(np.float64)
+    d2 = ((queries[:, None, :].astype(np.float64)
+           - pts[None, :, :].astype(np.float64)) ** 2).sum(-1)
+    assert (lb[:, None] <= d2 + 1e-6).all()
+
+
+def test_box_union():
+    a = (np.array([0.0, 0.0], np.float32), np.array([1.0, 1.0], np.float32))
+    b = (np.array([-1.0, 0.5], np.float32), np.array([0.5, 2.0], np.float32))
+    lo, hi = sp.box_union([a, None, b])
+    assert lo.tolist() == [-1.0, 0.0] and hi.tolist() == [1.0, 2.0]
+    assert sp.box_union([None, None]) is None
+
+
+def test_initial_wave_legacy_containing_nearest():
+    z = np.zeros(2, dtype=np.float64)
+    # legacy (None) always contacted; containing (min lb 0) contacted
+    assert sp.initial_wave([None, z + 1.0, z]) == [0, 2]
+    # nothing contains: the single nearest by min lb joins the legacy
+    assert sp.initial_wave([None, z + 5.0, z + 1.0]) == [0, 2]
+    # all boxed, none containing: exactly the nearest
+    assert sp.initial_wave([z + 5.0, z + 1.0, z + 3.0]) == [1]
+    assert sp.initial_wave([]) == []
+
+
+def test_widen_wave_exact_strict_tie_and_short_rules():
+    worst = np.array([2.0, np.inf])
+    short = np.array([False, True])
+    # shard 1: lb exactly == worst for q0 -> the TIE must be contacted
+    # (an equal-distance lower-id candidate would displace the
+    # incumbent in the (distance, id) merge)
+    lbs = [None, np.array([2.0, 9.0]), np.array([2.1, 9.0])]
+    wave, cut = sp.widen_wave(lbs, [1, 2], worst, short)
+    # q1 is short of k -> EVERY remaining shard is needed regardless
+    assert wave == [1, 2] and cut == 0
+    # with q1 satisfied, the strictly-beyond shard 2 is pruned
+    worst = np.array([2.0, 1.0])
+    short = np.array([False, False])
+    wave, cut = sp.widen_wave(lbs, [1, 2], worst, short)
+    assert wave == [1] and cut == 0
+    # nothing needed at all
+    wave, cut = sp.widen_wave(
+        [None, np.array([3.0, 2.0])], [1], worst, short)
+    assert wave == [] and cut == 0
+
+
+def test_widen_wave_recall_target_fraction_stop():
+    # 4 queries; only q3 needs shard 1 (lb below worst)
+    worst = np.array([1.0, 1.0, 1.0, 1.0])
+    short = np.zeros(4, dtype=bool)
+    lbs = [None, np.array([5.0, 5.0, 5.0, 0.5])]
+    # exact: widen
+    wave, cut = sp.widen_wave(lbs, [1], worst, short, None)
+    assert wave == [1] and cut == 0
+    # t=0.7 allows floor(0.3*4)=1 unguaranteed query: stop, report it
+    wave, cut = sp.widen_wave(lbs, [1], worst, short, 0.7)
+    assert wave == [] and cut == 1
+    # t=0.9 allows none: must widen (and then nothing is unguaranteed)
+    wave, cut = sp.widen_wave(lbs, [1], worst, short, 0.9)
+    assert wave == [1] and cut == 0
+    # a short-of-k query overrides the target: padding is correctness
+    short = np.array([False, False, False, True])
+    worst2 = np.array([1.0, 1.0, 1.0, np.inf])
+    wave, cut = sp.widen_wave(lbs, [1], worst2, short, 0.7)
+    assert wave == [1] and cut == 0
+
+
+# ---------------------------------------------------------------------------
+# the property test: the router's algorithm, simulated host-side
+# ---------------------------------------------------------------------------
+
+
+def _shard_topk(shard_pts, shard_ids, queries, k):
+    """One shard's wire answer: exact top-k by (distance, id), with the
+    wire's arithmetic (f32 squared distances, f64 sqrt) and padding
+    ((inf, -1) beyond the shard's point count)."""
+    q = queries.astype(np.float32)
+    d2 = ((q[:, None, :] - shard_pts[None, :, :]) ** 2).sum(
+        axis=-1, dtype=np.float32)
+    dist = np.sqrt(d2.astype(np.float64))
+    nq = q.shape[0]
+    out_d = np.full((nq, k), np.inf)
+    out_i = np.full((nq, k), -1, dtype=np.int64)
+    for qi in range(nq):
+        pairs = sorted(zip(dist[qi].tolist(), shard_ids.tolist()))[:k]
+        for j, (d, i) in enumerate(pairs):
+            out_d[qi, j] = d
+            out_i[qi, j] = i
+    return out_d, out_i
+
+
+def _merge(answers, k):
+    """The router's (distance, id) merge over a contact set."""
+    d = np.concatenate([a[0] for a in answers], axis=1)
+    ids = np.concatenate([a[1] for a in answers], axis=1)
+    nq = d.shape[0]
+    out_d = np.full((nq, k), np.inf)
+    out_i = np.full((nq, k), -1, dtype=np.int64)
+    for qi in range(nq):
+        pairs = sorted(
+            (float(dd), int(ii))
+            for dd, ii in zip(d[qi], ids[qi]) if ii >= 0
+        )[:k]
+        for j, (dd, ii) in enumerate(pairs):
+            out_d[qi, j] = dd
+            out_i[qi, j] = ii
+    return out_d, out_i
+
+
+def _simulate_selective(pts, queries, k, shards, target=None):
+    """The router's two-wave algorithm, verbatim: wave 1 from
+    initial_wave, running worsts from the wave-1 merge, wave 2 from
+    widen_wave. Returns (merged answer, contacted count, spatial_cut,
+    full-fan-out answer)."""
+    plan = sp.plan_partition(pts, shards)
+    order = plan["order"]
+    shard_answers = []
+    for (s, e) in plan["bounds"]:
+        shard_answers.append(_shard_topk(
+            pts[order[s:e]], np.arange(s, e), queries, k))
+    lbs = [
+        np.sqrt(sp.box_lower_bounds(queries, blo, bhi)
+                .astype(np.float64))
+        for blo, bhi in plan["boxes"]
+    ]
+    wave1 = sp.initial_wave(lbs)
+    contacted = sorted(wave1)
+    remaining = [i for i in range(shards) if i not in set(wave1)]
+    cut = 0
+    if remaining:
+        md, mi = _merge([shard_answers[i] for i in contacted], k)
+        worst = md[:, k - 1]
+        short = mi[:, k - 1] < 0
+        worst = np.where(short, np.inf, worst)
+        wave2, cut = sp.widen_wave(lbs, remaining, worst, short, target)
+        contacted = sorted(set(contacted) | set(wave2))
+    merged = _merge([shard_answers[i] for i in contacted], k)
+    full = _merge(shard_answers, k)
+    return merged, len(contacted), cut, full
+
+
+@pytest.mark.parametrize("kind", ["clustered", "uniform"])
+def test_selective_merge_byte_identical_over_random_seeds(kind):
+    """The acceptance property: on spatially-partitioned fleets (>= 4
+    shards) over random seeds, the selective contact set's merge is
+    BYTE-identical (distances and ids) to the full fan-out's — the
+    lb-ordered widening never drops a top-k member, ties included."""
+    near_contacts = 0
+    near_requests = 0
+    shards = 4
+    for seed in range(6):
+        pts = _cloud(100 + seed, 2000, 3, kind)
+        rng = np.random.default_rng(1000 + seed)
+        # the serving unit is the REQUEST: single-row queries near
+        # individual cloud points (the selectivity case) plus one
+        # spread batch (which may legitimately touch every region)
+        sel = rng.integers(0, pts.shape[0], size=4)
+        requests = [
+            (pts[s] + rng.normal(0, 0.5, 3)).astype(np.float32)
+            .reshape(1, 3)
+            for s in sel
+        ]
+        requests.append(
+            (rng.random((4, 3)) * 300.0 - 150.0).astype(np.float32))
+        for qi, queries in enumerate(requests):
+            (md, mi), m, cut, (fd, fi) = _simulate_selective(
+                pts, queries, 8, shards)
+            assert cut == 0
+            np.testing.assert_array_equal(mi, fi)
+            np.testing.assert_array_equal(md, fd)
+            if qi < 4:
+                near_contacts += m
+                near_requests += 1
+    if kind == "clustered":
+        # the selectivity acceptance shape: on clustered clouds, mean
+        # shards contacted per single-point query <= 50% of the count
+        assert near_contacts / near_requests <= 0.5 * shards
+
+
+def test_recall_target_stop_honors_the_fraction_bound():
+    """Approx mode: stopping at guaranteed-fraction >= t bounds the
+    batch's mean recall@k below by t (guaranteed queries recall 1)."""
+    for seed in range(4):
+        pts = _cloud(200 + seed, 2000, 3, "clustered")
+        rng = np.random.default_rng(seed)
+        queries = (rng.random((10, 3)) * 250.0 - 125.0).astype(np.float32)
+        t = 0.8
+        (md, mi), m_sel, cut, (fd, fi) = _simulate_selective(
+            pts, queries, 8, 4, target=t)
+        _, m_exact, _, _ = _simulate_selective(pts, queries, 8, 4)
+        assert m_sel <= m_exact
+        recalls = []
+        for qi in range(queries.shape[0]):
+            truth = set(int(x) for x in fi[qi] if x >= 0)
+            found = set(int(x) for x in mi[qi] if x >= 0)
+            recalls.append(len(truth & found) / max(len(truth), 1))
+        assert float(np.mean(recalls)) >= t - 1e-9
+
+
+def test_partition_rejects_too_many_shards():
+    with pytest.raises(ValueError):
+        sp.plan_partition(np.zeros((3, 3), dtype=np.float32), 4)

@@ -465,3 +465,79 @@ def test_capacity_knee_not_compared_across_changed_gear_mix(tmp_path):
     ]
     findings3, _ = tr.analyze(runs3, band=0.3)
     assert [f["rule"] for f in findings3] == ["capacity-drop"]
+
+
+# ---------------------------------------------------------------------------
+# fanout-growth (ISSUE 15 satellite: a regression back toward full
+# scatter fails CI like a throughput cliff)
+# ---------------------------------------------------------------------------
+
+
+def _fanout_report(knee, fanout):
+    rep = _loadgen_report(knee)
+    rep["capacity"]["fanout_frac"] = fanout
+    return rep
+
+
+def test_fanout_growth_flagged_and_grandfatherable(tmp_path):
+    paths = _runs_raw(tmp_path, [
+        ("a.json", _fanout_report(100.0, 0.3)),
+        ("b.json", _fanout_report(100.0, 0.9)),
+    ])
+    findings, band = tr.analyze([tr.load_run(p) for p in paths])
+    assert [f["rule"] for f in findings] == ["fanout-growth"]
+    assert findings[0]["metric"] == "capacity:fanout"
+    assert "full scatter" in findings[0]["detail"]
+    # grandfather mechanics work exactly like every other rule
+    base = tmp_path / "base.json"
+    tr.save_baseline(str(base), findings)
+    assert tr.partition(findings, tr.load_baseline(str(base))) \
+        == []
+
+
+def test_fanout_within_band_or_absent_is_clean(tmp_path):
+    # shrinking fan-out (the improvement direction) is never a finding
+    paths = _runs_raw(tmp_path, [
+        ("a.json", _fanout_report(100.0, 0.9)),
+        ("b.json", _fanout_report(100.0, 0.3)),
+    ])
+    findings, _ = tr.analyze([tr.load_run(p) for p in paths])
+    assert findings == []
+    # inside the absolute band: clean
+    paths = _runs_raw(tmp_path, [
+        ("c.json", _fanout_report(100.0, 0.30)),
+        ("d.json", _fanout_report(100.0, 0.40)),
+    ])
+    findings, _ = tr.analyze([tr.load_run(p) for p in paths])
+    assert findings == []
+    # pre-fanout artifacts (no key) are not comparable: clean
+    paths = _runs_raw(tmp_path, [
+        ("e.json", _loadgen_report(100.0)),
+        ("f.json", _fanout_report(100.0, 0.9)),
+    ])
+    findings, _ = tr.analyze([tr.load_run(p) for p in paths])
+    assert findings == []
+
+
+def _runs_raw(tmp_path, named):
+    paths = []
+    for name, obj in named:
+        p = tmp_path / name
+        p.write_text(json.dumps(obj))
+        paths.append(str(p))
+    return paths
+
+
+def test_fanout_not_reset_by_interposed_fanoutless_capacity_run(tmp_path):
+    """Review-pass pin: a plain-shard loadgen artifact (capacity block,
+    no fan-out) between two router runs must neither be compared nor
+    reset the fan-out baseline — the growth cursor tracks the previous
+    FANOUT-bearing run, like recall's."""
+    paths = _runs_raw(tmp_path, [
+        ("a.json", _fanout_report(100.0, 0.4)),
+        ("b.json", _loadgen_report(100.0)),      # no fanout_frac
+        ("c.json", _fanout_report(100.0, 1.0)),
+    ])
+    findings, _ = tr.analyze([tr.load_run(p) for p in paths])
+    assert [f["rule"] for f in findings] == ["fanout-growth"]
+    assert findings[0]["from"] == "a"
