@@ -102,6 +102,19 @@ R_CLIENT_TIMEOUT = register(Rule(
     "machinery all sits downstream of the socket actually timing out",
 ))
 
+R_TRACE_CTX = register(Rule(
+    "KDT110", "outbound-call-without-trace-context", CORRECTNESS,
+    "serve-layer outbound POSTs (conn.request('POST', ...)) must carry "
+    "the X-Trace-Context header in their literal headers dict — every "
+    "router->shard hop that drops it orphans the shard's spans from "
+    "the assembled waterfall",
+    "distributed tracing (PR 16) joins router and shard spans by the "
+    "propagated context; the hedge and write paths each open their own "
+    "connections, and one call site minted WITHOUT the header produced "
+    "waterfalls whose shard time silently read as an unaccounted gap — "
+    "exactly the hole the assembler exists to flag",
+))
+
 R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
@@ -587,6 +600,61 @@ def check_client_without_timeout(ctx) -> Iterator[Finding]:
             "block-forever default; one unreachable peer then wedges this "
             "thread (and anything joining it) — pass timeout=",
         )
+
+
+# --------------------------------------------------------------------------
+# KDT110 — outbound-call-without-trace-context
+# --------------------------------------------------------------------------
+
+# the header key the serve layer propagates trace context under — pinned
+# to obs/trace.py TRACE_HEADER by a test, so the lint rule and the wire
+# contract cannot drift
+_TRACE_CONTEXT_HEADER = "X-Trace-Context"
+
+
+@checker(R_TRACE_CTX)
+def check_outbound_without_trace_context(ctx) -> Iterator[Finding]:
+    # serve-layer files only: the router/server/write fan-out is where
+    # a dropped header orphans a waterfall; loadgen and test clients
+    # POST too, but they are trace ROOTS, not propagation hops
+    if "serve" not in ctx.relpath.split("/"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node).split(".")[-1] != "request":
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or node.args[0].value != "POST":
+            continue  # GETs (health probes, trace fetches) are exempt
+        if any(isinstance(a, ast.Starred) for a in node.args) or \
+                any(kw.arg is None for kw in node.keywords):
+            continue  # *args/**kwargs may carry it; syntactic rule stays quiet
+        headers = next((kw.value for kw in node.keywords
+                        if kw.arg == "headers"), None)
+        if headers is None:
+            yield _mk(
+                R_TRACE_CTX, ctx, node,
+                "outbound POST without headers= cannot propagate "
+                f"{_TRACE_CONTEXT_HEADER}; the downstream process's "
+                "spans fall out of the assembled trace — forward "
+                "trace.outbound_header(ctx)",
+            )
+            continue
+        if not isinstance(headers, ast.Dict):
+            continue  # built elsewhere; the literal-dict rule stays quiet
+        if any(k is None for k in headers.keys):
+            continue  # a {**base} spread may carry it
+        keys = {k.value for k in headers.keys
+                if isinstance(k, ast.Constant)}
+        if _TRACE_CONTEXT_HEADER not in keys:
+            yield _mk(
+                R_TRACE_CTX, ctx, node,
+                f"outbound POST headers lack {_TRACE_CONTEXT_HEADER!r}: "
+                "this hop drops the trace context and orphans every "
+                "downstream span from the waterfall — add the header "
+                "(trace.outbound_header(ctx); empty value = untraced)",
+            )
 
 
 # --------------------------------------------------------------------------

@@ -241,7 +241,7 @@ class _StepAcc:
     the lock guards list/int updates only, never I/O)."""
 
     __slots__ = ("rate", "intended", "sent", "latencies_ms",
-                 "send_lag_ms", "counts", "gears", "fanout")
+                 "send_lag_ms", "counts", "gears", "fanout", "slowest")
 
     def __init__(self, rate: float) -> None:
         self.rate = float(rate)
@@ -263,6 +263,11 @@ class _StepAcc:
         # target) — the selective fan-out evidence (docs/SERVING.md
         # "Spatial sharding & selective fan-out")
         self.fanout: List[float] = []
+        # (latency_ms, request id) of the step's slowest exchange: the
+        # id doubles as the TRACE id server-side, so the capacity block
+        # names the exact trace to pull a waterfall for (kdtree-tpu
+        # trace --id <it> --target <router>)
+        self.slowest: Optional[Tuple[float, str]] = None
 
 
 def _classify(op: str, status: int, body: Optional[dict]) -> List[str]:
@@ -485,11 +490,16 @@ def run_load(
     def record(arrival, intended: float, tags: List[str],
                done: float, actual_send: float,
                gear: Optional[str] = None,
-               fanout: Optional[float] = None) -> None:
+               fanout: Optional[float] = None,
+               req_id: str = "") -> None:
         acc = accs[arrival.step]
+        lat_ms = (done - intended) * 1e3
         with lock:
             acc.sent += 1
-            acc.latencies_ms.append((done - intended) * 1e3)
+            if req_id and (acc.slowest is None
+                           or lat_ms > acc.slowest[0]):
+                acc.slowest = (lat_ms, req_id)
+            acc.latencies_ms.append(lat_ms)
             acc.send_lag_ms.append(
                 max(actual_send - intended, 0.0) * 1e3)
             for tag in tags:
@@ -532,7 +542,7 @@ def run_load(
         except (http.client.HTTPException, OSError):
             tags = ["errors"]
         record(arrival, intended, tags, time.monotonic(), actual_send,
-               gear, fanout)
+               gear, fanout, req_id=headers["X-Request-Id"])
 
     def worker() -> None:
         conn = _WorkerConn(target, timeout_s)
@@ -610,6 +620,13 @@ def run_load(
             # fanout-growth rule watches
             "fanout_frac": (round(float(np.mean(acc.fanout)), 4)
                             if acc.fanout else None),
+            # the step's slowest exchange by request id — the id IS the
+            # server-side trace id, so this names the waterfall to pull
+            # (kdtree-tpu trace --id <it>) for the step's worst tail
+            "slowest_trace_id": (acc.slowest[1] if acc.slowest
+                                 else None),
+            "slowest_ms": (round(acc.slowest[0], 3) if acc.slowest
+                           else None),
         }
         steps.append(row)
     knee = compute_knee(steps, slo_ms=slo_ms, slo_quantile=slo_quantile,

@@ -240,6 +240,11 @@ METRIC_HELP = {
         "1 when the shard's /metrics scrape succeeded in the last "
         "federated exposition",
     "kdtree_router_replicas": "replicas per shard set",
+    "kdtree_router_clock_skew_ms":
+        "estimated shard wall-clock offset vs this router (RTT-midpoint "
+        "from the health probe; +ve = shard clock ahead)",
+    "kdtree_trace_promoted_total":
+        "traces tail-promoted to pinned retention, by reason",
     "kdtree_router_replica_requests_total":
         "attempts dispatched per replica (shard x replica) — the "
         "read-spread evidence for replica sets",
@@ -447,6 +452,47 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def openmetrics_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """OpenMetrics-flavored exposition (``GET /metrics?openmetrics=1``):
+    the same families as :func:`prometheus_text` plus per-bucket
+    exemplars — the last trace id a serving histogram observed into
+    each bucket (``# {trace_id="..."} value timestamp``) — and the
+    ``# EOF`` terminator the format requires. A SEPARATE rendering on
+    purpose: the default text exposition stays byte-identical (existing
+    scrapes and the router's federation parser are pinned to it), and
+    exemplars appear only where a call site actually passed one."""
+    reg = registry or get_registry()
+    lines = []
+    seen_family = set()
+    for name, kind, items, inst in reg.collect():
+        if name not in seen_family:
+            help_text = METRIC_HELP.get(name)
+            if help_text:
+                escaped = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {name} {escaped}")
+            lines.append(f"# TYPE {name} {kind}")
+            seen_family.add(name)
+        if kind in ("counter", "gauge"):
+            lines.append(f"{_prom_key(name, items)} {inst.value:g}")
+            continue
+        snap = inst.snapshot()
+        exemplars = inst.exemplars()
+        base = dict(items)
+        for upper, cum in snap["buckets"].items():
+            le_items = tuple(sorted({**base, "le": upper}.items()))
+            line = f"{_prom_key(name + '_bucket', le_items)} {cum}"
+            ex = exemplars.get(upper)
+            if ex is not None:
+                label, value, ts = ex
+                line += (f' # {{trace_id="{_escape_label_value(label)}"}} '
+                         f"{value:g} {ts:.3f}")
+            lines.append(line)
+        lines.append(f"{_prom_key(name + '_sum', items)} {snap['sum']:g}")
+        lines.append(f"{_prom_key(name + '_count', items)} {snap['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
 def _capacity_lines(cap: Dict) -> list:
     """Human rendering of a loadgen ``capacity`` block (shared by
     ``stats`` and ``stats --diff`` so the two views cannot drift)."""
@@ -480,6 +526,18 @@ def _capacity_lines(cap: Dict) -> list:
     if fanout is not None:
         out.append(f"fan-out fraction:    {fanout:.1%} of shards "
                    "contacted per routed query (selective fan-out)")
+    # the run's worst exchange, by trace id: the id a waterfall pull
+    # (kdtree-tpu trace --id <it> --target <router>) starts from
+    worst = None
+    for s in steps:
+        if s.get("slowest_trace_id") and s.get("slowest_ms") is not None:
+            if worst is None or s["slowest_ms"] > worst[0]:
+                worst = (s["slowest_ms"], s["slowest_trace_id"],
+                         s.get("rate"))
+    if worst is not None:
+        out.append(f"slowest trace:       {worst[1]} "
+                   f"({worst[0]:g} ms at {worst[2]:g} req/s) — "
+                   "kdtree-tpu trace --id <it> renders the waterfall")
     server = cap.get("server")
     if server:
         for op, stats in (server.get("write_latency_ms") or {}).items():

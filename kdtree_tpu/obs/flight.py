@@ -251,9 +251,12 @@ def record(kind: str, **fields) -> None:
 
 def _dump_history_companion(reason: str) -> None:
     """Every incident that earned a flight dump gets the metric-history
-    ring dumped alongside it (``history-<reason>.json``): the flight
-    ring says what happened in order, the history ring says how the
-    totals were trending into it. Piggybacks the flight rate limit —
+    ring dumped alongside it (``history-<reason>.json``) AND the pinned
+    distributed traces (``trace-<reason>.json``, obs/trace.py): the
+    flight ring says what happened in order, the history ring says how
+    the totals were trending into it, and the trace companion says
+    where each retained slow/partial/hedged request's time went —
+    causally, across processes. Piggybacks the flight rate limit —
     this only runs when a flight file was claimed."""
     try:
         from kdtree_tpu.obs import history
@@ -261,6 +264,36 @@ def _dump_history_companion(reason: str) -> None:
         history.auto_dump(reason)
     except Exception:
         pass
+    try:
+        from kdtree_tpu.obs import trace
+
+        trace.auto_dump(reason)
+    except Exception:
+        pass
+
+
+def filter_events(events: List[dict], trace: Optional[str] = None,
+                  reason: Optional[str] = None) -> List[dict]:
+    """Server-side ring filters (``GET /debug/flight?trace=<id>`` /
+    ``?reason=<r>``): the rings already carry trace ids on admissions,
+    batches, sheds and span completions — filtering HERE spares clients
+    fetching and grepping 1024 events, which was the debugging hot
+    path. ``trace`` matches an event's ``trace``/``trace_id`` field or
+    membership in a batch event's ``traces`` list; ``reason`` matches
+    ``reason``/``degraded`` (the two fields incident events name their
+    cause in). Both given = both must match."""
+    out = []
+    for e in events:
+        if trace is not None:
+            et = e.get("trace") or e.get("trace_id")
+            if et != trace and trace not in (e.get("traces") or ()):
+                continue
+        if reason is not None:
+            if str(e.get("reason", "")) != reason and \
+                    str(e.get("degraded", "")) != reason:
+                continue
+        out.append(e)
+    return out
 
 
 def _write_dump(path: str, reason: str) -> None:

@@ -102,7 +102,8 @@ class Histogram:
     inputs."""
 
     kind = "histogram"
-    __slots__ = ("_lock", "uppers", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "uppers", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, buckets: Sequence[float]) -> None:
         if not buckets:
@@ -112,13 +113,24 @@ class Histogram:
         self._counts = [0] * (len(self.uppers) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
+        # last exemplar per bucket index: {i: (label_value, value, unix)}.
+        # Populated only when a call site passes exemplar= (serving
+        # paths pass the trace id) — observe() without one costs nothing
+        # extra, and the default text exposition never renders these
+        # (only GET /metrics?openmetrics=1 does, obs/export.py).
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.uppers, value)
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar:
+                import time
+
+                self._exemplars[i] = (str(exemplar)[:128], float(value),
+                                      time.time())
 
     def observe_array(self, values) -> None:
         import numpy as np
@@ -153,6 +165,16 @@ class Histogram:
             cumulative[repr(upper)] = running
         cumulative["+Inf"] = total
         return {"count": total, "sum": s, "buckets": cumulative}
+
+    def exemplars(self) -> Dict[str, Tuple[str, float, float]]:
+        """Last recorded exemplar per bucket, keyed like ``snapshot``'s
+        buckets (``repr(upper)`` / ``"+Inf"``): ``(label_value, observed
+        value, unix timestamp)``. Empty for call sites that never pass
+        ``exemplar=``."""
+        with self._lock:
+            ex = dict(self._exemplars)
+        keys = [repr(u) for u in self.uppers] + ["+Inf"]
+        return {keys[i]: v for i, v in ex.items() if i < len(keys)}
 
 
 class MetricsRegistry:

@@ -62,7 +62,10 @@ class Span(list):
 
     It IS a list: append (or ``+=``) device outputs to have them
     hard-synced before the span's clock stops. ``duration`` is set on
-    exit; ``path`` is the slash-joined nesting path.
+    exit; ``path`` is the slash-joined nesting path. When a distributed
+    trace context is active on this thread (``obs/trace.py``),
+    ``span_id``/``parent_id`` causally link the completion into the
+    trace buffer and the flight ring.
     """
 
     def __init__(self, name: str, path: str) -> None:
@@ -70,6 +73,8 @@ class Span(list):
         self.name = name
         self.path = path
         self.duration: Optional[float] = None
+        self.span_id: Optional[str] = None
+        self.parent_id: Optional[str] = None
 
 
 def _stack() -> list:
@@ -93,10 +98,21 @@ def span(
     caller syncs later) — the duration then covers dispatch, not
     execution, and the span records ``synced: false`` in the event log.
     """
+    from kdtree_tpu.obs import trace as trace_mod
+
     reg = registry or get_registry()
     stack = _stack()
     path = "/".join([s.name for s in stack] + [name])
     sp = Span(name, path)
+    # distributed-trace linkage (obs/trace.py): under an active request
+    # context, this span becomes a causally-linked node — parented to
+    # the innermost open span on this thread, or to the propagated
+    # context's span (the upstream hop) at the top of the stack
+    tctx = trace_mod.current() if trace_mod.enabled() else None
+    if tctx is not None:
+        sp.span_id = trace_mod.new_span_id()
+        sp.parent_id = (stack[-1].span_id if stack and stack[-1].span_id
+                        else tctx.span_id)
     stack.append(sp)
     t0 = time.perf_counter()
     try:
@@ -129,9 +145,21 @@ def span(
         })
         # span completions also land in the always-on flight recorder
         # (bounded ring, ~µs): an incident dump then carries the last N
-        # seconds of where time went, not just counter totals
+        # seconds of where time went, not just counter totals. Under an
+        # active trace context they ALSO land in the trace buffer, with
+        # ids — the causal linkage the flight ring's flat timeline
+        # cannot carry.
+        link = {}
+        if tctx is not None and sp.span_id is not None:
+            link = {"trace_id": tctx.trace_id, "span_id": sp.span_id,
+                    "parent_id": sp.parent_id}
+            end_unix = time.time()
+            trace_mod.record_span(
+                tctx.trace_id, sp.span_id, sp.parent_id or "", path,
+                end_unix - sp.duration, end_unix, **attrs,
+            )
         flight.record("span", span=path, seconds=sp.duration,
-                      synced=bool(sync), **attrs)
+                      synced=bool(sync), **link, **attrs)
 
 
 def current_span() -> Optional[Span]:

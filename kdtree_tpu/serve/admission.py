@@ -58,7 +58,7 @@ class PendingRequest:
     __slots__ = (
         "queries", "k", "deadline", "enqueued_at", "dispatched_at",
         "event", "d2", "ids", "degraded", "error", "trace_id",
-        "recall_target", "gear",
+        "recall_target", "gear", "trace_ctx",
     )
 
     def __init__(
@@ -66,6 +66,7 @@ class PendingRequest:
         deadline: Optional[float] = None,
         trace_id: str = "",
         recall_target: Optional[float] = None,
+        trace_ctx=None,
     ) -> None:
         self.queries = queries  # f32[q, D], validated by the handler
         self.k = k
@@ -80,6 +81,11 @@ class PendingRequest:
         # queue/coalesce/device decomposition can be pulled from the
         # flight ring by id
         self.trace_id = trace_id
+        # the distributed-trace context (obs/trace.py TraceContext, or
+        # None untraced): span_id is the handler's server-root span the
+        # batch worker parents its queue/dispatch spans under — how a
+        # cross-thread phase stays causally linked to its request
+        self.trace_ctx = trace_ctx
         self.enqueued_at = time.monotonic()
         self.dispatched_at: Optional[float] = None
         self.event = threading.Event()

@@ -46,6 +46,7 @@ import numpy as np
 
 from kdtree_tpu import obs
 from kdtree_tpu.obs import flight
+from kdtree_tpu.obs import trace as trace_mod
 from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
 from kdtree_tpu.serve.faults import SITE_BATCH
 from kdtree_tpu.tuning.store import _pow2_ceil
@@ -262,12 +263,20 @@ class MicroBatcher:
         req_t = live[0].recall_target
         asked = [t for t in (ladder_t, req_t) if t is not None]
         effective = min(asked) if asked else None
+        # distributed tracing: the batch's device work runs under the
+        # COALESCING LEADER's trace context (a batch serves many traces;
+        # engine-internal obs.spans — tile dispatch, mutable overlay
+        # merge — can only parent under one). The leader's dispatch span
+        # id is minted up front so those engine spans nest beneath it.
+        lead = next((r for r in live if r.trace_ctx is not None), None)
+        dispatch_ctx = lead.trace_ctx.child() if lead is not None else None
         try:
-            if effective is None:
-                d2, ids, source = self.engine.knn_batch(q)
-            else:
-                d2, ids, source = self.engine.knn_batch(
-                    q, recall_target=effective)
+            with trace_mod.active(dispatch_ctx):
+                if effective is None:
+                    d2, ids, source = self.engine.knn_batch(q)
+                else:
+                    d2, ids, source = self.engine.knn_batch(
+                        q, recall_target=effective)
         except Exception as e:
             self._errors.inc()
             flight.record("serve.batch_error", rows=rows,
@@ -322,14 +331,37 @@ class MicroBatcher:
             epoch=getattr(self.engine, "last_answer_epoch", 0),
             traces=[r.trace_id for r in live],
         )
+        done_unix = time.time()
         off = 0
         for r in live:
-            r.fulfill(d2[off:off + r.rows, :r.k],
-                      ids[off:off + r.rows, :r.k],
-                      degraded=forced, gear=gear)
-            off += r.rows
             self._lat["dispatch"].observe(done - r.dispatched_at)
-            self._lat["total"].observe(done - r.enqueued_at)
+            self._lat["total"].observe(done - r.enqueued_at,
+                                       exemplar=r.trace_id)
+            if r.trace_ctx is not None:
+                # causally-linked phase spans, parented under the
+                # handler's server-root span: queue (admit → dispatch,
+                # i.e. admission wait + coalesce window) and dispatch
+                # (dispatch → device done). Monotonic deltas anchored
+                # to one wall-clock read, so cross-process assembly
+                # can order them against the router's spans.
+                ctx = r.trace_ctx
+                trace_mod.record_span(
+                    ctx.trace_id, trace_mod.new_span_id(), ctx.span_id,
+                    "serve/queue",
+                    done_unix - (done - r.enqueued_at),
+                    done_unix - (done - r.dispatched_at),
+                    rows=r.rows,
+                )
+                trace_mod.record_span(
+                    ctx.trace_id,
+                    (dispatch_ctx.span_id
+                     if lead is r and dispatch_ctx is not None
+                     else trace_mod.new_span_id()),
+                    ctx.span_id, "serve/dispatch",
+                    done_unix - (done - r.dispatched_at), done_unix,
+                    rows=rows, bucket=bucket, coalesced=len(live),
+                    plan=source, gear=gear or "exact",
+                )
             # per-request decomposition, by trace id: queue (admit ->
             # dispatch) vs device (dispatch -> done) — the flight ring's
             # answer to "why was THIS request slow"
@@ -339,6 +371,13 @@ class MicroBatcher:
                 device_ms=round((done - r.dispatched_at) * 1e3, 3),
                 total_ms=round((done - r.enqueued_at) * 1e3, 3),
             )
+            # fulfill LAST: it wakes the waiting handler thread, and a
+            # client that reads its answer and immediately snapshots the
+            # ring must find this request's decomposition already there
+            r.fulfill(d2[off:off + r.rows, :r.k],
+                      ids[off:off + r.rows, :r.k],
+                      degraded=forced, gear=gear)
+            off += r.rows
         if visit_cap is not None and self._sample_every:
             # shadow-sample AFTER the answers left: the exact re-answer
             # delays the next batch pickup by one dispatch, never the
@@ -398,14 +437,33 @@ class MicroBatcher:
             req.fail(f"fallback dispatch failed: {e!r}")
             return
         done = time.monotonic()
-        req.fulfill(d2, ids, degraded=reason,
-                    gear="brute-deadline" if reason == "brute-deadline"
-                    else None)
         if req.dispatched_at is not None:
             self._lat["dispatch"].observe(done - req.dispatched_at)
-        self._lat["total"].observe(done - req.enqueued_at)
+        self._lat["total"].observe(done - req.enqueued_at,
+                                   exemplar=req.trace_id)
+        if req.trace_ctx is not None:
+            ctx = req.trace_ctx
+            done_unix = time.time()
+            start = (req.dispatched_at if req.dispatched_at is not None
+                     else req.enqueued_at)
+            trace_mod.record_span(
+                ctx.trace_id, trace_mod.new_span_id(), ctx.span_id,
+                "serve/queue",
+                done_unix - (done - req.enqueued_at),
+                done_unix - (done - start), rows=req.rows,
+            )
+            trace_mod.record_span(
+                ctx.trace_id, trace_mod.new_span_id(), ctx.span_id,
+                "serve/fallback", done_unix - (done - start), done_unix,
+                rows=req.rows, degraded=reason,
+            )
         flight.record(
             "serve.request", trace=req.trace_id, rows=req.rows,
             degraded=reason,
             total_ms=round((done - req.enqueued_at) * 1e3, 3),
         )
+        # fulfill last, same response-implies-ring-event ordering as the
+        # batch path above
+        req.fulfill(d2, ids, degraded=reason,
+                    gear="brute-deadline" if reason == "brute-deadline"
+                    else None)

@@ -105,6 +105,7 @@ import numpy as np
 from kdtree_tpu import obs
 from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import flight
+from kdtree_tpu.obs import trace as trace_mod
 from kdtree_tpu.serve import spatial
 from kdtree_tpu.serve.server import (
     GracefulHTTPServer,
@@ -292,6 +293,13 @@ class ShardState:
         self.box: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self.grid = None  # spatial.SpatialGrid
         self.code_range: Optional[Tuple[int, int]] = None
+        # RTT-midpoint clock-offset estimate (seconds this replica's
+        # wall clock reads AHEAD of the router's), refreshed by every
+        # successful health probe — the trace assembler's join input.
+        # None until the first probed exchange; kept across later
+        # failures like id_offset (a stale estimate beats none when
+        # assembling a trace recorded just before an ejection)
+        self.clock_offset_s: Optional[float] = None
 
     # -- latency / hedging ---------------------------------------------------
 
@@ -460,6 +468,7 @@ class RouterConfig:
         breaker_reset_s: float = DEFAULT_BREAKER_RESET_S,
         health_period_s: float = DEFAULT_HEALTH_PERIOD_S,
         fanout: str = "selective",
+        trace_frac: float = 0.0,
     ) -> None:
         if fanout not in FANOUT_MODES:
             raise ValueError(
@@ -480,6 +489,15 @@ class RouterConfig:
         self.breaker_failures = int(breaker_failures)
         self.breaker_reset_s = float(breaker_reset_s)
         self.health_period_s = float(health_period_s)
+        # head-sampling fraction for distributed tracing (--trace-frac):
+        # tail promotion (slow/error/partial/hedged/...) is always on;
+        # this additionally pins a deterministic slice of BORING
+        # requests — the baseline a waterfall is read against
+        if not (0.0 <= float(trace_frac) <= 1.0):
+            raise ValueError(
+                f"trace_frac must be in [0, 1], got {trace_frac}"
+            )
+        self.trace_frac = float(trace_frac)
 
     def resolve_quorum(self, n_shards: int) -> int:
         if self.quorum is not None:
@@ -585,6 +603,26 @@ class RouterHandler(JsonRequestHandler):
         if path == "/debug/flight":
             self._send_flight()
             return
+        if path == "/debug/trace" or path.startswith("/debug/trace/"):
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            if qs.get("assemble", ["0"])[0] not in ("", "0"):
+                tid = path[len("/debug/trace"):].strip("/")
+                if not tid:
+                    self._send_json(400, {"error": "?assemble=1 needs "
+                                                   "/debug/trace/<id>"})
+                    return
+                assembled = self.server.assemble_trace(tid)
+                if assembled is None:
+                    self._send_json(404, {"error": f"no such trace: "
+                                                   f"{tid} (aged out or "
+                                                   "never recorded)"})
+                    return
+                self._send_json(200, assembled)
+                return
+            self._send_trace(path)
+            return
         if path == "/debug/shards":
             self._send_json(200, {"shards": self.server.shard_report()})
             return
@@ -621,6 +659,16 @@ class RouterHandler(JsonRequestHandler):
         # PAGE dump names it (shared helper on JsonRequestHandler)
         self._note_offered_rate()
         trace = _trace_id(self.headers)
+        # the router MINTS the fleet's trace context (it is the root of
+        # every fan-out): head-sampled at --trace-frac, tail-promoted
+        # regardless at response time (obs/trace.py)
+        ctx = None
+        if trace_mod.enabled():
+            ctx = trace_mod.mint(
+                trace,
+                sampled=trace_mod.head_sampled(
+                    trace, self.server.config.trace_frac),
+            )
         try:
             length = int(self.headers.get("Content-Length", ""))
         except ValueError:
@@ -637,7 +685,8 @@ class RouterHandler(JsonRequestHandler):
             return
         if path in ("/v1/upsert", "/v1/delete"):
             op = "upsert" if path == "/v1/upsert" else "delete"
-            code, out = self.server.route_write(op, payload, trace)
+            code, out = self.server.route_write(op, payload, trace,
+                                                ctx=ctx)
             self._send_json(code, out)
             return
         if not isinstance(payload, dict) or "queries" not in payload:
@@ -661,7 +710,8 @@ class RouterHandler(JsonRequestHandler):
         if not parse_recall_target(payload.get("recall_target"))[0]:
             self._send_json(400, {"error": RECALL_TARGET_ERROR})
             return
-        code, out, headers = self.server.route_knn(body, payload, k, trace)
+        code, out, headers = self.server.route_knn(body, payload, k, trace,
+                                                   ctx=ctx)
         self._send_json(code, out, extra_headers=headers)
 
 
@@ -749,6 +799,9 @@ class Router(GracefulHTTPServer):
         # the most recent X-Loadgen-Rate a client declared (see
         # JsonRequestHandler._note_offered_rate)
         self.loadgen_rate: Optional[float] = None
+        # the p99-relative slowness detector behind the "slow" trace
+        # promotion (obs/trace.py SlowTracker)
+        self.slow_tracker = trace_mod.SlowTracker()
 
     # -- telemetry plumbing --------------------------------------------------
 
@@ -785,12 +838,49 @@ class Router(GracefulHTTPServer):
             labels={"shard": str(shard.index), "outcome": outcome},
         ).inc()
 
+    def _trace_route_finish(
+        self, ctx: Optional[trace_mod.TraceContext], t0_wall: float,
+        t_merge0: Optional[float], status: str, degraded: Optional[str],
+        contacted: int, answered: int, pruned: int,
+    ) -> None:
+        """Close the routed request's trace: the router-side merge span,
+        the ROOT route/request span (parent_id empty — this is the
+        waterfall's denominator), and the tail-sampling promotions.
+        Never raises — runs on every response path."""
+        if ctx is None:
+            return
+        try:
+            end = time.time()
+            if t_merge0 is not None:
+                trace_mod.record_span(
+                    ctx.trace_id, trace_mod.new_span_id(), ctx.span_id,
+                    "route/merge", t_merge0, end, answered=answered)
+            attrs = {"status": status, "contacted": contacted,
+                     "answered": answered, "pruned": pruned}
+            if degraded:
+                attrs["degraded"] = degraded
+            trace_mod.record_span(ctx.trace_id, ctx.span_id, "",
+                                  "route/request", t0_wall, end, **attrs)
+            if status in ("unavailable", "client_error"):
+                trace_mod.promote(ctx.trace_id, "error")
+            if status == "partial":
+                trace_mod.promote(ctx.trace_id, "partial")
+            if degraded and status != "partial":
+                trace_mod.promote(ctx.trace_id, "degraded")
+            if status in ("ok", "partial") and \
+                    self.slow_tracker.note(end - t0_wall):
+                trace_mod.promote(ctx.trace_id, "slow")
+            if ctx.sampled:
+                trace_mod.promote(ctx.trace_id, "sampled")
+        except Exception:
+            pass
+
     # -- shard I/O -----------------------------------------------------------
 
     def _call_shard(
         self, shard: ShardState, body: bytes, timeout_s: float, trace: str,
         conn_box: Optional[dict] = None, tag: str = "primary",
-        abort_check=None, path: str = "/v1/knn",
+        abort_check=None, path: str = "/v1/knn", tp: str = "",
     ) -> dict:
         """One HTTP attempt against one shard; returns the parsed
         payload or raises :class:`ShardError`. The connection is stored
@@ -824,8 +914,13 @@ class Router(GracefulHTTPServer):
             try:
                 conn.request(
                     "POST", path, body=body,
+                    # X-Trace-Context propagates the distributed-trace
+                    # context on EVERY outbound shard call — retries,
+                    # hedges, and write partitions included (KDT110
+                    # lints for this key; empty value = untraced)
                     headers={"Content-Type": "application/json",
-                             "X-Request-Id": trace},
+                             "X-Request-Id": trace,
+                             "X-Trace-Context": tp},
                 )
                 resp = conn.getresponse()
                 raw = resp.read()
@@ -895,6 +990,7 @@ class Router(GracefulHTTPServer):
     def _attempt_hedged(
         self, shard: ShardState, body: bytes, deadline: float, trace: str,
         allow_hedge: bool = True, hedge_shard: Optional[ShardState] = None,
+        ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
     ) -> Tuple[dict, ShardState]:
         """One logical attempt = a primary call plus (maybe) one hedge.
         The first success wins and the loser's connection is closed;
@@ -926,6 +1022,12 @@ class Router(GracefulHTTPServer):
             target = (hedge_shard
                       if tag == "hedge" and hedge_shard is not None
                       else shard)
+            # each attempt carries its OWN child span id downstream, so
+            # the shard's serve/request parents under this exact call —
+            # a hedge pair shows up as two siblings, not one blurred bar
+            a_ctx = ctx.child() if ctx is not None else None
+            t_span0 = time.time()
+            outcome = "ok"
             try:
                 payload = self._call_shard(
                     target, body, budget, trace, conn_box=conns, tag=tag,
@@ -933,6 +1035,7 @@ class Router(GracefulHTTPServer):
                     # aborts itself before sending anything
                     abort_check=lambda: result.get("winner") not in
                     (None, tag),
+                    tp=trace_mod.outbound_header(a_ctx),
                 )
                 with cond:
                     if "winner" not in result:
@@ -955,9 +1058,22 @@ class Router(GracefulHTTPServer):
                     reg.counter("kdtree_router_hedge_wins_total",
                                 labels=target.label()).inc()
             except ShardError as e:
+                outcome = e.outcome
                 with cond:
                     result[tag] = e
                     cond.notify_all()
+            finally:
+                if a_ctx is not None:
+                    trace_mod.record_span(
+                        a_ctx.trace_id, a_ctx.span_id,
+                        ctx.span_id, "route/shard",
+                        t_span0, time.time(),
+                        shard=target.index, replica=target.replica,
+                        wave=wave, role=tag,
+                        hedge=("winner" if result.get("winner") == tag
+                               else "loser"),
+                        outcome=outcome,
+                    )
 
         primary = threading.Thread(
             target=run, args=("primary",), name="kdtree-route-primary"
@@ -980,6 +1096,11 @@ class Router(GracefulHTTPServer):
                         labels=shard.label()).inc()
             flight.record("route.hedge", shard=shard.index, trace=trace,
                           after_ms=round(hedge_after * 1e3, 3))
+            if ctx is not None:
+                # a fired hedge IS tail evidence: promote at launch, so
+                # the pair survives even if the response path races the
+                # loser's span arriving late
+                trace_mod.promote(ctx.trace_id, "hedged")
             hedge_thread = threading.Thread(
                 target=run, args=("hedge",), name="kdtree-route-hedge"
             )
@@ -1023,6 +1144,7 @@ class Router(GracefulHTTPServer):
 
     def _shard_task(
         self, sset: ReplicaSet, body: bytes, deadline: float, trace: str,
+        ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
     ):
         """The full per-shard policy, replica-aware: pick a routable
         replica round-robin (ejection and breaker checks per replica),
@@ -1071,6 +1193,7 @@ class Router(GracefulHTTPServer):
                     # aim the hedge at a sibling replica when one is
                     # routable (None falls back to the same process)
                     hedge_shard=sset.hedge_candidate(shard),
+                    ctx=ctx, wave=wave,
                 )
             except ShardError as e:
                 last = e
@@ -1136,6 +1259,7 @@ class Router(GracefulHTTPServer):
     def _scatter_start(
         self, indices: List[int], body: bytes, deadline: float,
         trace: str, results: List[Optional[object]],
+        ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
     ) -> List[threading.Thread]:
         """Launch one concurrent scatter wave over the named shard
         sets; results land in ``results`` by set index (waves touch
@@ -1148,7 +1272,8 @@ class Router(GracefulHTTPServer):
         for i in indices:
             def task(s=self.shard_sets[i]):
                 results[s.index] = self._shard_task(s, body, deadline,
-                                                    trace)
+                                                    trace, ctx=ctx,
+                                                    wave=wave)
 
             t = threading.Thread(target=task, name="kdtree-route-scatter")
             t.start()
@@ -1235,13 +1360,16 @@ class Router(GracefulHTTPServer):
 
     def route_knn(
         self, body: bytes, payload: dict, k: Optional[int], trace: str,
+        ctx: Optional[trace_mod.TraceContext] = None,
     ) -> Tuple[int, dict, Optional[dict]]:
         """Fan one validated request out — to every shard, or (with
         learned boxes) to the lb-ranked nearest few, widening only
         until exactness (or the recall target) is proven — gather
         inside the deadline, merge. Returns (status, response body,
-        headers)."""
+        headers). ``ctx`` is the request's minted trace context; its
+        span id is the trace's ROOT (the waterfall's denominator)."""
         t0 = time.monotonic()
+        t0_wall = time.time()
         deadline = t0 + self.config.deadline_s
         n = len(self.shard_sets)
         results: List[Optional[object]] = [None] * n
@@ -1266,7 +1394,7 @@ class Router(GracefulHTTPServer):
             wave1 = spatial.initial_wave(lbs)
             contacted = sorted(wave1)
             threads = self._scatter_start(wave1, body, deadline, trace,
-                                          results)
+                                          results, ctx=ctx)
             remaining = [i for i in range(n) if i not in set(wave1)]
             if remaining:
                 # wave 1 gets at most HALF the remaining budget while
@@ -1288,12 +1416,17 @@ class Router(GracefulHTTPServer):
                     lbs, remaining, worst, short, recall_target)
                 if wave2:
                     threads += self._scatter_start(wave2, body, deadline,
-                                                   trace, results)
+                                                   trace, results,
+                                                   ctx=ctx, wave=2)
                     contacted = sorted(set(contacted) | set(wave2))
+                    if ctx is not None:
+                        # a widening wave is tail evidence too: the
+                        # pruning argument failed to close on wave 1
+                        trace_mod.promote(ctx.trace_id, "wave2")
         else:
             contacted = list(range(n))
             threads = self._scatter_start(contacted, body, deadline,
-                                          trace, results)
+                                          trace, results, ctx=ctx)
         self._scatter_join(threads, deadline + 0.25)
         m = len(contacted)
         pruned = n - m
@@ -1306,6 +1439,7 @@ class Router(GracefulHTTPServer):
         # ONE snapshot: a laggard task finishing between two reads of
         # `results` must not let the merge and the missing-list disagree
         snapshot = list(results)
+        t_merge0 = time.time()
         payloads = [snapshot[i] for i in contacted
                     if isinstance(snapshot[i], dict)]
         errors = {i: snapshot[i] for i in contacted
@@ -1317,9 +1451,12 @@ class Router(GracefulHTTPServer):
                 self._count_request("client_error")
                 out = dict(err.body)
                 out["trace_id"] = trace
+                self._trace_route_finish(
+                    ctx, t0_wall, None, "client_error", None,
+                    len(contacted), len(payloads), pruned)
                 return err.status or 400, out, None
         elapsed = time.monotonic() - t0
-        self._req_lat.observe(elapsed)
+        self._req_lat.observe(elapsed, exemplar=trace)
         missing = sorted(set(contacted)
                          - {i for i in contacted
                             if isinstance(snapshot[i], dict)})
@@ -1349,6 +1486,8 @@ class Router(GracefulHTTPServer):
             }
             if gear is not None:
                 out["gear"] = gear
+            self._trace_route_finish(ctx, t0_wall, t_merge0, "ok",
+                                     degraded, m, answered, pruned)
             return 200, out, None
         if answered >= required:
             # partial degradation: exact over the answered shards,
@@ -1359,6 +1498,12 @@ class Router(GracefulHTTPServer):
                 recall_target if spatial_cut else None)
             self._partial.inc()
             self._count_request("partial")
+            # promote BEFORE the flight dump: its trace-route-partial
+            # companion snapshots the pinned set, and this request's
+            # trace is the whole point of that file
+            self._trace_route_finish(
+                ctx, t0_wall, t_merge0, "partial",
+                f"partial:{answered}/{m}", m, answered, pruned)
             flight.record(
                 "route.partial", trace=trace, answered=answered,
                 total=n, contacted=m, missing=missing,
@@ -1375,6 +1520,8 @@ class Router(GracefulHTTPServer):
                 out["gear"] = gear
             return 200, out, None
         self._count_request("unavailable")
+        self._trace_route_finish(ctx, t0_wall, t_merge0, "unavailable",
+                                 None, m, answered, pruned)
         flight.record(
             "route.unavailable", trace=trace, answered=answered,
             total=n, contacted=m, quorum=self.quorum, missing=missing,
@@ -1387,6 +1534,82 @@ class Router(GracefulHTTPServer):
             "trace_id": trace,
             "shards": shards_block(),
         }, {"Retry-After": str(int(max(self.config.breaker_reset_s, 1.0)))}
+
+    # -- distributed-trace assembly ------------------------------------------
+
+    def assemble_trace(self, trace_id: str) -> Optional[dict]:
+        """Join this router's spans for ``trace_id`` with every
+        contacted shard's (a ``GET /debug/trace/<id>`` fan-out),
+        clock-corrected by the health loop's RTT-midpoint offset
+        estimates. None when the router never recorded the trace. Who
+        to ask is read off the local route/shard spans' shard/replica
+        attrs; a replica that cannot answer contributes an ``error``
+        source entry, never a silent hole in the waterfall."""
+        import http.client
+
+        local = trace_mod.get_trace(trace_id)
+        if local is None:
+            return None
+        by_key = {(s.index, s.replica): s for s in self.shards}
+        targets: List[ShardState] = []
+        seen = set()
+        for sp in local["spans"]:
+            key = (sp.get("shard"), sp.get("replica"))
+            if key in by_key and key not in seen:
+                seen.add(key)
+                targets.append(by_key[key])
+        if not targets:
+            # no scatter spans recorded (trace minted but fanned out
+            # before tracing, or spans aged out): ask every primary
+            # rather than assembling a router-only forest
+            targets = [s.primary for s in self.shard_sets]
+        sources: List[dict] = [{
+            "source": "router", "clock_offset_s": 0.0,
+            "spans": local["spans"], "error": None,
+        }]
+
+        def fetch(shard: ShardState, out: list, i: int) -> None:
+            name = (f"shard{shard.index}/r{shard.replica}"
+                    if shard.multi else f"shard{shard.index}")
+            entry = {"source": name,
+                     "clock_offset_s": shard.clock_offset_s or 0.0,
+                     "spans": [], "error": None}
+            try:
+                conn = http.client.HTTPConnection(shard.host, shard.port,
+                                                  timeout=2.0)
+                try:
+                    conn.request("GET", f"/debug/trace/{trace_id}")
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                finally:
+                    conn.close()
+                if resp.status != 200:
+                    entry["error"] = f"HTTP {resp.status}"
+                else:
+                    payload = json.loads(raw.decode("utf-8"))
+                    entry["spans"] = payload.get("spans") or []
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                entry["error"] = repr(e)
+            out[i] = entry
+
+        # concurrent fetch, same reasoning as the health sweep: one
+        # unreachable replica must not serialize its timeout in front
+        # of every other source
+        slots: List[Optional[dict]] = [None] * len(targets)
+        threads = [
+            threading.Thread(target=fetch, args=(t, slots, i),
+                             name="kdtree-route-trace-fetch")
+            for i, t in enumerate(targets)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=3.0)
+        sources += [s for s in slots if s is not None]
+        assembled = trace_mod.assemble(trace_id, sources)
+        assembled["reasons"] = local.get("reasons", [])
+        assembled["pinned"] = local.get("pinned", False)
+        return assembled
 
     # -- write passthrough (mutable index) -----------------------------------
 
@@ -1403,6 +1626,7 @@ class Router(GracefulHTTPServer):
 
     def route_write(
         self, op: str, payload, trace: str,
+        ctx: Optional[trace_mod.TraceContext] = None,
     ) -> Tuple[int, dict]:
         """Partition a write request's GLOBAL ids by owning shard (the
         contiguous range starting at each shard's ``id_offset``) and
@@ -1417,6 +1641,25 @@ class Router(GracefulHTTPServer):
             ).inc()
 
         from kdtree_tpu.serve.server import MAX_WRITE_IDS
+
+        t0_wall = time.time()
+
+        def tfinish(status: str) -> None:
+            """Root span + promotions for a write that actually fanned
+            out (pre-scatter 4xxs stay untraced: nothing downstream to
+            decompose). Never raises."""
+            if ctx is None:
+                return
+            try:
+                trace_mod.record_span(
+                    ctx.trace_id, ctx.span_id, "", "route/request",
+                    t0_wall, time.time(), status=status, op=op)
+                if status == "error":
+                    trace_mod.promote(ctx.trace_id, "error")
+                if ctx.sampled:
+                    trace_mod.promote(ctx.trace_id, "sampled")
+            except Exception:
+                pass
 
         ids = payload.get("ids") if isinstance(payload, dict) else None
         if not isinstance(ids, list) or not ids or not all(
@@ -1607,12 +1850,23 @@ class Router(GracefulHTTPServer):
                 shard_out[out_key] = {"error": "deadline exhausted"}
                 failures = failures or "timeout"
                 continue
+            # each forwarded partition carries its own child span id, so
+            # the owning shard's serve/request parents under this call
+            j_ctx = ctx.child() if ctx is not None else None
+            t_j0 = time.time()
             try:
                 res = self._call_shard(
                     shard, json.dumps(sub).encode("utf-8"), budget,
                     trace, path=f"/v1/{job_op}",
+                    tp=trace_mod.outbound_header(j_ctx),
                 )
             except ShardError as e:
+                if j_ctx is not None:
+                    trace_mod.record_span(
+                        j_ctx.trace_id, j_ctx.span_id, ctx.span_id,
+                        "route/shard", t_j0, time.time(),
+                        shard=shard.index, replica=shard.replica,
+                        op=job_op, outcome=e.outcome)
                 # mirror the read path's breaker contract: a 4xx is the
                 # shard ANSWERING (success — and a half-open probe slot
                 # claimed by allow() above must be released either way)
@@ -1633,6 +1887,12 @@ class Router(GracefulHTTPServer):
                 continue
             shard.breaker.record_success()
             self._count_attempt(shard, "ok")
+            if j_ctx is not None:
+                trace_mod.record_span(
+                    j_ctx.trace_id, j_ctx.span_id, ctx.span_id,
+                    "route/shard", t_j0, time.time(),
+                    shard=shard.index, replica=shard.replica,
+                    op=job_op, outcome="ok")
             if counts:
                 applied += int(res.get("applied", 0))
             shard_out[out_key] = {
@@ -1653,6 +1913,7 @@ class Router(GracefulHTTPServer):
                       routing="spatial" if spatial_mode else "range")
         if failures is None:
             count("ok")
+            tfinish("ok")
             return 200, out
         if client_error is not None and len(jobs) == 1 and \
                 primary_jobs == 1:
@@ -1660,9 +1921,11 @@ class Router(GracefulHTTPServer):
             # propagate its verdict verbatim (nothing was applied
             # anywhere, so this is a clean 4xx, not a partial write)
             count("client_error")
+            tfinish("client_error")
             out["error"] = str(client_error)
             return client_error.status or 400, out
         count("error")
+        tfinish("error")
         out["error"] = "one or more shards failed the write (see shards)"
         return 502, out
 
@@ -1825,9 +2088,15 @@ class Router(GracefulHTTPServer):
             conn = http.client.HTTPConnection(shard.host, shard.port,
                                               timeout=timeout)
             try:
+                # wall-clock the exchange: the shard stamps server_unix
+                # into its /healthz body, and the RTT midpoint gives the
+                # per-replica clock-offset estimate the trace assembler
+                # joins cross-process spans with (obs/trace.py)
+                t0_wall = time.time()
                 conn.request("GET", "/healthz")
                 resp = conn.getresponse()
                 raw = resp.read()
+                t1_wall = time.time()
                 if resp.status == 200:
                     try:
                         detail = json.loads(raw.decode("utf-8"))
@@ -1836,6 +2105,15 @@ class Router(GracefulHTTPServer):
                     off = detail.get("id_offset")
                     if isinstance(off, int) and not isinstance(off, bool):
                         shard.id_offset = off
+                    su = detail.get("server_unix")
+                    if isinstance(su, (int, float)) and \
+                            not isinstance(su, bool):
+                        shard.clock_offset_s = trace_mod.\
+                            estimate_clock_offset(t0_wall, t1_wall, su)
+                        obs.get_registry().gauge(
+                            "kdtree_router_clock_skew_ms",
+                            labels=shard.label(),
+                        ).set(shard.clock_offset_s * 1e3)
                     self._learn_spatial(shard, detail)
                     healthy = detail.get("slo", {}).get("state") != "PAGE"
                     if not healthy:

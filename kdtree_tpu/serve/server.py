@@ -65,6 +65,7 @@ import numpy as np
 
 from kdtree_tpu import obs
 from kdtree_tpu.obs import flight
+from kdtree_tpu.obs import trace as trace_mod
 from kdtree_tpu.serve.admission import (
     AdmissionQueue,
     PendingRequest,
@@ -169,10 +170,23 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
     def _send_metrics(self) -> None:
         """``GET /metrics``: the process registry's Prometheus text,
-        deferred device fetches flushed first."""
-        from kdtree_tpu.obs.export import prometheus_text
+        deferred device fetches flushed first. ``?openmetrics=1`` opts
+        into the OpenMetrics flavor (trace-id exemplars + ``# EOF``);
+        the default exposition stays byte-identical to the pre-exemplar
+        format so existing scrapers never see a parse change."""
+        from urllib.parse import parse_qs, urlparse
+
+        from kdtree_tpu.obs.export import openmetrics_text, prometheus_text
 
         obs.flush()
+        qs = parse_qs(urlparse(self.path).query)
+        if qs.get("openmetrics", ["0"])[0] not in ("", "0"):
+            self._send_bytes(
+                200, openmetrics_text().encode("utf-8"),
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8",
+            )
+            return
         self._send_bytes(
             200, prometheus_text().encode("utf-8"),
             "text/plain; version=0.0.4; charset=utf-8",
@@ -180,8 +194,41 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
     def _send_flight(self) -> None:
         """``GET /debug/flight``: the live ring, no file involved — same
-        payload shape as a SIGUSR2 dump so one reader handles both."""
-        self._send_json(200, flight.recorder().report("debug-endpoint"))
+        payload shape as a SIGUSR2 dump so one reader handles both.
+        ``?trace=<id>`` / ``?reason=<r>`` filter server-side (the rings
+        carry trace ids; shipping 1024 events to grep one request out
+        was the debugging hot path)."""
+        from urllib.parse import parse_qs, urlparse
+
+        qs = parse_qs(urlparse(self.path).query)
+        trace = (qs.get("trace") or [None])[0]
+        reason = (qs.get("reason") or [None])[0]
+        rep = flight.recorder().report("debug-endpoint")
+        if trace is not None or reason is not None:
+            rep["events"] = flight.filter_events(
+                rep["events"], trace=trace, reason=reason)
+            rep["filter"] = {"trace": trace, "reason": reason,
+                             "matched": len(rep["events"])}
+        self._send_json(200, rep)
+
+    def _send_trace(self, path: str) -> None:
+        """``GET /debug/trace/`` (the pinned-trace index) and
+        ``GET /debug/trace/<id>`` (one trace's local span list) — the
+        per-process half of distributed-trace assembly, shared by the
+        shard server AND the router (whose ``?assemble=1`` fans out to
+        shards through this very endpoint)."""
+        tid = path[len("/debug/trace"):].strip("/")
+        if not tid:
+            self._send_json(200, trace_mod.index())
+            return
+        payload = trace_mod.get_trace(tid)
+        if payload is None:
+            self._send_json(404, {"error": f"no such trace: {tid} "
+                                           "(aged out or never recorded)"})
+            return
+        payload["trace_version"] = trace_mod.TRACE_VERSION
+        payload["pid"] = os.getpid()
+        self._send_json(200, payload)
 
     def _note_offered_rate(self) -> None:
         """Mirror the load generator's ``X-Loadgen-Rate`` header into a
@@ -303,8 +350,15 @@ class KnnRequestHandler(JsonRequestHandler):
                 return
             state: ServeState = self.server.state
             if state.ready:
+                import time as _time
+
                 body = {
                     "status": "ok",
+                    # this process's wall clock, stamped mid-exchange:
+                    # the router's RTT-midpoint clock-offset estimate
+                    # (obs/trace.py, cross-process trace assembly)
+                    # reads it from every health probe
+                    "server_unix": _time.time(),
                     "n": state.engine.tree.n_real,
                     "dim": state.engine.tree.dim,
                     "k_max": state.engine.k,
@@ -378,6 +432,9 @@ class KnnRequestHandler(JsonRequestHandler):
         if path == "/debug/flight":
             self._send_flight()
             return
+        if path == "/debug/trace" or path.startswith("/debug/trace/"):
+            self._send_trace(path)
+            return
         if path == "/debug/history":
             # the metric-history ring the SLO engine reads — same payload
             # shape as an incident's history-<reason>.json dump
@@ -416,6 +473,16 @@ class KnnRequestHandler(JsonRequestHandler):
         if self._fire_fault(SITE_KNN):
             return
         trace = _trace_id(self.headers)
+        # distributed tracing (obs/trace.py): adopt the router's
+        # propagated context (or mint a local root for direct clients);
+        # everything this request does — admission wait, coalesce,
+        # dispatch — parents under one server-root span
+        import time as _time
+
+        ctx = trace_mod.adopt(self.headers, trace) \
+            if trace_mod.enabled() else None
+        root_id = trace_mod.new_span_id() if ctx is not None else ""
+        t_req0 = _time.time()
         parsed = self._parse_knn_body()
         if parsed is None:
             return  # error response already sent
@@ -461,22 +528,29 @@ class KnnRequestHandler(JsonRequestHandler):
                 flight.record("serve.error", trace=trace,
                               error=repr(e)[:200])
                 flight.auto_dump("serve-error")
+                self._trace_finish(ctx, root_id, t_req0, "error", None,
+                                   int(queries.shape[0]))
                 self._send_json(500, {"error": f"engine failure: {e!r}",
                                       "trace_id": trace})
                 return
             finally:
                 self.server.queue.release(charge)
             _count_request("degraded")
+            self._trace_finish(ctx, root_id, t_req0, "degraded", "oversized",
+                               int(queries.shape[0]))
             self._send_json(
                 200, self._result_json(d2, ids, k, degraded="oversized",
                                        trace_id=trace)
             )
             return
-        import time as _time
-
         deadline = (_time.monotonic() + deadline_s) if deadline_s else None
-        req = PendingRequest(queries, k, deadline, trace_id=trace,
-                             recall_target=recall_target)
+        req = PendingRequest(
+            queries, k, deadline, trace_id=trace,
+            recall_target=recall_target,
+            trace_ctx=(trace_mod.TraceContext(ctx.trace_id, root_id,
+                                              ctx.sampled)
+                       if ctx is not None else None),
+        )
         try:
             self.server.queue.submit(req)
         except QueueFullError:
@@ -495,14 +569,20 @@ class KnnRequestHandler(JsonRequestHandler):
             _count_request("timeout")
             flight.record("serve.timeout", trace=trace, rows=req.rows)
             flight.auto_dump("serve-error")
+            self._trace_finish(ctx, root_id, t_req0, "timeout", None,
+                               req.rows)
             self._send_json(504, {"error": "request timed out in service",
                                   "trace_id": trace})
             return
         if req.error is not None:
             _count_request("error")
+            self._trace_finish(ctx, root_id, t_req0, "error", None, req.rows)
             self._send_json(500, {"error": req.error, "trace_id": trace})
             return
         _count_request("degraded" if req.degraded else "ok")
+        self._trace_finish(ctx, root_id, t_req0,
+                           "degraded" if req.degraded else "ok",
+                           req.degraded, req.rows)
         self._send_json(
             200, self._result_json(req.d2, req.ids, k, degraded=req.degraded,
                                    trace_id=trace, gear=req.gear)
@@ -668,16 +748,28 @@ class KnnRequestHandler(JsonRequestHandler):
                 return
         import time as _time
 
+        ctx = trace_mod.adopt(self.headers, trace) \
+            if trace_mod.enabled() else None
+        root_id = trace_mod.new_span_id() if ctx is not None else ""
+        t_w0 = _time.time()
         t0 = _time.perf_counter()
         try:
-            if op == "upsert":
-                res = engine.upsert(local, points)
-            else:
-                res = engine.delete(local)
+            # activate the write's root context so engine-internal spans
+            # (delta append, overlay merge, rebuild swap) nest under it
+            with trace_mod.active(
+                trace_mod.TraceContext(ctx.trace_id, root_id, ctx.sampled)
+                if ctx is not None else None
+            ):
+                if op == "upsert":
+                    res = engine.upsert(local, points)
+                else:
+                    res = engine.delete(local)
         except ValueError as e:
+            self._trace_finish(ctx, root_id, t_w0, "error", None, len(ids))
             self._send_json(400, {"error": str(e), "trace_id": trace})
             return
         except RuntimeError as e:
+            self._trace_finish(ctx, root_id, t_w0, "error", None, len(ids))
             self._send_json(503, {"error": str(e), "trace_id": trace})
             return
         # the write path is TIMED (PR 10's open note: mutation throughput
@@ -685,13 +777,57 @@ class KnnRequestHandler(JsonRequestHandler):
         # engine-lock wait, so lock-held compiles and rebuild-swap
         # contention show up here, not only in a profiler capture
         apply_ms = (_time.perf_counter() - t0) * 1e3
-        self.server.write_latency[op].observe(apply_ms)
+        self.server.write_latency[op].observe(apply_ms, exemplar=trace)
+        if ctx is not None:
+            trace_mod.record_span(
+                ctx.trace_id, trace_mod.new_span_id(), root_id,
+                "serve/write", t_w0, t_w0 + apply_ms / 1e3,
+                op=op, ids=len(ids), applied=res["applied"],
+            )
+        # writes do not feed the knn slow tracker: rebuild-heavy applies
+        # would inflate the p99 the knn "slow" promotion is relative to
+        self._trace_finish(ctx, root_id, t_w0, "ok", None, len(ids),
+                           track_slow=False)
         flight.record("serve.write", op=op, trace=trace,
                       ids=len(ids), applied=res["applied"],
                       delta_rows=res["delta_rows"], epoch=res["epoch"])
         res["op"] = op
         res["trace_id"] = trace
         self._send_json(200, res)
+
+    def _trace_finish(
+        self, ctx, root_id: str, t0_unix: float, status: str,
+        degraded, rows: int, track_slow: bool = True,
+    ) -> None:
+        """Close the request's server-root span and apply the tail-
+        sampling promotion rules (docs/OBSERVABILITY.md "Distributed
+        tracing"): errored/timed-out and degraded answers always pin;
+        p99-relative slow answers pin; head-sampled contexts pin the
+        boring baseline. Never raises — called on the response path."""
+        if ctx is None:
+            return
+        try:
+            import time as _time
+
+            end = _time.time()
+            attrs = {"status": status, "rows": rows}
+            if degraded:
+                attrs["degraded"] = degraded
+            trace_mod.record_span(
+                ctx.trace_id, root_id, ctx.span_id or "",
+                "serve/request", t0_unix, end, **attrs,
+            )
+            if status in ("error", "timeout"):
+                trace_mod.promote(ctx.trace_id, "error")
+            if degraded:
+                trace_mod.promote(ctx.trace_id, "degraded")
+            if track_slow and status in ("ok", "degraded") and \
+                    self.server.slow_tracker.note(end - t0_unix):
+                trace_mod.promote(ctx.trace_id, "slow")
+            if ctx.sampled:
+                trace_mod.promote(ctx.trace_id, "sampled")
+        except Exception:
+            pass
 
     def _retry_after(self, rows: int) -> dict:
         """The 429 extra-headers dict: Retry-After derived from the
@@ -890,6 +1026,10 @@ class KnnServer(GracefulHTTPServer):
         # the most recent X-Loadgen-Rate a client declared (None until a
         # load-harness run shows up); see _note_offered_rate
         self.loadgen_rate: Optional[float] = None
+        # the p99-relative slowness detector behind the "slow" trace
+        # promotion (obs/trace.py): a request is slow relative to ITS
+        # shard's recent window, not an absolute threshold
+        self.slow_tracker = trace_mod.SlowTracker()
 
     def _slo_tick(self) -> None:
         eng = self.state.slo_engine

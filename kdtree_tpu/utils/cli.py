@@ -1293,6 +1293,7 @@ def cmd_route(args) -> None:
             breaker_reset_s=args.breaker_reset_s,
             health_period_s=args.health_period_s,
             fanout=args.fanout,
+            trace_frac=args.trace_frac,
         )
         from kdtree_tpu.obs import slo as obs_slo
 
@@ -1537,6 +1538,76 @@ def cmd_profile(args) -> None:
         sys.stdout.write(obs_timeline.render_timeline(rep))
     print(f"timeline report written to {args.out}; raw trace: "
           f"{cap.trace_file}", file=sys.stderr)
+
+
+def cmd_trace(args) -> None:
+    """Fetch one distributed trace from a live serve/route process and
+    render the ASCII waterfall (docs/OBSERVABILITY.md "Distributed
+    tracing"): ``--id T`` names the trace, ``--last-slow`` asks the
+    target's pinned-trace index for the most recent slow-promoted id.
+    A router target assembles across its shards (``?assemble=1``,
+    clock-corrected by the health loop's RTT-midpoint offsets); a
+    shard target renders its local spans. ``--out`` keeps the JSON
+    artifact the waterfall was rendered from."""
+    import urllib.error
+    import urllib.request
+
+    from kdtree_tpu.obs import trace as trace_mod
+
+    base = args.target.rstrip("/")
+
+    def fetch(path: str) -> dict:
+        with urllib.request.urlopen(f"{base}{path}",
+                                    timeout=args.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    try:
+        tid = args.id
+        if tid is None:
+            idx = fetch("/debug/trace")
+            tid = (idx.get("last_promoted") or {}).get("slow")
+            if not tid:
+                # no slow promotion yet: fall back to the newest pinned
+                # trace — an errored/hedged waterfall beats "nothing"
+                pinned = idx.get("pinned") or []
+                tid = pinned[-1]["trace_id"] if pinned else None
+            if not tid:
+                print("no promoted traces at the target yet (nothing "
+                      "slow/errored/hedged so far; head-sample boring "
+                      "requests with route --trace-frac)",
+                      file=sys.stderr)
+                sys.exit(1)
+        try:
+            payload = fetch(f"/debug/trace/{tid}?assemble=1")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                print(f"no such trace at {base}: {tid} (aged out or "
+                      "never recorded)", file=sys.stderr)
+                sys.exit(1)
+            raise
+    except (OSError, ValueError) as e:
+        print(f"cannot fetch trace from {base}: {e}", file=sys.stderr)
+        sys.exit(1)
+    if payload.get("assembled"):
+        assembled = payload
+    else:
+        # a shard target ignores ?assemble=1 and answers its local span
+        # list — assemble the single-source forest client-side so the
+        # rendering path is one shape
+        assembled = trace_mod.assemble(tid, [{
+            "source": f"pid{payload.get('pid', '?')}",
+            "clock_offset_s": 0.0,
+            "spans": payload.get("spans") or [],
+            "error": None,
+        }])
+        assembled["reasons"] = payload.get("reasons", [])
+        assembled["pinned"] = payload.get("pinned", False)
+    sys.stdout.write(trace_mod.render_waterfall(assembled) + "\n")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(assembled, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+        print(f"trace artifact written to {args.out}", file=sys.stderr)
 
 
 def cmd_lint(args) -> None:
@@ -2102,6 +2173,13 @@ def main(argv=None) -> None:
                          "sharding & selective fan-out\"); full "
                          "restores the contact-every-shard scatter "
                          "(the A/B baseline)")
+    ro.add_argument("--trace-frac", type=float, default=0.0,
+                    help="head-sampling fraction for distributed "
+                         "tracing: deterministically pin this slice of "
+                         "BORING requests' traces (tail promotion — "
+                         "slow/error/partial/hedged — is always on; "
+                         "docs/OBSERVABILITY.md \"Distributed "
+                         "tracing\")")
     ro.set_defaults(fn=cmd_route)
 
     lg = sub.add_parser(
@@ -2328,6 +2406,32 @@ def main(argv=None) -> None:
                          "(burn down or grandfather debt) and exit 0")
     li.set_defaults(fn=cmd_lint)
 
+    tw = sub.add_parser(
+        "trace",
+        help="fetch a distributed trace from a live serve/route "
+             "process and render the ASCII waterfall (router targets "
+             "assemble across shards, clock-corrected); writes the "
+             "JSON artifact with --out (docs/OBSERVABILITY.md "
+             '"Distributed tracing")',
+    )
+    tw.add_argument("--target", default="http://127.0.0.1:8081",
+                    metavar="URL",
+                    help="router (assembled) or shard (local spans) "
+                         "base url")
+    tw_which = tw.add_mutually_exclusive_group(required=True)
+    tw_which.add_argument("--id", default=None, metavar="TRACE_ID",
+                          help="trace id to fetch (a request's "
+                               "trace_id / X-Request-Id)")
+    tw_which.add_argument("--last-slow", action="store_true",
+                          help="render the target's most recently "
+                               "slow-promoted trace (falls back to "
+                               "the newest pinned one)")
+    tw.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the assembled trace JSON here")
+    tw.add_argument("--timeout-s", type=float, default=5.0,
+                    help="per-fetch HTTP timeout")
+    tw.set_defaults(fn=cmd_trace)
+
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -2337,7 +2441,7 @@ def main(argv=None) -> None:
         # Usage parity with Utility.cpp:109-112
         print(f"Usage: {p.prog} harness SEED DIM_POINTS  NUM_POINTS", file=sys.stderr)
         sys.exit(1)
-    if args.cmd in ("lint", "trend"):
+    if args.cmd in ("lint", "trend", "trace"):
         # pure-stdlib paths: dispatch before the engine-error plumbing
         # below. (The kdtree_tpu package import itself still pulls in
         # jax — the ANALYSIS/trend code is stdlib-only, the entry point

@@ -86,9 +86,12 @@ def _isolate_flight_dump_rate_limit():
     test_flight's shed-burst vs test_slo's flood e2e). Clearing the
     limiter before every test makes every hand-picked order behave
     like a fresh process."""
-    from kdtree_tpu.obs import flight
+    from kdtree_tpu.obs import flight, trace
 
     flight.recorder().reset_dump_rate_limit()
+    # same reasoning for the process-wide trace buffer: promotion state
+    # (pinned ids, last-promoted pointers) must not leak across tests
+    trace.reset()
     yield
 
 

@@ -677,6 +677,88 @@ def test_kdt107_suppressible_with_reason(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# KDT110 outbound-call-without-trace-context
+# ---------------------------------------------------------------------------
+
+
+def test_kdt110_flags_post_whose_headers_lack_trace_context(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(conn, body, trace):\n"
+        "    conn.request('POST', '/v1/knn', body,\n"
+        "                 headers={'Content-Type': 'application/json',\n"
+        "                          'X-Request-Id': trace})\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT110"]
+    assert "X-Trace-Context" in res.findings[0].message
+
+
+def test_kdt110_flags_post_without_headers_at_all(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(conn, body):\n"
+        "    conn.request('POST', '/v1/knn', body)\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT110"]
+    assert "without headers=" in res.findings[0].message
+
+
+def test_kdt110_clean_when_header_forwarded(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(conn, body, trace, tp):\n"
+        "    conn.request('POST', '/v1/knn', body,\n"
+        "                 headers={'X-Request-Id': trace,\n"
+        "                          'X-Trace-Context': tp})\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt110_quiet_on_gets_and_non_literal_headers(tmp_path):
+    # GETs are exempt (health probes, trace fetches — they mint no
+    # spans downstream); a headers VARIABLE or a {**base} spread may
+    # carry the key, so the syntactic rule stays quiet rather than
+    # guessing (predictable false negatives over unpredictable false
+    # positives — the file's contract)
+    res = lint_snippet(tmp_path, (
+        "def calls(conn, body, hdrs, base):\n"
+        "    conn.request('GET', '/healthz')\n"
+        "    conn.request('POST', '/v1/knn', body, headers=hdrs)\n"
+        "    conn.request('POST', '/v1/knn', body,\n"
+        "                 headers={**base, 'X-Request-Id': 'r'})\n"
+        "    conn.request('POST', '/v1/knn', body, **hdrs)\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt110_scoped_to_serve_layer(tmp_path):
+    # the propagation contract binds the serving fleet; an analysis
+    # script POSTing to a dashboard is not an intra-fleet hop
+    res = lint_snippet(tmp_path, (
+        "def push(conn, body):\n"
+        "    conn.request('POST', '/api/upload', body, headers={})\n"
+    ), relpath="analysis/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt110_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(conn, body):\n"
+        "    conn.request('POST', '/v1/knn', body, headers={})  "
+        "# kdt-lint: disable=KDT110 external webhook, not an intra-fleet hop\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+def test_kdt110_header_literal_pinned_to_trace_module():
+    # the checker necessarily re-states the header name as a string
+    # (it lints source text, it cannot import the serve layer); this
+    # pin is what keeps a rename from silently gutting the rule
+    from kdtree_tpu.analysis import checkers
+    from kdtree_tpu.obs import trace
+
+    assert checkers._TRACE_CONTEXT_HEADER == trace.TRACE_HEADER
+
+
+# ---------------------------------------------------------------------------
 # KDT401 signal-unsafe-lock
 # ---------------------------------------------------------------------------
 

@@ -346,6 +346,10 @@ def test_e2e_capacity_block_with_write_mix_and_fault_knee_drop(
         assert step["sent"] > 0
         assert step["p99_ms"] is not None
         assert step["writes_ok"] > 0 or step["rate"] == 25.0
+        # each step names its slowest exchange's trace id — the handle
+        # `kdtree-tpu trace --id` resolves against the server's buffer
+        assert step["slowest_trace_id"].startswith("lg13-")
+        assert step["slowest_ms"] >= step["p99_ms"] * 0.5
     # server-side write-path evidence made it into the block
     server = cap["server"]
     assert server is not None

@@ -292,6 +292,50 @@ def test_prometheus_type_and_help_once_per_family():
     assert "# HELP totally_unknown_total" not in export.prometheus_text(reg2)
 
 
+def test_histogram_exemplars_keep_last_per_bucket():
+    reg = MetricsRegistry()
+    h = reg.histogram("z_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)                      # no exemplar recorded
+    h.observe(0.06, exemplar="req-a")
+    h.observe(0.07, exemplar="req-b")    # same bucket: last wins
+    h.observe(5.0, exemplar="req-slow")  # overflow bucket
+    ex = h.exemplars()
+    assert ex["0.1"][0:2] == ("req-b", 0.07)
+    assert "1" not in ex  # bucket nobody exemplared stays absent
+    assert ex["+Inf"][0:2] == ("req-slow", 5.0)
+
+
+def test_openmetrics_text_carries_exemplars_and_eof():
+    reg = MetricsRegistry()
+    h = reg.histogram("z_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="req-fast")
+    h.observe(5.0, exemplar="req-slow")
+    text = export.openmetrics_text(reg)
+    assert ('z_seconds_bucket{le="0.1"} 1 '
+            '# {trace_id="req-fast"} 0.05 ') in text
+    assert ('z_seconds_bucket{le="+Inf"} 2 '
+            '# {trace_id="req-slow"} 5 ') in text
+    assert text.endswith("# EOF\n")  # the terminator the format requires
+
+
+def test_default_exposition_byte_identical_despite_exemplars():
+    # the compatibility pin: existing scrapes (and the router's
+    # federation parser) read the DEFAULT exposition; recording
+    # exemplars must not perturb a single byte of it — only
+    # ?openmetrics=1 renders them
+    reg = MetricsRegistry()
+    h = reg.histogram("z_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    before = export.prometheus_text(reg)
+    reg2 = MetricsRegistry()
+    h2 = reg2.histogram("z_seconds", buckets=(0.1, 1.0))
+    h2.observe(0.05, exemplar="req-fast")
+    h2.observe(5.0, exemplar="req-slow")
+    assert export.prometheus_text(reg2) == before
+    assert "req-fast" not in before
+
+
 def test_report_and_render(tmp_path):
     reg = MetricsRegistry()
     reg.counter("kdtree_builds_total", labels={"engine": "morton"}).inc()

@@ -1,0 +1,663 @@
+"""Fleet-wide distributed tracing (docs/OBSERVABILITY.md "Distributed
+tracing").
+
+Three layers of evidence:
+
+1. **Primitives**: the wire-context round-trip (incl. dashed trace ids
+   — the right-anchored deviation from W3C), deterministic head
+   sampling, the RTT-midpoint clock-offset estimator under injected
+   skew, the bounded tail-sampled buffer, and the streaming p99 slow
+   tracker — all jax-free and tier-1-cheap.
+2. **Assembly**: :func:`trace.assemble` joins skewed multi-process span
+   lists into one forest, FLAGGING orphans and unaccounted root gaps
+   instead of dropping them; the waterfall renderer is pinned as a pure
+   function over that output.
+3. **Fleet e2e** (in-process 3-shard fleet + router, the
+   tests/test_router.py harness shape): a routed request's assembled
+   trace decomposes the router wall time into causally-linked router
+   and shard spans; a hedged pair carries winner/loser; a partial
+   answer tail-promotes its trace and writes the
+   ``trace-route-partial.json`` companion next to the flight dump.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kdtree_tpu.obs import trace
+
+REPO = Path(__file__).resolve().parents[1]
+
+DIM, K = 3, 4
+SHARD_N = 256
+N_SHARDS = 3
+SEED = 13
+
+
+# ---------------------------------------------------------------------------
+# context: wire round-trip + head sampling
+# ---------------------------------------------------------------------------
+
+
+def test_context_roundtrip_includes_dashed_trace_ids():
+    # trace ids are sanitized client request ids — dashes are the
+    # COMMON case (uuid-style ids), which is why the parse is
+    # right-anchored instead of a naive 4-way split
+    for tid in ("abc123", "req-2026-08-06-a1b2", "a-b-c-d-e"):
+        ctx = trace.mint(tid, sampled=True)
+        wire = trace.fmt(ctx)
+        back = trace.parse(wire)
+        assert back is not None
+        assert back.trace_id == tid
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+
+
+def test_context_sampled_flag_roundtrip():
+    ctx = trace.mint("t1", sampled=False)
+    back = trace.parse(trace.fmt(ctx))
+    assert back is not None and back.sampled is False
+
+
+def test_parse_rejects_malformed_without_raising():
+    bad = [
+        None, "", "00", "00-t", "00-t-span", "99-t-abcdef0123456789-01",
+        "00-t-NOTHEX0123456789-01", "00-t-abcdef0123456789-02",
+        "00--abcdef0123456789-01", "x" * 300, 42,
+    ]
+    for value in bad:
+        assert trace.parse(value) is None
+
+
+def test_child_keeps_trace_changes_span():
+    ctx = trace.mint("t2", sampled=True)
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+    assert kid.sampled is True
+
+
+def test_adopt_prefers_header_falls_back_to_local_mint():
+    ctx = trace.mint("propagated")
+    adopted = trace.adopt({trace.TRACE_HEADER: trace.fmt(ctx)}, "local")
+    assert adopted.trace_id == "propagated"
+    assert adopted.span_id == ctx.span_id
+    # garbage header (or none at all) degrades to a LOCAL root, never
+    # to an error — direct clients get single-process traces for free
+    local = trace.adopt({trace.TRACE_HEADER: "garbage"}, "local")
+    assert local.trace_id == "local"
+    assert trace.adopt({}, "local2").trace_id == "local2"
+
+
+def test_outbound_header_empty_for_none():
+    assert trace.outbound_header(None) == ""
+    assert trace.parse("") is None  # and the empty value parses to None
+
+
+def test_head_sampled_deterministic_and_edge_fracs():
+    assert trace.head_sampled("any", 0.0) is False
+    assert trace.head_sampled("any", 1.0) is True
+    # deterministic: retries of one id must agree with each other
+    for tid in ("a", "b", "req-17"):
+        first = trace.head_sampled(tid, 0.25)
+        assert all(trace.head_sampled(tid, 0.25) == first
+                   for _ in range(5))
+    # and the rate is roughly the dialed fraction over many ids
+    hits = sum(trace.head_sampled(f"id-{i}", 0.25) for i in range(4000))
+    assert 0.15 < hits / 4000 < 0.35
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offset_estimator_recovers_injected_skew():
+    # a server whose clock reads 5s ahead, probed over a symmetric
+    # 40ms round trip: the midpoint estimate recovers the skew exactly
+    t0, rtt, skew = 1000.0, 0.040, 5.0
+    server_stamp = (t0 + rtt / 2) + skew
+    est = trace.estimate_clock_offset(t0, t0 + rtt, server_stamp)
+    assert est == pytest.approx(skew, abs=1e-9)
+
+
+def test_clock_offset_error_bounded_by_half_rtt():
+    # worst-case asymmetry: the server stamps at the very start (or
+    # end) of the exchange — the estimate is off by exactly RTT/2,
+    # the documented honesty bound
+    t0, rtt = 1000.0, 0.040
+    est_early = trace.estimate_clock_offset(t0, t0 + rtt, t0)
+    est_late = trace.estimate_clock_offset(t0, t0 + rtt, t0 + rtt)
+    assert est_early == pytest.approx(-rtt / 2)
+    assert est_late == pytest.approx(rtt / 2)
+
+
+# ---------------------------------------------------------------------------
+# the tail-sampled trace buffer
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_record_get_roundtrip_returns_copies():
+    buf = trace.TraceBuffer(capacity=8, pinned_capacity=4)
+    buf.record_span("t1", "s1", "", "root", 1.0, 2.0, shard=3)
+    got = buf.get("t1")
+    assert got == {
+        "trace_id": "t1", "pinned": False, "reasons": [],
+        "spans": [{"trace_id": "t1", "span_id": "s1", "parent_id": "",
+                   "name": "root", "start_unix": 1.0, "end_unix": 2.0,
+                   "shard": 3}],
+    }
+    got["spans"][0]["name"] = "mutated"
+    assert buf.get("t1")["spans"][0]["name"] == "root"  # copies, not views
+    assert buf.get("never-recorded") is None
+
+
+def test_buffer_evicts_lru_but_pinned_traces_survive():
+    buf = trace.TraceBuffer(capacity=4, pinned_capacity=4)
+    buf.record_span("keep", "s0", "", "root", 1.0, 2.0)
+    assert buf.promote("keep", "error") is True
+    for i in range(16):
+        buf.record_span(f"t{i}", f"s{i}", "", "x", 1.0, 2.0)
+    assert buf.get("t0") is None  # aged out of the recent ring
+    kept = buf.get("keep")
+    assert kept is not None and kept["pinned"] is True
+    assert buf.index()["dropped_traces"] > 0
+
+
+def test_buffer_promote_before_record_attaches_late_spans():
+    # a request that errors before any span completes still promotes;
+    # spans completing afterwards (the hedge loser finishing late)
+    # attach to the pinned trace because the span list is SHARED
+    buf = trace.TraceBuffer(capacity=8, pinned_capacity=4)
+    assert buf.promote("early", "error") is True
+    buf.record_span("early", "s1", "", "late-span", 1.0, 2.0)
+    got = buf.get("early")
+    assert got["pinned"] is True
+    assert [s["name"] for s in got["spans"]] == ["late-span"]
+
+
+def test_buffer_promote_reasons_accumulate_unknown_becomes_manual():
+    buf = trace.TraceBuffer(capacity=8, pinned_capacity=4)
+    buf.record_span("t1", "s1", "", "root", 1.0, 2.0)
+    assert buf.promote("t1", "slow") is True
+    assert buf.promote("t1", "hedged") is False  # already pinned
+    assert buf.promote("t1", "not-a-reason") is False
+    assert buf.get("t1")["reasons"] == ["slow", "hedged", "manual"]
+    assert buf.last_promoted("slow") == "t1"
+
+
+def test_buffer_caps_spans_per_trace():
+    buf = trace.TraceBuffer(capacity=2, pinned_capacity=2)
+    for i in range(trace.MAX_SPANS_PER_TRACE + 10):
+        buf.record_span("hog", f"s{i}", "", "x", 1.0, 2.0)
+    assert len(buf.get("hog")["spans"]) == trace.MAX_SPANS_PER_TRACE
+    assert buf.index()["dropped_spans"] == 10
+
+
+def test_buffer_index_and_report_shapes():
+    buf = trace.TraceBuffer(capacity=8, pinned_capacity=4)
+    buf.record_span("t1", "s1", "", "root", 1.0, 2.0)
+    buf.promote("t1", "partial")
+    idx = buf.index()
+    assert idx["trace_version"] == trace.TRACE_VERSION
+    assert idx["pinned"] == [{
+        "trace_id": "t1", "reasons": ["partial"],
+        "promoted_unix": idx["pinned"][0]["promoted_unix"], "spans": 1,
+    }]
+    assert idx["last_promoted"] == {"partial": "t1"}
+    rep = buf.report("route-partial")
+    assert rep["reason"] == "route-partial"
+    assert [t["trace_id"] for t in rep["traces"]] == ["t1"]
+    assert rep["traces"][0]["spans"][0]["name"] == "root"
+
+
+def test_buffer_rejects_bad_capacities():
+    with pytest.raises(ValueError):
+        trace.TraceBuffer(capacity=0)
+
+
+def test_record_overhead_stays_microscale():
+    # the <2% serving-overhead budget decomposes to a few µs per span
+    # (a request records ~5 spans against ~ms-scale service times);
+    # locally this measures ~3µs — the 25µs bound only catches a
+    # pathological regression (an O(n) scan, an env lookup per span),
+    # not CI scheduling noise
+    buf = trace.TraceBuffer(capacity=64, pinned_capacity=8)
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        buf.record_span(f"t{i % 32}", f"s{i:016x}", "", "bench",
+                        1.0, 2.0, shard=1)
+    per_span = (time.perf_counter() - t0) / n
+    assert per_span < 25e-6, f"record_span took {per_span * 1e6:.1f}µs"
+
+
+def test_active_context_is_thread_local_and_reentrant():
+    outer = trace.mint("outer")
+    inner = trace.mint("inner")
+    assert trace.current() is None
+    with trace.active(outer):
+        assert trace.current() is outer
+        with trace.active(inner):
+            assert trace.current() is inner
+        assert trace.current() is outer
+    assert trace.current() is None
+    with trace.active(None):  # None-safe: branch-free call sites
+        assert trace.current() is None
+
+
+# ---------------------------------------------------------------------------
+# slow tracker (p99-relative tail promotion)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_tracker_cold_process_never_promotes():
+    st = trace.SlowTracker(window=64, min_samples=50)
+    assert not any(st.note(10.0) for _ in range(49))
+
+
+def test_slow_tracker_flags_spike_relative_to_own_window():
+    st = trace.SlowTracker(window=128, quantile=0.99, min_samples=50)
+    for i in range(100):
+        st.note(0.010 + (i % 10) * 1e-4)
+    assert st.note(0.500) is True      # the spike promotes itself
+    assert st.note(0.010) is False     # ordinary traffic still doesn't
+
+
+# ---------------------------------------------------------------------------
+# assembly: skewed clocks, orphans, gaps — and the waterfall over it
+# ---------------------------------------------------------------------------
+
+
+def _assembled_fixture():
+    """Router root [0, 100ms] with one local child covering the first
+    60ms; a shard whose clock reads +5s contributes a 30ms span that —
+    ONLY after offset correction — lands inside the root; plus an
+    orphan whose parent never arrived."""
+    skew = 5.0
+    router_spans = [
+        {"trace_id": "T", "span_id": "root", "parent_id": "",
+         "name": "route/request", "start_unix": 100.0,
+         "end_unix": 100.100},
+        {"trace_id": "T", "span_id": "call0", "parent_id": "root",
+         "name": "route/shard", "start_unix": 100.0,
+         "end_unix": 100.060, "shard": 0, "wave": 1},
+    ]
+    shard_spans = [
+        {"trace_id": "T", "span_id": "serve0", "parent_id": "call0",
+         "name": "serve/request", "start_unix": 100.010 + skew,
+         "end_unix": 100.040 + skew},
+        {"trace_id": "T", "span_id": "lost-kid", "parent_id": "gone",
+         "name": "serve/dispatch", "start_unix": 100.020 + skew,
+         "end_unix": 100.030 + skew},
+    ]
+    return trace.assemble("T", [
+        {"source": "router", "clock_offset_s": 0.0,
+         "spans": router_spans, "error": None},
+        {"source": "shard0", "clock_offset_s": skew,
+         "spans": shard_spans, "error": None},
+        {"source": "shard1", "clock_offset_s": 0.0, "spans": [],
+         "error": "connection refused"},
+    ])
+
+
+def test_assemble_corrects_skew_flags_orphans_and_gaps():
+    out = _assembled_fixture()
+    assert out["assembled"] is True and out["trace_id"] == "T"
+    by_id = {s["span_id"]: s for s in out["spans"]}
+    # the +5s shard span, offset-corrected, nests inside its parent
+    assert by_id["serve0"]["start_unix"] == pytest.approx(100.010)
+    assert (by_id["call0"]["start_unix"]
+            <= by_id["serve0"]["start_unix"]
+            <= by_id["serve0"]["end_unix"]
+            <= by_id["call0"]["end_unix"])
+    assert out["roots"] == ["root"]
+    assert out["orphans"] == ["lost-kid"]  # flagged, not dropped
+    # an unreachable source is an ERROR entry, not a silent shrink
+    meta = {m["source"]: m for m in out["sources"]}
+    assert meta["shard1"]["error"] == "connection refused"
+    assert meta["shard0"]["clock_offset_ms"] == pytest.approx(5000.0)
+    # coverage: the root's direct children account for 60 of 100ms,
+    # and the 40ms tail is a flagged gap
+    cov = out["coverage"]
+    assert cov["root_span_id"] == "root"
+    assert cov["frac"] == pytest.approx(0.6)
+    assert cov["gaps"] == [{"start_ms": 60.0, "end_ms": 100.0}]
+
+
+def test_assemble_dedups_spans_shared_across_sources():
+    # an in-process fleet answers for every source out of ONE buffer:
+    # the same span arriving twice must not double-count coverage
+    span = {"trace_id": "T", "span_id": "s1", "parent_id": "",
+            "name": "route/request", "start_unix": 1.0, "end_unix": 2.0}
+    out = trace.assemble("T", [
+        {"source": "router", "clock_offset_s": 0.0, "spans": [span],
+         "error": None},
+        {"source": "shard0", "clock_offset_s": 0.25, "spans": [span],
+         "error": None},
+    ])
+    assert len(out["spans"]) == 1
+    assert out["spans"][0]["source"] == "router"  # first source wins
+    assert out["spans"][0]["start_unix"] == 1.0   # reference clock
+
+
+def test_render_waterfall_pins_layout_over_assembled_output():
+    text = trace.render_waterfall(_assembled_fixture())
+    lines = text.splitlines()
+    assert lines[0] == "trace T"
+    assert "60% accounted by direct children, 1 gap(s) flagged" in lines[1]
+    # one bar line per span, root first, depth as indentation
+    assert any(line.startswith("route/request ") for line in lines)
+    assert any(line.startswith("    serve/request") for line in lines)
+    assert any("shard=0 wave=1" in line for line in lines)
+    assert any("!orphan" in line for line in lines)
+    assert any("gap: 60.00..100.00ms unaccounted" in line
+               for line in lines)
+    assert any("@shard0" in line for line in lines)
+
+
+def test_render_waterfall_handles_empty_trace():
+    out = trace.assemble("E", [])
+    assert out["coverage"] is None
+    assert "(no spans)" in trace.render_waterfall(out)
+
+
+# ---------------------------------------------------------------------------
+# fleet e2e: in-process 3-shard fleet + router
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def points():
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+
+    return np.asarray(
+        generate_points_rowwise(SEED, DIM, N_SHARDS * SHARD_N)
+    )
+
+
+class _Fleet:
+    def __init__(self, points):
+        from kdtree_tpu.serve import faults as faults_mod
+        from kdtree_tpu.serve import lifecycle
+        from kdtree_tpu.serve import server as srv
+
+        self.servers, self.faults, self.urls = [], [], []
+        for i in range(N_SHARDS):
+            sub = points[i * SHARD_N:(i + 1) * SHARD_N]
+            state = lifecycle.build_state(
+                points=sub, k=K, max_batch=64, id_offset=i * SHARD_N,
+            )
+            fset = faults_mod.FaultSet()
+            httpd = srv.make_server(state, port=0, faults=fset)
+            httpd.start(warmup_buckets=[8])
+            self.servers.append(httpd)
+            self.faults.append(fset)
+            self.urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    def clear_faults(self):
+        for f in self.faults:
+            f.clear()
+
+    def stop(self):
+        for httpd in self.servers:
+            httpd.stop()
+
+
+@pytest.fixture(scope="module")
+def fleet(points):
+    fl = _Fleet(points)
+    yield fl
+    fl.clear_faults()
+    fl.stop()
+
+
+@contextlib.contextmanager
+def _router_for(fleet, **cfg):
+    from kdtree_tpu.serve import router as rt
+
+    defaults = dict(deadline_s=30.0, retries=2, backoff_base_s=0.01,
+                    hedge_min_s=0.05, breaker_failures=2,
+                    breaker_reset_s=0.3, health_period_s=0.2)
+    defaults.update(cfg)
+    router = rt.make_router(fleet.urls, config=rt.RouterConfig(**defaults))
+    router.start(health_loop=False)
+    try:
+        yield router
+    finally:
+        router.stop()
+
+
+def _post_knn(router, payload, headers=None, timeout=60.0):
+    url = f"http://127.0.0.1:{router.server_address[1]}/v1/knn"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_json(router, path, timeout=10.0):
+    url = f"http://127.0.0.1:{router.server_address[1]}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _queries(points, n, seed=0):
+    """n query points spread evenly across the contiguous shard
+    partition (+ jitter), so every shard owns at least one query's
+    neighborhood and the selective fan-out cannot prune any of them —
+    the e2e assertions below count one serve/request PER shard."""
+    idx = np.linspace(0, len(points) - 1, n).astype(int)
+    jitter = np.random.default_rng(seed).normal(0, 1e-3, (n, DIM))
+    return points[idx] + jitter
+
+
+def test_e2e_assembled_trace_links_router_and_shard_spans(fleet, points):
+    tid = "e2e-trace-clean"
+    with _router_for(fleet) as router:
+        status, out = _post_knn(
+            router, {"queries": _queries(points, 4).tolist(), "k": K},
+            headers={"X-Request-Id": tid},
+        )
+        assert status == 200 and out["degraded"] is None
+        code, asm = _get_json(router, f"/debug/trace/{tid}?assemble=1")
+    assert code == 200 and asm["assembled"] is True
+    spans = asm["spans"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # one root, empty parent — the router's route/request
+    (root,) = by_name["route/request"]
+    assert root["parent_id"] == "" and asm["roots"] == [root["span_id"]]
+    assert root["status"] == "ok" and root["contacted"] == N_SHARDS
+    # one scatter attempt per shard, all children of the root
+    calls = by_name["route/shard"]
+    assert {s["shard"] for s in calls} == set(range(N_SHARDS))
+    assert all(s["parent_id"] == root["span_id"] and s["wave"] == 1
+               and s["outcome"] == "ok" for s in calls)
+    # every shard's serve/request parents under the EXACT attempt that
+    # carried it (the per-call child context, not the request root)
+    call_ids = {s["span_id"] for s in calls}
+    serves = by_name["serve/request"]
+    assert len(serves) == N_SHARDS
+    assert all(s["parent_id"] in call_ids for s in serves)
+    # and the shard-internal decomposition hangs off serve/request
+    serve_ids = {s["span_id"] for s in serves}
+    assert all(s["parent_id"] in serve_ids
+               for s in by_name["serve/queue"] + by_name["serve/dispatch"])
+    # the router-side merge is a sibling of the scatter calls
+    (merge,) = by_name["route/merge"]
+    assert merge["parent_id"] == root["span_id"]
+    assert asm["orphans"] == []
+    # the waterfall renders the whole forest without error
+    text = trace.render_waterfall(asm)
+    assert "route/request" in text and "serve/dispatch" in text
+
+
+def test_e2e_hedged_trace_carries_winner_loser_and_decomposes(
+        fleet, points):
+    tid = "e2e-trace-hedged"
+    fleet.faults[1].set_spec("knn=latency:300")
+    try:
+        with _router_for(fleet, deadline_s=10.0,
+                         hedge_min_s=0.05) as router:
+            status, out = _post_knn(
+                router, {"queries": _queries(points, 4, seed=1).tolist(),
+                         "k": K},
+                headers={"X-Request-Id": tid},
+            )
+            assert status == 200 and out["degraded"] is None
+            # the hedge LOSER records its span after the response went
+            # out; the pinned trace shares the live span list, so poll
+            # briefly until both attempts have landed
+            deadline = time.monotonic() + 5.0
+            while True:
+                code, asm = _get_json(
+                    router, f"/debug/trace/{tid}?assemble=1")
+                assert code == 200
+                hedged = [s for s in asm["spans"]
+                          if s["name"] == "route/shard"
+                          and s.get("shard") == 1]
+                if len(hedged) >= 2 or time.monotonic() > deadline:
+                    break
+                time.sleep(0.05)
+    finally:
+        fleet.clear_faults()
+    # launching the hedge tail-promoted the trace
+    assert asm["pinned"] is True and "hedged" in asm["reasons"]
+    # the pair: one primary, one hedge; exactly one winner
+    assert {s["role"] for s in hedged} == {"primary", "hedge"}
+    assert sorted(s["hedge"] for s in hedged) == ["loser", "winner"]
+    # acceptance: the assembled trace decomposes >=90% of the router
+    # wall time, and the slow shard's attempt visibly dominates it
+    cov = asm["coverage"]
+    assert cov is not None and cov["frac"] >= 0.9
+    slow_ms = max((s["end_unix"] - s["start_unix"]) * 1e3
+                  for s in hedged)
+    assert slow_ms >= 0.5 * cov["root_ms"]
+
+
+def test_e2e_partial_promotes_trace_and_writes_companion(fleet, points):
+    tid = "e2e-trace-partial"
+    fleet.faults[2].set_spec("knn=hang")
+    try:
+        with _router_for(fleet, deadline_s=1.0, retries=0) as router:
+            status, out = _post_knn(
+                router, {"queries": _queries(points, 3, seed=2).tolist(),
+                         "k": K},
+                headers={"X-Request-Id": tid},
+            )
+            assert status == 200
+            assert out["degraded"] == f"partial:2/{N_SHARDS}"
+            code, local = _get_json(router, f"/debug/trace/{tid}")
+            assert code == 200
+            assert local["pinned"] is True and "partial" in local["reasons"]
+            # the index names it under last_promoted so --last-slow-style
+            # lookups can find incidents without knowing the id
+            code, idx = _get_json(router, "/debug/trace")
+            assert code == 200
+            assert idx["last_promoted"]["partial"] == tid
+    finally:
+        fleet.clear_faults()
+    # the flight dump grew a trace companion carrying this trace. The
+    # dump claims its rate-limit slot inline but serializes on a
+    # background thread (flight.auto_dump), and the shared session
+    # flight dir may hold a stale companion from an earlier test —
+    # poll until OUR trace lands rather than reading whatever file is
+    # there the instant the response returns.
+    companion = Path(os.environ["KDTREE_TPU_FLIGHT_DIR"]) \
+        / "trace-route-partial.json"
+    rep = None
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if companion.exists():
+            try:
+                rep = json.loads(companion.read_text())
+            except ValueError:  # mid-replace; transient
+                rep = None
+            if rep and tid in [t["trace_id"] for t in rep["traces"]]:
+                break
+        time.sleep(0.05)
+    assert rep is not None and companion.exists()
+    assert rep["reason"] == "route-partial"
+    assert tid in [t["trace_id"] for t in rep["traces"]]
+
+
+def test_e2e_flight_endpoint_filters_by_trace_and_reason(fleet, points):
+    tid = "e2e-flight-filter"
+    with _router_for(fleet) as router:
+        status, _ = _post_knn(
+            router, {"queries": _queries(points, 2, seed=3).tolist(),
+                     "k": K},
+            headers={"X-Request-Id": tid},
+        )
+        assert status == 200
+        code, rep = _get_json(router, f"/debug/flight?trace={tid}")
+        assert code == 200
+        assert rep["filter"] == {"trace": tid, "reason": None,
+                                 "matched": len(rep["events"])}
+        assert rep["events"], "the routed request left no ring events"
+        assert all(
+            e.get("trace") == tid or e.get("trace_id") == tid
+            or tid in (e.get("traces") or ())
+            for e in rep["events"]
+        )
+        # a reason filter that matches nothing returns an EMPTY list,
+        # not an error (the grep-zero-hits contract)
+        code, rep = _get_json(
+            router, "/debug/flight?reason=no-such-reason")
+        assert code == 200 and rep["events"] == []
+
+
+def test_e2e_metrics_openmetrics_flavor_is_opt_in(fleet, points):
+    """``GET /metrics?openmetrics=1`` on a LIVE router returns the
+    OpenMetrics flavor (``# EOF`` terminator + the traced request's
+    exemplar) while the default exposition stays exemplar-free — the
+    endpoint wiring, not just the renderer (which test_obs pins)."""
+    tid = "e2e-openmetrics"
+    with _router_for(fleet) as router:
+        status, _ = _post_knn(
+            router, {"queries": _queries(points, 2, seed=5).tolist(),
+                     "k": K},
+            headers={"X-Request-Id": tid},
+        )
+        assert status == 200
+        base = f"http://127.0.0.1:{router.server_address[1]}/metrics"
+        with urllib.request.urlopen(base + "?openmetrics=1",
+                                    timeout=10.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            om = resp.read().decode("utf-8")
+        assert om.endswith("# EOF\n")
+        assert f'# {{trace_id="{tid}"}}' in om
+        with urllib.request.urlopen(base, timeout=10.0) as resp:
+            assert resp.status == 200
+            default = resp.read().decode("utf-8")
+        assert "# {" not in default and "# EOF" not in default
+
+
+def test_e2e_unknown_trace_404s_with_hint(fleet):
+    with _router_for(fleet) as router:
+        code, body = _get_json(router, "/debug/trace/never-seen")
+        assert code == 404 and "aged out" in body["error"]
+        code, body = _get_json(router,
+                               "/debug/trace/never-seen?assemble=1")
+        assert code == 404
