@@ -115,6 +115,20 @@ R_TRACE_CTX = register(Rule(
     "exactly the hole the assembler exists to flag",
 ))
 
+R_POOL_RELEASE = register(Rule(
+    "KDT111", "pooled-connection-unsafe-reuse", CORRECTNESS,
+    "never pool.release(...) inside an except handler — an exception "
+    "means the exchange state is unknown (request half-sent, body "
+    "undrained, socket mid-close); the only safe disposal there is "
+    "pool.discard(...), which closes instead of parking",
+    "the router's keep-alive pool (PR 17) reuses a connection only "
+    "after a CLEAN fully-drained exchange; a connection released from "
+    "an error path parks a desynchronized HTTP state on the idle list "
+    "and poisons the next lease with the previous request's bytes — "
+    "the discard(reason=...) taxonomy exists precisely so every "
+    "non-clean path (hedge-loser aborts included) is a counted close",
+))
+
 R_SYNC = register(Rule(
     "KDT201", "sync-in-hot-path", PERFORMANCE,
     "no device->host syncs (np.asarray / .item() / block_until_ready / "
@@ -655,6 +669,42 @@ def check_outbound_without_trace_context(ctx) -> Iterator[Finding]:
                 "downstream span from the waterfall — add the header "
                 "(trace.outbound_header(ctx); empty value = untraced)",
             )
+
+
+# --------------------------------------------------------------------------
+# KDT111 — pooled-connection-unsafe-reuse
+# --------------------------------------------------------------------------
+
+
+@checker(R_POOL_RELEASE)
+def check_pooled_release_in_except(ctx) -> Iterator[Finding]:
+    # syntactic contract: a ``<something pool-ish>.release(...)`` call
+    # lexically inside an except handler's body. The receiver must name
+    # a pool (``self.pool``, ``pool``, ``conn_pool``...) so lock
+    # .release() discipline (KDT402's territory) never trips this rule
+    seen: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call) or \
+                        not isinstance(sub.func, ast.Attribute) or \
+                        sub.func.attr != "release":
+                    continue
+                recv = dotted_name(sub.func.value)
+                if "pool" not in recv.lower():
+                    continue
+                if id(sub) in seen:
+                    continue  # nested handlers walk shared statements
+                seen.add(id(sub))
+                yield _mk(
+                    R_POOL_RELEASE, ctx, sub,
+                    f"{recv}.release() inside an except handler parks a "
+                    "connection whose exchange state is unknown — the "
+                    "next lease inherits a half-drained HTTP stream; "
+                    f"use {recv}.discard(...) on every error path",
+                )
 
 
 # --------------------------------------------------------------------------

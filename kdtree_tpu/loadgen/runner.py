@@ -56,7 +56,7 @@ DEFAULT_MAX_INFLIGHT = 64
 DEFAULT_TIMEOUT_S = 10.0
 
 __all__ = ["discover", "run_load", "compute_knee", "scrape_server_block",
-           "CAPACITY_VERSION"]
+           "scrape_pool_counters", "CAPACITY_VERSION"]
 
 
 def _host_port(target: str) -> Tuple[str, int]:
@@ -171,6 +171,26 @@ def _write_base_of(detail: Dict) -> int:
     return int(detail.get("id_offset", 0)) + int(detail.get("n", 0))
 
 
+def _leaf_details(entry: Dict) -> List[Dict]:
+    """The data-bearing leaf healthz details under one router shard
+    entry. A plain shard's own detail carries ``dim`` directly; a
+    replica set's primary may be ejected, so the first serving
+    replica's detail stands in; and under two-level routing the entry
+    is a CHILD ROUTER whose detail is its own aggregated breakdown —
+    recurse, so a parent target sums n over the whole tree."""
+    detail = entry.get("detail") or {}
+    if "dim" in detail:
+        return [detail]
+    for rep in entry.get("replicas") or []:
+        rdetail = rep.get("detail") or {}
+        if "dim" in rdetail:
+            return [rdetail]
+    leaves: List[Dict] = []
+    for sub in detail.get("shards") or []:
+        leaves.extend(_leaf_details(sub))
+    return leaves
+
+
 def discover(
     target: str, timeout_s: float = 5.0, retries: int = 60,
     retry_sleep_s: float = 0.5,
@@ -201,18 +221,7 @@ def discover(
             if "shards" in body:
                 dims, kmaxs, bases, total = [], [], [0], 0
                 for s in body["shards"]:
-                    detail = s.get("detail") or {}
-                    if "dim" not in detail:
-                        # replica sets: the top-level detail describes
-                        # the PRIMARY — a shard whose primary is
-                        # ejected but whose secondaries serve is still
-                        # routable and must still be discoverable
-                        for rep in s.get("replicas") or []:
-                            rdetail = rep.get("detail") or {}
-                            if "dim" in rdetail:
-                                detail = rdetail
-                                break
-                    if "dim" in detail:
+                    for detail in _leaf_details(s):
                         dims.append(int(detail["dim"]))
                         kmaxs.append(int(detail.get("k_max", 1)))
                         total += int(detail.get("n", 0))
@@ -406,6 +415,55 @@ def _max_series(parsed: Dict[str, float], family: str) -> Optional[float]:
     return max(vals) if vals else None
 
 
+def scrape_pool_counters(
+        target: str, timeout_s: float = 2.0
+) -> Optional[Tuple[float, float]]:
+    """One ``/metrics`` scrape distilled to the router's connection-pool
+    counters: ``(hits, misses)`` summed across series. None ONLY when
+    the scrape itself failed; a 200 exposition without either family
+    reads as ``(0, 0)`` — the registry exports counters lazily, so a
+    pre-traffic router legitimately shows neither family at snapshot 0
+    and the first window's deltas must still anchor there. A target
+    that NEVER exports the families (a plain shard, a ``--no-pool``
+    router) nets a zero delta across every window, and ``_reuse_frac``
+    maps that to None: absent evidence, never a fake zero."""
+    try:
+        host, port = _host_port(target)
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            status, text = resp.status, resp.read().decode(
+                "utf-8", "replace")
+        finally:
+            conn.close()
+        if status != 200:
+            return None
+        parsed = _parse_prom_lines(text)
+        hits = _sum_series(parsed, "kdtree_router_pool_hits_total")
+        misses = _sum_series(parsed, "kdtree_router_pool_misses_total")
+        return (hits or 0.0, misses or 0.0)
+    except (OSError, http.client.HTTPException, ValueError):
+        return None
+
+
+def _reuse_frac(
+        start: Optional[Tuple[float, float]],
+        end: Optional[Tuple[float, float]],
+) -> Optional[float]:
+    """Connection-reuse fraction over a [start, end) counter window:
+    hits / (hits + misses) of the DELTAS. None when either snapshot is
+    missing or nothing was leased in the window."""
+    if start is None or end is None:
+        return None
+    hits = end[0] - start[0]
+    misses = end[1] - start[1]
+    attempts = hits + misses
+    if attempts <= 0:
+        return None
+    return round(hits / attempts, 4)
+
+
 def scrape_server_block(target: str,
                         timeout_s: float = 5.0) -> Optional[Dict]:
     """One ``/metrics`` scrape distilled to the write-path evidence the
@@ -485,6 +543,27 @@ def run_load(
         accs[a.step].intended += 1
     lock = threading.Lock()
     work: "queue.Queue" = queue.Queue()
+
+    # connection-reuse evidence: pool-counter snapshots at each step
+    # boundary (docs/SERVING.md "Scaling the router"). The boundary
+    # scrapes run on their own daemon threads so the open-loop
+    # dispatcher never blocks on a GET; snapshot 0 and the final one
+    # bracket the run synchronously (outside the measured window).
+    # Attribution at a boundary is approximate by design — responses
+    # from step N may still land after step N+1 opened — which is fine
+    # for a fraction that moves by tens of points between the pooled
+    # and --no-pool arms.
+    pool_snaps: Dict[int, Tuple[float, float]] = {}
+    snap_threads: List[threading.Thread] = []
+
+    def snap_boundary(step: int) -> None:
+        got = scrape_pool_counters(target)
+        if got is not None:
+            with lock:
+                pool_snaps[step] = got
+
+    if scrape:
+        snap_boundary(0)
     t0 = time.monotonic()
 
     def record(arrival, intended: float, tags: List[str],
@@ -567,6 +646,12 @@ def run_load(
     try:
         for seq, arrival in enumerate(schedule.arrivals):
             if arrival.step != current_step:
+                if scrape and arrival.step > 0:
+                    st = threading.Thread(
+                        target=snap_boundary, args=(arrival.step,),
+                        name="kdtree-loadgen-poolsnap", daemon=True)
+                    st.start()
+                    snap_threads.append(st)
                 current_step = arrival.step
                 rate = schedule.rates[current_step]
                 flight.record("loadgen.step", step=current_step,
@@ -586,8 +671,13 @@ def run_load(
         for t in threads:
             t.join()
 
+    if scrape:
+        for st in snap_threads:
+            st.join(timeout=5.0)
+        snap_boundary(len(accs))
+
     steps = []
-    for acc in accs:
+    for si, acc in enumerate(accs):
         sent = acc.sent
         bad = (acc.counts["shed"] + acc.counts["errors"]
                + acc.counts["timeouts"])
@@ -627,6 +717,12 @@ def run_load(
                                  else None),
             "slowest_ms": (round(acc.slowest[0], 3) if acc.slowest
                            else None),
+            # connection-reuse fraction of the step's shard attempts
+            # (pool hits / leases, from the target's own counters);
+            # None against a pool-less target or when a boundary
+            # scrape was lost — absent evidence, never a fake zero
+            "conn_reuse_frac": _reuse_frac(pool_snaps.get(si),
+                                           pool_snaps.get(si + 1)),
         }
         steps.append(row)
     knee = compute_knee(steps, slo_ms=slo_ms, slo_quantile=slo_quantile,
@@ -645,6 +741,11 @@ def run_load(
         # toward full scatter fails trend like a throughput cliff
         "fanout_frac": (round(float(np.mean(all_fanout)), 4)
                         if all_fanout else None),
+        # run-level connection-reuse fraction over the whole ladder
+        # (additive key, same versioning posture as fanout_frac): the
+        # pooled-vs---no-pool A/B's second axis next to the knee
+        "conn_reuse_frac": _reuse_frac(
+            pool_snaps.get(0), pool_snaps.get(len(accs))),
         "steps": steps,
         "server": server_block,
     }

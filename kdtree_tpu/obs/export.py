@@ -256,6 +256,19 @@ METRIC_HELP = {
     "kdtree_router_shards_pruned_total":
         "shard sets skipped because their bounding-box lower bound "
         "provably cleared the running k-th best distance",
+    # router scale-out (docs/SERVING.md "Scaling the router")
+    "kdtree_router_pool_hits_total":
+        "shard attempts served off a pooled keep-alive connection "
+        "(the loadgen reuse-fraction numerator)",
+    "kdtree_router_pool_misses_total":
+        "shard attempts that opened a fresh connection (empty or "
+        "stale pool)",
+    "kdtree_router_pool_discards_total":
+        "pooled connections closed instead of reused, by reason "
+        "(stale/abort/error/full/undrained/shutdown)",
+    "kdtree_router_spec_wave_total":
+        "speculative wave-2 launches by outcome (needed = the exact "
+        "widen decision wanted that shard anyway; wasted = it did not)",
     # snapshots & replica fleets (docs/SERVING.md)
     "kdtree_snapshot_saves_total": "serving snapshots written",
     "kdtree_snapshot_loads_total": "serving snapshots loaded",

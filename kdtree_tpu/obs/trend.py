@@ -18,10 +18,20 @@ series of bench artifacts and flags exactly that class of silent decay:
 - **capacity-drop**: the load harness's knee rate (the highest offered
   rate still meeting the latency SLO — ``kdtree-tpu loadgen``,
   docs/OBSERVABILITY.md "Load harness & capacity curves") falling
-  beyond the band vs the *previous capacity-bearing* run. Capacity
-  blocks are schema-versioned and optional: a series mixing plain
-  bench sidecars with loadgen reports compares capacity only where it
-  was measured — old artifacts parse exactly as before.
+  beyond the band vs the *previous capacity-bearing* run carrying the
+  same ``variant`` label (``loadgen --variant``; unlabeled runs chain
+  among themselves) — the committed A/B arms are deliberately distinct
+  configurations, not points on one trajectory. Capacity blocks are
+  schema-versioned and optional: a series mixing plain bench sidecars
+  with loadgen reports compares capacity only where it was measured —
+  old artifacts parse exactly as before.
+- **knee-drop**: a loadgen run that EMBEDS an A/B baseline (``loadgen
+  --ab-baseline``, the ``capacity.ab`` block) claims its arm beats
+  that baseline; the gate holds it to the claim — the run's knee must
+  be strictly higher, or tie with a strictly lower p99 at the knee
+  rate. Judged per run against its own embedded anchor (not against a
+  neighboring run), so a committed pooled-vs-fresh artifact keeps
+  failing CI the day pooling stops paying for itself.
 - **recall-drop**: the recall harness's measured recall@k at a visit
   cap (``kdtree-tpu recall``'s sidecar ``recall`` block) falling more
   than ``RECALL_DROP_BAND`` *absolute* vs the previous recall-bearing
@@ -32,7 +42,8 @@ series of bench artifacts and flags exactly that class of silent decay:
 - **fanout-growth**: the router's mean contacted-shard fraction (the
   loadgen capacity block's ``fanout_frac`` — docs/SERVING.md "Spatial
   sharding & selective fan-out") GROWING more than
-  ``FANOUT_GROWTH_BAND`` absolute vs the previous fanout-bearing run:
+  ``FANOUT_GROWTH_BAND`` absolute vs the previous fanout-bearing run
+  of the same variant (per-variant cursors, like capacity's):
   a regression back toward full scatter — a broken box contract, a
   partitioner that stopped separating regions, or a widening rule
   gone timid — costs the fleet its sub-linear scaling exactly like a
@@ -212,7 +223,25 @@ def _capacity_facts(cap) -> Optional[dict]:
         fanout = None if fanout is None else float(fanout)
     except (TypeError, ValueError):
         fanout = None
+    ab = cap.get("ab")
+    ab_facts = None
+    if isinstance(ab, dict):
+        try:
+            ab_facts = {
+                "baseline_knee_rate": float(ab["baseline_knee_rate"]),
+                "baseline_file": ab.get("baseline_file"),
+                "baseline_variant": ab.get("baseline_variant"),
+                "baseline_p99_ms_at_knee": (
+                    None if ab.get("baseline_p99_ms_at_knee") is None
+                    else float(ab["baseline_p99_ms_at_knee"])),
+            }
+        except (KeyError, TypeError, ValueError):
+            ab_facts = None  # malformed A/B anchors read as absent
     return {"knee_rate": knee, "steps": steps,
+            # this run's declared A/B arm + embedded baseline (loadgen
+            # --variant / --ab-baseline): the knee-drop rule's input
+            "variant": cap.get("variant"),
+            "ab": ab_facts,
             "slo_ms": cap.get("slo_ms"),
             # mean contacted-shard fraction of the run's routed
             # queries (None for pre-fanout artifacts and plain shard
@@ -384,13 +413,21 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     f"{cr:g} (a warm steady state holds this flat)",
                 ))
     # capacity blocks compare against the PREVIOUS capacity-bearing run
-    # (not strictly-consecutive: a series legitimately interleaves plain
-    # bench sidecars, which carry no curve, with loadgen reports)
-    prev_cap = None
+    # OF THE SAME VARIANT (not strictly-consecutive: a series
+    # legitimately interleaves plain bench sidecars, which carry no
+    # curve, with loadgen reports). The variant label (loadgen
+    # --variant) names a deliberately distinct configuration — the
+    # committed BENCH_router_* A/B arms differ by topology and shard
+    # count, and chaining a 16-shard pooled knee into a 64-shard
+    # hierarchical one would mint a drop that no code change caused.
+    # Unlabeled artifacts (variant None, the pre-A/B series) keep
+    # chaining among themselves exactly as before.
+    prev_caps: dict = {}
     for cur in runs:
         cap = cur.get("capacity")
         if not cap:
             continue
+        prev_cap = prev_caps.get(cap.get("variant"))
         if prev_cap is not None:
             pknee = prev_cap[1].get("knee_rate")
             cknee = cap.get("knee_rate")
@@ -411,16 +448,20 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     "service meets its latency SLO at a lower offered "
                     "load than it used to",
                 ))
-        prev_cap = (cur, cap)
-    # fan-out compares against the previous FANOUT-bearing run — its
-    # own cursor, like recall's: a plain-shard loadgen artifact (which
-    # carries a capacity block but no fan-out) interposed between two
-    # router runs must neither be compared nor reset the baseline
-    prev_fan = None
+        prev_caps[cap.get("variant")] = (cur, cap)
+    # fan-out compares against the previous FANOUT-bearing run of the
+    # same variant — its own cursor, like recall's: a plain-shard
+    # loadgen artifact (which carries a capacity block but no fan-out)
+    # interposed between two router runs must neither be compared nor
+    # reset the baseline, and distinct A/B arms (see the capacity
+    # chain above) legitimately sit at different fan-out fractions
+    prev_fans: dict = {}
     for cur in runs:
-        cfan = (cur.get("capacity") or {}).get("fanout_frac")
+        ccap = cur.get("capacity") or {}
+        cfan = ccap.get("fanout_frac")
         if cfan is None:
             continue
+        prev_fan = prev_fans.get(ccap.get("variant"))
         if prev_fan is not None:
             pfan = prev_fan[1]
             if cfan - pfan > FANOUT_GROWTH_BAND:
@@ -433,7 +474,45 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     "scatter — selective fan-out's sub-linear scaling "
                     "is eroding",
                 ))
-        prev_fan = (cur, cfan)
+        prev_fans[ccap.get("variant")] = (cur, cfan)
+    # the A/B knee gate judges each run AGAINST ITS OWN EMBEDDED
+    # baseline (loadgen --ab-baseline), not against a neighboring run:
+    # the artifact itself claims "this arm beats that arm", and the
+    # gate holds it to the claim — strictly higher knee, or tied knees
+    # with a strictly lower p99 at the knee rate (the two ways a
+    # faster hot path shows up on a ladder whose top step both arms
+    # clear)
+    for cur in runs:
+        cap = cur.get("capacity")
+        ab = (cap or {}).get("ab")
+        if not ab:
+            continue
+        cknee = cap.get("knee_rate")
+        if cknee is None:
+            continue
+        bknee = ab["baseline_knee_rate"]
+        base_label = str(ab.get("baseline_variant")
+                         or ab.get("baseline_file") or "ab-baseline")
+        if cknee > bknee:
+            continue
+        verdict = (f"A/B knee {bknee:g} -> {cknee:g} req/s vs its "
+                   "embedded baseline")
+        if cknee == bknee:
+            bp99 = ab.get("baseline_p99_ms_at_knee")
+            cp99 = next((s.get("p99_ms")
+                         for s in cap.get("steps") or []
+                         if s.get("rate") == cknee), None)
+            if bp99 is not None and cp99 is not None and cp99 < bp99:
+                continue  # tied knees, strictly better tail: a win
+            verdict = (f"A/B knee tied at {cknee:g} req/s with no "
+                       "strictly-lower p99 at that rate"
+                       + (f" ({bp99:g} -> {cp99:g} ms)"
+                          if bp99 is not None and cp99 is not None
+                          else ""))
+        findings.append(_finding(
+            "knee-drop", "capacity:ab", {"label": base_label}, cur,
+            f"{verdict}: the arm this run claims to beat still wins",
+        ))
     # recall curves compare against the PREVIOUS recall-bearing run
     # (same interleaving tolerance as capacity), at matching visit
     # caps, with the ABSOLUTE band — recall on a seeded shape is

@@ -97,7 +97,7 @@ import random
 import re
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 from urllib.parse import urlparse
 
 import numpy as np
@@ -106,6 +106,7 @@ from kdtree_tpu import obs
 from kdtree_tpu.analysis import lockwatch
 from kdtree_tpu.obs import flight
 from kdtree_tpu.obs import trace as trace_mod
+from kdtree_tpu.serve import pool as pool_mod
 from kdtree_tpu.serve import spatial
 from kdtree_tpu.serve.server import (
     GracefulHTTPServer,
@@ -469,6 +470,11 @@ class RouterConfig:
         health_period_s: float = DEFAULT_HEALTH_PERIOD_S,
         fanout: str = "selective",
         trace_frac: float = 0.0,
+        pool: bool = True,
+        pool_max_idle: int = pool_mod.DEFAULT_MAX_IDLE,
+        pool_idle_reuse_s: float = pool_mod.DEFAULT_IDLE_REUSE_S,
+        spec_wave: bool = True,
+        parent: bool = False,
     ) -> None:
         if fanout not in FANOUT_MODES:
             raise ValueError(
@@ -498,6 +504,17 @@ class RouterConfig:
                 f"trace_frac must be in [0, 1], got {trace_frac}"
             )
         self.trace_frac = float(trace_frac)
+        # hot-path scale-out knobs (docs/SERVING.md "Scaling the
+        # router"): keep-alive pooling ON by default (--no-pool is the
+        # A/B's fresh arm and the operator's big-red-switch), the
+        # speculative widening wave likewise, and --parent marks the
+        # downstream targets as CHILD ROUTERS (two-level routing) —
+        # federation then scrapes them deep and labels per child.
+        self.pool = bool(pool)
+        self.pool_max_idle = int(pool_max_idle)
+        self.pool_idle_reuse_s = float(pool_idle_reuse_s)
+        self.spec_wave = bool(spec_wave)
+        self.parent = bool(parent)
 
     def resolve_quorum(self, n_shards: int) -> int:
         if self.quorum is not None:
@@ -642,7 +659,22 @@ class RouterHandler(JsonRequestHandler):
             "available": available,
             "quorum": rt.quorum,
             "total": len(shards),
+            # a PARENT router health-probes this router exactly like a
+            # shard (docs/SERVING.md "Scaling the router"): stamp the
+            # wall clock for its RTT-midpoint skew estimate
+            "server_unix": time.time(),
         }
+        # ... and publish the fleet's bounding box (the union over the
+        # shard sets') so the parent's point-to-box pruning recurses.
+        # Only when EVERY set has a box: a boxless set holds data the
+        # union does not cover, and advertising a partial union would
+        # let the parent prune a subtree that still owns candidates.
+        set_boxes = [s.box() for s in rt.shard_sets]
+        if set_boxes and all(b is not None for b in set_boxes):
+            u = spatial.box_union(set_boxes)
+            if u is not None:
+                body["box"] = {"lo": [float(x) for x in u[0]],
+                               "hi": [float(x) for x in u[1]]}
         if rt.slo_engine is not None:
             body["slo"] = rt.slo_engine.health_block()
         self._send_json(200 if available >= rt.quorum else 503, body)
@@ -661,14 +693,23 @@ class RouterHandler(JsonRequestHandler):
         trace = _trace_id(self.headers)
         # the router MINTS the fleet's trace context (it is the root of
         # every fan-out): head-sampled at --trace-frac, tail-promoted
-        # regardless at response time (obs/trace.py)
+        # regardless at response time (obs/trace.py). Under two-level
+        # routing the PARENT is the root — a child router ADOPTS the
+        # propagated context instead, so its spans parent under the
+        # parent's route/shard bar in one waterfall.
         ctx = None
         if trace_mod.enabled():
-            ctx = trace_mod.mint(
-                trace,
-                sampled=trace_mod.head_sampled(
-                    trace, self.server.config.trace_frac),
-            )
+            inbound = trace_mod.parse(
+                self.headers.get(trace_mod.TRACE_HEADER))
+            if inbound is not None:
+                ctx = inbound
+                trace = inbound.trace_id
+            else:
+                ctx = trace_mod.mint(
+                    trace,
+                    sampled=trace_mod.head_sampled(
+                        trace, self.server.config.trace_frac),
+                )
         try:
             length = int(self.headers.get("Content-Length", ""))
         except ValueError:
@@ -791,6 +832,15 @@ class Router(GracefulHTTPServer):
             "kdtree_router_shards_contacted", buckets=_FANOUT_BUCKETS,
         )
         self._pruned = reg.counter("kdtree_router_shards_pruned_total")
+        # the shard-call connection pool (serve/pool.py): leases ride
+        # inside _call_shard; None = fresh-connection mode (the A/B's
+        # control arm, and PR 9's exact behavior)
+        self.pool: Optional[pool_mod.ConnectionPool] = (
+            pool_mod.ConnectionPool(
+                max_idle=self.config.pool_max_idle,
+                idle_reuse_s=self.config.pool_idle_reuse_s,
+            ) if self.config.pool else None
+        )
         self.slo_engine = slo_engine
         self._serve_thread: Optional[threading.Thread] = None
         self._health_thread: Optional[threading.Thread] = None
@@ -883,13 +933,19 @@ class Router(GracefulHTTPServer):
         abort_check=None, path: str = "/v1/knn", tp: str = "",
     ) -> dict:
         """One HTTP attempt against one shard; returns the parsed
-        payload or raises :class:`ShardError`. The connection is stored
-        in ``conn_box`` (so a hedging race can abort the loser) and
-        always closed here — the router never pools, so shutdown can
-        never orphan a shard connection. ``abort_check`` (checked after
-        registering the connection) lets a hedge loser that registered
-        AFTER the winner's close sweep abort itself instead of running
-        a redundant full request."""
+        payload or raises :class:`ShardError`. The connection handle is
+        stored in ``conn_box`` (so a hedging race can abort the loser)
+        and always disposed here — released to the keep-alive pool
+        after a clean fully-drained exchange, closed-and-discarded on
+        every other path — so shutdown can never orphan a shard
+        connection. ``abort_check`` (checked after registering the
+        connection) lets a hedge loser that registered AFTER the
+        winner's close sweep abort itself instead of running a
+        redundant full request. A REUSED pooled connection that fails
+        before any response byte (the shard restarted, or its idle
+        reaper won the keep-alive race) is transparently retried ONCE
+        on a fresh connection: a stale socket costs one extra
+        round-trip, never a false shard failure at ``retries=0``."""
         import http.client
 
         # the per-replica spread counter (CI's replica-smoke asserts
@@ -901,16 +957,34 @@ class Router(GracefulHTTPServer):
             labels=shard.replica_label(),
         ).inc()
         t0 = time.monotonic()
-        conn = http.client.HTTPConnection(
-            shard.host, shard.port, timeout=max(timeout_s, 0.001)
-        )
-        if conn_box is not None:
-            conn_box[tag] = conn
-        if abort_check is not None and abort_check():
-            conn.close()
-            raise ShardError(f"shard {shard.index}: hedge twin already won",
-                             outcome="network")
-        try:
+        attempt = 0
+        while True:
+            attempt += 1
+            budget = max(timeout_s - (time.monotonic() - t0), 0.001)
+            pc: Optional[pool_mod.PooledConn] = None
+            if self.pool is not None:
+                pc = self.pool.lease(shard.host, shard.port, budget)
+                conn = pc.conn
+            else:
+                conn = http.client.HTTPConnection(
+                    shard.host, shard.port, timeout=budget
+                )
+            if conn_box is not None:
+                # the POOLED handle (not the raw connection) is what a
+                # hedge winner's close sweep gets: PooledConn.close()
+                # marks the lease dead too, so an aborted twin's socket
+                # can never be returned dirty — even if the abort races
+                # a release that already parked it on the idle list
+                conn_box[tag] = pc if pc is not None else conn
+            if abort_check is not None and abort_check():
+                if pc is not None:
+                    self.pool.discard(pc, "abort")
+                else:
+                    conn.close()
+                raise ShardError(
+                    f"shard {shard.index}: hedge twin already won",
+                    outcome="network")
+            reused = pc is not None and pc.reused
             try:
                 conn.request(
                     "POST", path, body=body,
@@ -930,6 +1004,21 @@ class Router(GracefulHTTPServer):
                 # connections, resets, AND injected drops (the server
                 # closing without a status line surfaces as
                 # BadStatusLine below or a bare OSError here)
+                aborted = pc is not None and pc.dead
+                if pc is not None:
+                    self.pool.discard(
+                        pc, "abort" if aborted
+                        else ("stale" if reused else "error"))
+                else:
+                    conn.close()
+                if (reused and not aborted and attempt == 1
+                        and not isinstance(e, TimeoutError)
+                        and timeout_s - (time.monotonic() - t0) > 0):
+                    # stale keep-alive reuse: crisp retry, fresh socket
+                    flight.record("route.pool_stale_retry",
+                                  shard=shard.index,
+                                  replica=shard.replica, trace=trace)
+                    continue
                 outcome = ("timeout"
                            if isinstance(e, TimeoutError) else "network")
                 raise ShardError(f"shard {shard.index}: {e!r}",
@@ -944,10 +1033,36 @@ class Router(GracefulHTTPServer):
                 # concurrent close() already set to None ('NoneType'
                 # has no attribute 'close'); escaping here killed the
                 # hedge thread (caught by the blue/green fleet e2e).
+                aborted = pc is not None and pc.dead
+                if pc is not None:
+                    self.pool.discard(
+                        pc, "abort" if aborted
+                        else ("stale" if reused else "error"))
+                else:
+                    conn.close()
+                if (reused and not aborted and attempt == 1
+                        and timeout_s - (time.monotonic() - t0) > 0):
+                    # BadStatusLine("") IS the canonical symptom of a
+                    # keep-alive connection the server already hung up
+                    flight.record("route.pool_stale_retry",
+                                  shard=shard.index,
+                                  replica=shard.replica, trace=trace)
+                    continue
                 raise ShardError(f"shard {shard.index}: {e!r}",
                                  outcome="network") from None
-        finally:
-            conn.close()
+            # the exchange completed and resp.read() drained the body
+            # to EOF above — the one state a pooled connection may be
+            # returned from (release itself still refuses will_close,
+            # abort-marked, and shutdown-raced handles)
+            if pc is not None:
+                if resp.will_close or pc.dead:
+                    self.pool.discard(
+                        pc, "abort" if pc.dead else "error")
+                else:
+                    self.pool.release(pc, drained=True)
+            else:
+                conn.close()
+            break
         if status == 429:
             retry_after = None
             try:
@@ -991,6 +1106,7 @@ class Router(GracefulHTTPServer):
         self, shard: ShardState, body: bytes, deadline: float, trace: str,
         allow_hedge: bool = True, hedge_shard: Optional[ShardState] = None,
         ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
+        spec: bool = False,
     ) -> Tuple[dict, ShardState]:
         """One logical attempt = a primary call plus (maybe) one hedge.
         The first success wins and the loser's connection is closed;
@@ -1073,6 +1189,9 @@ class Router(GracefulHTTPServer):
                         hedge=("winner" if result.get("winner") == tag
                                else "loser"),
                         outcome=outcome,
+                        # mark speculative wave-2 calls so a waterfall
+                        # shows which bars were hedge-style bets
+                        **({"spec": True} if spec else {}),
                     )
 
         primary = threading.Thread(
@@ -1145,6 +1264,7 @@ class Router(GracefulHTTPServer):
     def _shard_task(
         self, sset: ReplicaSet, body: bytes, deadline: float, trace: str,
         ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
+        spec: bool = False,
     ):
         """The full per-shard policy, replica-aware: pick a routable
         replica round-robin (ejection and breaker checks per replica),
@@ -1193,7 +1313,7 @@ class Router(GracefulHTTPServer):
                     # aim the hedge at a sibling replica when one is
                     # routable (None falls back to the same process)
                     hedge_shard=sset.hedge_candidate(shard),
-                    ctx=ctx, wave=wave,
+                    ctx=ctx, wave=wave, spec=spec,
                 )
             except ShardError as e:
                 last = e
@@ -1260,6 +1380,8 @@ class Router(GracefulHTTPServer):
         self, indices: List[int], body: bytes, deadline: float,
         trace: str, results: List[Optional[object]],
         ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
+        spec: bool = False,
+        on_done: Optional[Callable[[], None]] = None,
     ) -> List[threading.Thread]:
         """Launch one concurrent scatter wave over the named shard
         sets; results land in ``results`` by set index (waves touch
@@ -1267,13 +1389,18 @@ class Router(GracefulHTTPServer):
         joins via :meth:`_scatter_join` — possibly earlier than the
         request deadline, so a hung wave-1 shard cannot starve the
         widening wave of its budget (stragglers keep running against
-        the full deadline and are harvested by the final join)."""
+        the full deadline and are harvested by the final join).
+        ``on_done`` fires after EACH task's result lands — the
+        speculative widening loop wakes on it instead of sleeping out
+        its timer."""
         threads = []
         for i in indices:
             def task(s=self.shard_sets[i]):
                 results[s.index] = self._shard_task(s, body, deadline,
                                                     trace, ctx=ctx,
-                                                    wave=wave)
+                                                    wave=wave, spec=spec)
+                if on_done is not None:
+                    on_done()
 
             t = threading.Thread(target=task, name="kdtree-route-scatter")
             t.start()
@@ -1342,6 +1469,119 @@ class Router(GracefulHTTPServer):
                  else np.full(nq, np.inf))
         return worst, ~np.isfinite(worst)
 
+    # -- speculative overlapped wave 2 ---------------------------------------
+
+    def _spec_delay(self, wave1: List[int]) -> float:
+        """Hedge-style speculative delay: the largest p95-floored hedge
+        delay across the wave-1 sets' replicas. By then the wave has
+        answered with high probability — responses still missing are
+        straggler evidence, and wave 2 fires on the conservative widen
+        decision instead of waiting out the half-budget join."""
+        d = self.config.hedge_min_s
+        for i in wave1:
+            for r in self.shard_sets[i].replicas:
+                d = max(d, r.hedge_delay())
+        return d
+
+    def _optimistic_worst(
+        self, payloads: List[dict],
+        pending_lbs: List[Optional[np.ndarray]],
+        nq: int, k: Optional[int],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """A LOWER bound on the final per-query k-th best distance
+        while some wave-1 shards are still unanswered: each pending
+        shard is assumed to deliver k candidates AT its box lower
+        bound — the best it could possibly do (a pending legacy shard,
+        boxless, is assumed to deliver k zero-distance candidates).
+        The true merge can only land at or above this bound, and the
+        assumed candidate counts can only overstate fill, so a
+        remaining shard that clears the strict-tie needed-mask against
+        THIS (worst, short) is in the exact widen decision no matter
+        what the stragglers answer — launching it early is provably
+        never waste."""
+        kk = (int(k) if k is not None
+              else min(p["k"] for p in payloads) if payloads else None)
+        if kk is None:
+            # nothing answered and no explicit k: no sound bound yet —
+            # worst=0/short=False proves nothing (only lb==0 shards
+            # would qualify, and those are already in wave 1)
+            return np.zeros(nq), np.zeros(nq, dtype=bool)
+        fakes = []
+        for lb in pending_lbs:
+            d = (np.tile(lb.astype(np.float64)[:, None], (1, kk))
+                 if lb is not None else np.zeros((nq, kk)))
+            fakes.append({"k": kk, "distances": d,
+                          "ids": np.zeros((nq, kk), dtype=np.int64)})
+        return self._running_worst(list(payloads) + fakes, nq, kk)
+
+    def _spec_overlap(
+        self, wave1: List[int], remaining: List[int],
+        lbs: List[Optional[np.ndarray]], nq: int, k: Optional[int],
+        body: bytes, deadline: float, half_by: float, trace: str,
+        results: List[Optional[object]], cond,
+        ctx: Optional[trace_mod.TraceContext],
+    ) -> Tuple[List[threading.Thread], Set[int]]:
+        """Overlap the widening wave with wave 1 instead of paying a
+        serial second RTT. Wakes on every wave-1 completion and
+        launches wave-2 calls on two triggers, both preserving the
+        exact merge's byte-identity (contacting a SUPERSET of the
+        exact decision never changes an exact merge):
+
+        - **proven**: the optimistic bound (:meth:`_optimistic_worst`)
+          already shows the shard is in the final widen decision —
+          launch immediately, provably never waste.
+        - **hedge**: past the p95-derived delay (:meth:`_spec_delay`)
+          stragglers are being waited out — launch the conservative
+          decision computed from the answers so far (a superset of the
+          final decision: fewer payloads can only leave ``worst``
+          larger). After it, no unseen answer can make another shard
+          needed, so the loop ends.
+
+        Returns (threads, launched). The caller charges each
+        speculative launch to ``kdtree_router_spec_wave_total`` at
+        merge time, once the full wave-1 evidence settles the exact
+        decision (needed) or refutes it (wasted)."""
+        spec_by = min(half_by, time.monotonic() + self._spec_delay(wave1))
+        launched: Set[int] = set()
+        threads: List[threading.Thread] = []
+
+        def fire(need: List[int], trigger: str) -> None:
+            flight.record("route.spec_wave", trace=trace,
+                          launched=list(need), trigger=trigger)
+            threads.extend(self._scatter_start(
+                need, body, deadline, trace, results, ctx=ctx, wave=2,
+                spec=True))
+            launched.update(need)
+
+        while True:
+            unanswered = [i for i in wave1 if results[i] is None]
+            todo = [i for i in remaining if i not in launched]
+            if not unanswered or not todo:
+                break
+            now = time.monotonic()
+            if now >= half_by:
+                break
+            payloads1 = [results[i] for i in wave1
+                         if isinstance(results[i], dict)]
+            opt_worst, opt_short = self._optimistic_worst(
+                payloads1, [lbs[u] for u in unanswered], nq, k)
+            proven, _ = spatial.widen_wave(lbs, todo, opt_worst,
+                                           opt_short, None)
+            if proven:
+                fire(proven, "proven")
+                continue
+            if now >= spec_by:
+                worst, short = self._running_worst(payloads1, nq, k)
+                need, _ = spatial.widen_wave(lbs, todo, worst, short,
+                                             None)
+                if need:
+                    fire(need, "hedge")
+                break
+            with cond:
+                cond.wait(timeout=max(min(spec_by, half_by)
+                                      - time.monotonic(), 0.0))
+        return threads, launched
+
     @staticmethod
     def _spatial_gear(gear: Optional[str],
                       target: Optional[float]) -> Optional[str]:
@@ -1382,6 +1622,9 @@ class Router(GracefulHTTPServer):
                     for b in boxes)
         )
         spatial_cut = 0
+        spec_launched: Set[int] = set()
+        wave1: List[int] = []
+        lbs: List[Optional[np.ndarray]] = []
         if selective:
             # per-set lower-bound distances; None = legacy/unprobed set
             # (no box, no pruning argument — ALWAYS contacted)
@@ -1393,9 +1636,22 @@ class Router(GracefulHTTPServer):
             ]
             wave1 = spatial.initial_wave(lbs)
             contacted = sorted(wave1)
-            threads = self._scatter_start(wave1, body, deadline, trace,
-                                          results, ctx=ctx)
             remaining = [i for i in range(n) if i not in set(wave1)]
+            # speculation is exactness-only: under a recall target the
+            # widening may STOP early, and a speculative superset would
+            # contact shards the truncated decision deliberately skips
+            spec_on = bool(self.config.spec_wave and remaining
+                           and recall_target is None)
+            cond = (lockwatch.make_condition("route.spec")
+                    if spec_on else None)
+
+            def _wake() -> None:
+                with cond:
+                    cond.notify_all()
+
+            threads = self._scatter_start(
+                wave1, body, deadline, trace, results, ctx=ctx,
+                on_done=_wake if spec_on else None)
             if remaining:
                 # wave 1 gets at most HALF the remaining budget while
                 # a widening wave may still need the rest: one hung
@@ -1406,19 +1662,28 @@ class Router(GracefulHTTPServer):
                 # conservative, and its late answer still merges (the
                 # final join below harvests stragglers).
                 now = time.monotonic()
-                self._scatter_join(threads,
-                                   min(deadline, now + (deadline - now) / 2))
-                payloads1 = [results[i] for i in contacted
+                half_by = min(deadline, now + (deadline - now) / 2)
+                if spec_on:
+                    spec_threads, spec_launched = self._spec_overlap(
+                        wave1, remaining, lbs, queries.shape[0], k,
+                        body, deadline, half_by, trace, results, cond,
+                        ctx)
+                    threads += spec_threads
+                self._scatter_join(threads, half_by)
+                payloads1 = [results[i] for i in wave1
                              if isinstance(results[i], dict)]
                 worst, short = self._running_worst(
                     payloads1, queries.shape[0], k)
+                todo = [i for i in remaining if i not in spec_launched]
                 wave2, spatial_cut = spatial.widen_wave(
-                    lbs, remaining, worst, short, recall_target)
+                    lbs, todo, worst, short, recall_target)
                 if wave2:
                     threads += self._scatter_start(wave2, body, deadline,
                                                    trace, results,
                                                    ctx=ctx, wave=2)
-                    contacted = sorted(set(contacted) | set(wave2))
+                if wave2 or spec_launched:
+                    contacted = sorted(set(contacted) | set(wave2)
+                                       | spec_launched)
                     if ctx is not None:
                         # a widening wave is tail evidence too: the
                         # pruning argument failed to close on wave 1
@@ -1439,6 +1704,27 @@ class Router(GracefulHTTPServer):
         # ONE snapshot: a laggard task finishing between two reads of
         # `results` must not let the merge and the missing-list disagree
         snapshot = list(results)
+        if spec_launched:
+            # charge each speculative launch now that the full wave-1
+            # evidence is in: the exact widen decision recomputed over
+            # every answered wave-1 payload either wanted the shard
+            # (needed — speculation saved its serial RTT) or not
+            # (wasted — the hedge-style bet lost; the answer is still
+            # byte-identical, a superset only costs shard work)
+            payloads1f = [snapshot[i] for i in wave1
+                          if isinstance(snapshot[i], dict)]
+            worst_f, short_f = self._running_worst(
+                payloads1f, queries.shape[0], k)
+            final_need, _ = spatial.widen_wave(
+                lbs, sorted(spec_launched), worst_f, short_f, None)
+            needed = set(final_need)
+            reg = obs.get_registry()
+            for s in sorted(spec_launched):
+                reg.counter(
+                    "kdtree_router_spec_wave_total",
+                    labels={"outcome": "needed" if s in needed
+                            else "wasted"},
+                ).inc()
         t_merge0 = time.time()
         payloads = [snapshot[i] for i in contacted
                     if isinstance(snapshot[i], dict)]
@@ -1661,6 +1947,19 @@ class Router(GracefulHTTPServer):
             except Exception:
                 pass
 
+        if self.config.parent:
+            # a child router publishes no id_offset / code range, so
+            # the parent has no ownership evidence — guessing would
+            # half-apply writes across subtrees. Two-level routing
+            # serves READS; writes go to a child router (or the owning
+            # shard) directly (docs/SERVING.md "Scaling the router").
+            count("unavailable")
+            return 503, {
+                "error": "this is a parent router: write ownership is "
+                         "unknown at this level — send writes to a "
+                         "child router or the owning shard directly",
+                "trace_id": trace,
+            }
         ids = payload.get("ids") if isinstance(payload, dict) else None
         if not isinstance(ids, list) or not ids or not all(
             isinstance(i, int) and not isinstance(i, bool) for i in ids
@@ -1980,11 +2279,14 @@ class Router(GracefulHTTPServer):
         import http.client
 
         timeout = max(min(self.config.deadline_s, 2.0), 0.5)
+        # a parent scrapes its CHILD ROUTERS' federated expositions, so
+        # one parent scrape carries the whole two-level fleet
+        path = "/metrics?federate=1" if self.config.parent else "/metrics"
         try:
             conn = http.client.HTTPConnection(shard.host, shard.port,
                                               timeout=timeout)
             try:
-                conn.request("GET", "/metrics")
+                conn.request("GET", path)
                 resp = conn.getresponse()
                 raw = resp.read()
                 if resp.status != 200:
@@ -2019,6 +2321,11 @@ class Router(GracefulHTTPServer):
                     tgt["series"].append((sname, inner, value))
 
         def fed_tag(shard: ShardState) -> str:
+            # a parent labels each CHILD ROUTER's exposition child="i"
+            # — the child's own series already carry shard="j" labels,
+            # and reusing the shard key would collide with them
+            if self.config.parent:
+                return f'child="{shard.index}"'
             # single-replica sets keep their historical shard="i" series
             # identity; replicas add the replica dimension
             if shard.multi:
@@ -2280,6 +2587,11 @@ class Router(GracefulHTTPServer):
                                      + 2.0)
             self._health_thread = None
         self.server_close()
+        if self.pool is not None:
+            # after server_close: every handler thread (and so every
+            # in-flight lease) has been joined — nothing can release a
+            # connection back into a pool we just drained
+            self.pool.close_all()
         obs.flush()
 
 

@@ -147,6 +147,13 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if not self.close_connection:
+            # ADVERTISE the keep-alive contract the router's connection
+            # pool (serve/pool.py) leans on: HTTP/1.1 + Content-Length
+            # already make the connection reusable implicitly, but the
+            # explicit idle window tells clients how long a parked
+            # socket stays honored before the `timeout` reaper hangs up
+            self.send_header("Keep-Alive", f"timeout={self.timeout}")
         for key, val in (extra_headers or {}).items():
             self.send_header(key, val)
         self.end_headers()

@@ -1294,10 +1294,16 @@ def cmd_route(args) -> None:
             health_period_s=args.health_period_s,
             fanout=args.fanout,
             trace_frac=args.trace_frac,
+            pool=args.pool,
+            pool_max_idle=args.pool_max_idle,
+            spec_wave=args.spec_wave,
+            parent=args.parent,
         )
-        from kdtree_tpu.obs import slo as obs_slo
+        engine = None
+        if args.slo:
+            from kdtree_tpu.obs import slo as obs_slo
 
-        engine = obs_slo.SloEngine(specs=obs_slo.router_specs())
+            engine = obs_slo.SloEngine(specs=obs_slo.router_specs())
         httpd = rt.make_router(urls, host=args.host, port=args.port,
                                config=config, slo_engine=engine)
     except ValueError as e:
@@ -1316,10 +1322,13 @@ def cmd_route(args) -> None:
     if flight.install_signal_handler():
         print("flight recorder armed: kill -USR2 this pid dumps the "
               "recent-event ring", file=sys.stderr)
-    print(f"kdtree-tpu route: {len(urls)} shard(s), quorum "
+    kind = "child router(s)" if config.parent else "shard(s)"
+    print(f"kdtree-tpu route: {len(urls)} {kind}, quorum "
           f"{httpd.quorum}, deadline {config.deadline_s * 1e3:g} ms, "
           f"retries {config.retries}, breaker "
-          f"{config.breaker_failures}x/{config.breaker_reset_s:g}s",
+          f"{config.breaker_failures}x/{config.breaker_reset_s:g}s, "
+          f"pool {'on' if config.pool else 'off'}, spec-wave "
+          f"{'on' if config.spec_wave else 'off'}",
           file=sys.stderr)
     httpd.start()
     print(f"ready: routing POST /v1/knn, GET /healthz, GET /metrics on "
@@ -1369,6 +1378,26 @@ def cmd_loadgen(args) -> None:
               f"step quantiles), got {args.slo_quantile}",
               file=sys.stderr)
         sys.exit(1)
+    ab_base = None
+    if args.ab_baseline:
+        # read + validate the baseline BEFORE the sweep runs: a sweep
+        # whose A/B anchor turns out to be garbage was minutes wasted
+        try:
+            with open(args.ab_baseline) as f:
+                base_rep = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read --ab-baseline {args.ab_baseline}: {e}",
+                  file=sys.stderr)
+            sys.exit(1)
+        base_cap = (base_rep or {}).get("capacity") \
+            if isinstance(base_rep, dict) else None
+        if not isinstance(base_cap, dict) \
+                or "knee_rate" not in base_cap:
+            print(f"{args.ab_baseline} is not a loadgen capacity "
+                  "report (missing capacity.knee_rate); was it written "
+                  "by loadgen --out?", file=sys.stderr)
+            sys.exit(1)
+        ab_base = base_cap
     try:
         facts = lg_runner.discover(args.target,
                                    retries=args.ready_retries)
@@ -1406,6 +1435,28 @@ def cmd_loadgen(args) -> None:
         on_step=on_step,
     )
     cap = report["capacity"]
+    if args.variant:
+        cap["variant"] = args.variant
+    if ab_base is not None:
+        import os
+
+        # the A/B block the trend knee-drop rule judges: this run is
+        # the CANDIDATE, the embedded knee is the bar it must clear —
+        # strictly, or by a strictly lower p99 when both arms top out
+        # at the same ladder step
+        base_p99 = next(
+            (s.get("p99_ms") for s in ab_base.get("steps") or []
+             if isinstance(s, dict)
+             and s.get("rate") == ab_base["knee_rate"]), None)
+        cap["ab"] = {
+            "baseline_file": os.path.basename(args.ab_baseline),
+            "baseline_variant": ab_base.get("variant"),
+            "baseline_knee_rate": float(ab_base["knee_rate"]),
+            "baseline_p99_ms_at_knee": base_p99,
+            "knee_delta": round(
+                float(cap["knee_rate"]) - float(ab_base["knee_rate"]),
+                3),
+        }
     if args.out:
         import os
 
@@ -2180,7 +2231,36 @@ def main(argv=None) -> None:
                          "slow/error/partial/hedged — is always on; "
                          "docs/OBSERVABILITY.md \"Distributed "
                          "tracing\")")
-    ro.set_defaults(fn=cmd_route)
+    ro.add_argument("--no-pool", dest="pool", action="store_false",
+                    help="open a fresh connection per shard attempt "
+                         "instead of pooling keep-alive connections "
+                         "(the pooled-vs-fresh A/B baseline — "
+                         "docs/SERVING.md \"Scaling the router\")")
+    ro.add_argument("--pool-max-idle", type=int, default=8,
+                    help="idle keep-alive connections kept per shard "
+                         "replica (host, port)")
+    ro.add_argument("--no-spec-wave", dest="spec_wave",
+                    action="store_false",
+                    help="disable speculative overlapped wave 2: wait "
+                         "for every wave-1 response before widening "
+                         "(answers identical either way; this is the "
+                         "latency A/B baseline)")
+    ro.add_argument("--no-slo", dest="slo", action="store_false",
+                    help="serve without the router SLO ladder: no "
+                         "burn-rate pages, no slo block on /healthz — "
+                         "so an upstream parent router never ejects "
+                         "this router for paging. For benches and "
+                         "fleets where paging is handled out-of-band; "
+                         "a PAGE is sticky for the burn window, which "
+                         "turns a transient overload into minutes of "
+                         "ejection")
+    ro.add_argument("--parent", action="store_true",
+                    help="two-level mode: --shard urls are CHILD "
+                         "ROUTERS, not serve shards — prune/scatter/"
+                         "merge recurses through them with the same "
+                         "exact-merge byte-identity "
+                         "(docs/SERVING.md \"Scaling the router\")")
+    ro.set_defaults(fn=cmd_route, pool=True, spec_wave=True, slo=True)
 
     lg = sub.add_parser(
         "loadgen",
@@ -2256,6 +2336,16 @@ def main(argv=None) -> None:
                     metavar="FILE",
                     help="standalone capacity report artifact (a "
                          "kdtree-tpu trend input); '' disables")
+    lg.add_argument("--variant", default=None,
+                    help="label for this arm of an A/B (e.g. 'pooled', "
+                         "'fresh', 'hier'); recorded in the capacity "
+                         "block")
+    lg.add_argument("--ab-baseline", default=None, metavar="FILE",
+                    help="a previous loadgen report to A/B against: "
+                         "embeds its knee in this report's "
+                         "capacity.ab block, and the trend knee-drop "
+                         "rule fails any run whose knee is not "
+                         "strictly better than its baseline")
     lg.set_defaults(fn=cmd_loadgen)
 
     st = sub.add_parser(

@@ -759,6 +759,88 @@ def test_kdt110_header_literal_pinned_to_trace_module():
 
 
 # ---------------------------------------------------------------------------
+# KDT111 pooled-connection-unsafe-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_kdt111_flags_pool_release_in_except_handler(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(self, pc, body):\n"
+        "    try:\n"
+        "        pc.conn.request('POST', '/v1/knn', body,\n"
+        "                        headers={'X-Trace-Context': ''})\n"
+        "        return pc.conn.getresponse().read()\n"
+        "    except OSError:\n"
+        "        self.pool.release(pc, drained=False)\n"
+        "        raise\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT111"]
+    assert "except handler" in res.findings[0].message
+    assert "discard" in res.findings[0].message
+
+
+def test_kdt111_flags_nested_call_inside_handler(tmp_path):
+    # lexically inside the handler counts even under further nesting:
+    # the cleanup-helper-in-a-for-loop shape is exactly how the bug
+    # hides from a shallow body scan
+    res = lint_snippet(tmp_path, (
+        "def sweep(conn_pool, leases):\n"
+        "    try:\n"
+        "        return [pc.send() for pc in leases]\n"
+        "    except Exception:\n"
+        "        for pc in leases:\n"
+        "            if pc.live:\n"
+        "                conn_pool.release(pc)\n"
+        "        raise\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == ["KDT111"]
+
+
+def test_kdt111_clean_for_discard_in_except_and_release_on_clean_path(
+        tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(self, pc, body):\n"
+        "    try:\n"
+        "        raw = pc.conn.getresponse().read()\n"
+        "    except OSError:\n"
+        "        self.pool.discard(pc, 'error')\n"
+        "        raise\n"
+        "    self.pool.release(pc, drained=True)\n"
+        "    return raw\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+
+
+def test_kdt111_ignores_lock_release_in_except(tmp_path):
+    # lock .release() discipline is KDT402's territory; the receiver
+    # must look pool-ish for this rule to speak
+    res = lint_snippet(tmp_path, (
+        "def guarded(lock, fn):\n"
+        "    lock.acquire()\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except Exception:\n"
+        "        lock.release()\n"
+        "        raise\n"
+    ), relpath="serve/mod.py")
+    assert "KDT111" not in rules_of(res)
+
+
+def test_kdt111_suppressible_with_reason(tmp_path):
+    res = lint_snippet(tmp_path, (
+        "def call(self, pc):\n"
+        "    try:\n"
+        "        return pc.send()\n"
+        "    except KeyError:\n"
+        "        self.pool.release(pc)  "
+        "# kdt-lint: disable=KDT111 lookup miss, exchange never started\n"
+        "        raise\n"
+    ), relpath="serve/mod.py")
+    assert rules_of(res) == []
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
 # KDT401 signal-unsafe-lock
 # ---------------------------------------------------------------------------
 

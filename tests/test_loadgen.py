@@ -285,6 +285,39 @@ def test_discover_reads_shard_and_router_shapes():
         httpd.server_close()
 
 
+def test_discover_recurses_parent_router_breakdowns():
+    """Under two-level routing a parent's healthz entries are CHILD
+    ROUTERS whose detail is their own aggregated breakdown, not a leaf
+    shard body: discover must recurse to the data-bearing leaves so a
+    parent target sums n (and mins k_max) over the whole tree."""
+
+    class ParentStub(_StubHandler):
+        def do_GET(self):
+            self._answer(200, {"status": "ok", "shards": [
+                {"detail": {"status": "ok", "shards": [
+                    {"detail": {"dim": 3, "n": 40, "k_max": 8,
+                                "id_offset": 0}},
+                    {"detail": {"dim": 3, "n": 60, "k_max": 4,
+                                "id_offset": 40}},
+                ]}},
+                {"detail": {"status": "ok", "shards": [
+                    {"detail": {"dim": 3, "n": 25, "k_max": 8,
+                                "id_offset": 100}},
+                ]}},
+            ]})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), ParentStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        facts = lg_runner.discover(
+            f"http://127.0.0.1:{httpd.server_address[1]}", retries=3)
+        assert facts == {"dim": 3, "n": 125, "k_max": 4,
+                         "write_base": 125}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
 # ---------------------------------------------------------------------------
 # e2e: a real serve process, mixed load, fault-injected slowdown
 # ---------------------------------------------------------------------------
@@ -587,3 +620,131 @@ def test_discover_write_base_respects_spatial_id_range():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# connection-reuse fraction + the A/B capacity block (PR 17 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_reuse_frac_window_math():
+    assert lg_runner._reuse_frac((0.0, 0.0), (30.0, 10.0)) == 0.75
+    # no leases in the window / a lost boundary scrape: absent, not 0
+    assert lg_runner._reuse_frac((5.0, 5.0), (5.0, 5.0)) is None
+    assert lg_runner._reuse_frac(None, (3.0, 1.0)) is None
+    assert lg_runner._reuse_frac((3.0, 1.0), None) is None
+
+
+def test_conn_reuse_frac_lands_in_steps_and_capacity():
+    """A router-shaped stub publishing pool counters: the per-step and
+    run-level conn_reuse_frac are computed from counter DELTAS across
+    the step boundaries; a target without the families records None
+    (absent evidence, never a fake zero)."""
+
+    class PooledRouterStub(_StubHandler):
+        posts = 0
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            type(self).posts += 1
+            self._answer(200, {"ids": [[0]], "distances": [[0.0]],
+                               "degraded": None})
+
+        def do_GET(self):
+            if self.path.startswith("/metrics"):
+                # a constant 3:1 hit:miss ratio, so ANY window with
+                # traffic reads 0.75 — the step-attribution jitter the
+                # async boundary scrape allows cannot move the answer
+                n = type(self).posts
+                body = (f"kdtree_router_pool_hits_total {3 * n}\n"
+                        f"kdtree_router_pool_misses_total {n}\n"
+                        ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                super().do_GET()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), PooledRouterStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    target = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        sched = build_schedule([30, 30], 1.0, 5, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=True)
+        cap = rep["capacity"]
+        assert cap["conn_reuse_frac"] == 0.75
+        fracs = [s["conn_reuse_frac"] for s in cap["steps"]]
+        assert all(f in (0.75, None) for f in fracs), fracs
+        assert 0.75 in fracs  # at least one boundary pair survived
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # plain shard stub: no pool families -> fraction absent everywhere
+    httpd, target = _stub_server()
+    try:
+        sched = build_schedule([30], 1.0, 5, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=True)
+        assert rep["capacity"]["conn_reuse_frac"] is None
+        assert rep["capacity"]["steps"][0]["conn_reuse_frac"] is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_cli_embeds_variant_and_ab_baseline(tmp_path, capsys):
+    """The A/B loop end to end: arm 1 writes a report under --variant,
+    arm 2 runs with --ab-baseline pointing at it and publishes the
+    capacity.ab block the trend knee-drop rule judges."""
+    from kdtree_tpu.utils import cli
+
+    httpd, target = _stub_server()
+    base_out = str(tmp_path / "base.json")
+    cand_out = str(tmp_path / "cand.json")
+    try:
+        cli.main(["loadgen", "--target", target, "--rates", "40",
+                  "--step-seconds", "0.5", "--mix", "query:1",
+                  "--variant", "fresh", "--out", base_out])
+        cli.main(["loadgen", "--target", target, "--rates", "40",
+                  "--step-seconds", "0.5", "--mix", "query:1",
+                  "--variant", "pooled", "--ab-baseline", base_out,
+                  "--out", cand_out])
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    capsys.readouterr()
+    with open(base_out) as f:
+        base = json.load(f)
+    with open(cand_out) as f:
+        cand = json.load(f)
+    assert base["capacity"]["variant"] == "fresh"
+    assert "ab" not in base["capacity"]
+    ab = cand["capacity"]["ab"]
+    assert ab["baseline_file"] == "base.json"
+    assert ab["baseline_variant"] == "fresh"
+    assert ab["baseline_knee_rate"] == base["capacity"]["knee_rate"]
+    assert ab["knee_delta"] == pytest.approx(
+        cand["capacity"]["knee_rate"] - base["capacity"]["knee_rate"])
+    assert cand["capacity"]["variant"] == "pooled"
+
+
+def test_cli_rejects_garbage_ab_baseline(tmp_path, capsys):
+    """A bogus --ab-baseline fails BEFORE the sweep (and before the
+    target is contacted — the bogus port proves it)."""
+    from kdtree_tpu.utils import cli
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"not\": \"a capacity report\"}")
+    with pytest.raises(SystemExit) as e:
+        cli.main(["loadgen", "--target", "http://127.0.0.1:9",
+                  "--rates", "10", "--ab-baseline", str(bad)])
+    assert e.value.code == 1
+    assert "missing capacity.knee_rate" in capsys.readouterr().err
+    missing = tmp_path / "nope.json"
+    with pytest.raises(SystemExit) as e:
+        cli.main(["loadgen", "--target", "http://127.0.0.1:9",
+                  "--rates", "10", "--ab-baseline", str(missing)])
+    assert e.value.code == 1
+    assert "cannot read --ab-baseline" in capsys.readouterr().err

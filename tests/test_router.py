@@ -1043,6 +1043,31 @@ def test_route_cli_needs_shards(capsys):
     assert "--shard" in capsys.readouterr().err
 
 
+def test_route_cli_no_slo_disables_router_slo_engine(monkeypatch):
+    """--no-slo must wire slo_engine=None (the bench harness depends on
+    it: a PAGE is sticky for the burn window, so one over-the-knee
+    ladder step would leave an upstream parent ejecting this router
+    through every later step); the default stays the router SLO ladder."""
+    from kdtree_tpu.utils import cli
+
+    captured = {}
+
+    def fake_make_router(urls, **kw):
+        captured.update(kw)
+        raise ValueError("captured — stop before binding a port")
+
+    monkeypatch.setattr(rt, "make_router", fake_make_router)
+    with pytest.raises(SystemExit) as e:
+        cli.main(["route", "--shard", "http://127.0.0.1:1", "--no-slo"])
+    assert e.value.code == 1
+    assert captured["slo_engine"] is None
+
+    captured.clear()
+    with pytest.raises(SystemExit):
+        cli.main(["route", "--shard", "http://127.0.0.1:1"])
+    assert captured["slo_engine"] is not None
+
+
 # ---------------------------------------------------------------------------
 # replica sets (docs/SERVING.md "Snapshots & replica fleets")
 # ---------------------------------------------------------------------------
@@ -1397,7 +1422,9 @@ def test_spatial_selective_byte_identical_and_prunes(spatial_fleet):
     the metrics."""
     pruned_before = _counter("kdtree_router_shards_pruned_total")
     contacts = []
-    with spatial_router(spatial_fleet) as sel_router, \
+    # spec_wave off for deterministic contact accounting: speculation
+    # widens early whenever a wave-1 shard is transiently slow
+    with spatial_router(spatial_fleet, spec_wave=False) as sel_router, \
             spatial_router(spatial_fleet, fanout="full") as full_router:
         for si, center in enumerate(SP_CENTERS):
             q = _near(center, seed=40 + si)
@@ -1441,7 +1468,12 @@ def test_spatial_heterogeneous_legacy_shard_never_pruned(spatial_fleet):
     degrade to full fan-out for the legacy ones — they are ALWAYS
     contacted, never silently pruned."""
     legacy = 2
-    with spatial_router(spatial_fleet, health_loop=False) as router:
+    # spec_wave off and hedging pinned far out: both deliberately trade
+    # extra contacts for latency when a wave-1 shard is transiently
+    # slow (each pinned by its own tests), which would make this
+    # test's per-shard dispatch accounting timing-dependent
+    with spatial_router(spatial_fleet, health_loop=False,
+                        spec_wave=False, hedge_min_s=30.0) as router:
         for shard in router.shards:
             router._probe_health(shard)
         # strip one set's spatial evidence: a legacy serve build that
@@ -1725,3 +1757,380 @@ def test_idrange_routed_upsert_expands_cached_box(write_shards):
         assert (box[1] >= far - 1e-6).all()
         # clean up the write so sibling tests see the fixture state
         _post_path(router, "/v1/delete", {"ids": [1500]})
+
+
+# ---------------------------------------------------------------------------
+# router scale-out: connection pooling, speculative wave 2, two levels
+# (docs/SERVING.md "Scaling the router")
+# ---------------------------------------------------------------------------
+
+
+def _pool_discards(reason):
+    return _counter(
+        f'kdtree_router_pool_discards_total{{reason="{reason}"}}')
+
+
+def test_pooled_connections_reused_byte_identical(shards, oracle_tree):
+    """The pooling tentpole pin: back-to-back requests reuse keep-alive
+    connections (hits counted, idle list populated) and the answers
+    stay byte-identical to the single-index oracle — reuse is a
+    transport optimization, never a semantics change."""
+    hits0 = _counter("kdtree_router_pool_hits_total")
+    q = _queries(4, seed=21)
+    payload = {"queries": q.tolist(), "k": K}
+    dist, ids = _oracle(oracle_tree, q, K)
+    with router_for(shards) as router:
+        assert router.pool is not None
+        for _ in range(3):
+            status, out = _post(router, payload)
+            assert status == 200 and out["degraded"] is None
+            assert out["ids"] == ids and out["distances"] == dist
+        # requests 2 and 3 ran over request 1's connections (a cold
+        # first request may hedge and lose a twin's connection, so the
+        # bound is one full round of reuse, not two)
+        assert _counter("kdtree_router_pool_hits_total") - hits0 >= \
+            N_SHARDS
+        assert router.pool.idle_count() >= 1
+    hits_after = _counter("kdtree_router_pool_hits_total")
+    # the --no-pool A/B arm: same answers, no pool, no new hits
+    with router_for(shards, pool=False) as router:
+        assert router.pool is None
+        status, out = _post(router, payload)
+        assert status == 200
+        assert out["ids"] == ids and out["distances"] == dist
+    assert _counter("kdtree_router_pool_hits_total") == hits_after
+
+
+class _DelayShard:
+    """A keep-alive stub whose i-th POST sleeps ``delays[i]`` before a
+    fixed 200 body — hedging needs a SLOW first exchange, which the
+    scripted-response stub cannot express."""
+
+    def __init__(self, delays, body):
+        import http.server
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(length)
+                with stub.lock:
+                    i = stub.count
+                    stub.count += 1
+                if i < len(stub.delays):
+                    time.sleep(stub.delays[i])
+                raw = json.dumps(stub.body).encode()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # the hedge winner closed this socket mid-response:
+                    # normal weather for the losing twin
+                    self.close_connection = True
+
+        self.delays = list(delays)
+        self.body = body
+        self.count = 0
+        self.lock = threading.Lock()
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.httpd.daemon_threads = True
+        self.thread = threading.Thread(target=self.httpd.serve_forever)
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.thread.join()
+        self.httpd.server_close()
+
+
+def test_hedge_loser_pooled_connection_discarded_never_released():
+    """The pooling x hedging composition pin: the hedge winner closes
+    the loser's POOLED connection; the loser's lease is discarded
+    (reason=abort) and never returns to the idle list — only the
+    winner's fully-drained connection is reusable afterwards."""
+    ok_body = {"k": 1, "ids": [[3]], "distances": [[0.25]],
+               "degraded": None, "trace_id": ""}
+    aborts0 = _pool_discards("abort")
+    stub = _DelayShard([0.8], ok_body)  # first POST slow, rest fast
+    try:
+        router = rt.make_router(
+            [stub.url],
+            config=rt.RouterConfig(deadline_s=10.0, retries=0, quorum=1,
+                                   hedge_min_s=0.05),
+        )
+        router.start(health_loop=False)
+        try:
+            status, out = _post(router, {"queries": [[0.0] * DIM]})
+            assert status == 200 and out["ids"] == [[3]]
+            assert _counter('kdtree_router_hedges_total{shard="0"}') >= 1
+            # the loser's connection: closed, counted, NOT pooled. The
+            # losing thread may still be blocked in its read when the
+            # winner returns — its discard lands when it unwinds.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and \
+                    _pool_discards("abort") - aborts0 < 1:
+                time.sleep(0.05)
+            assert _pool_discards("abort") - aborts0 >= 1
+            assert router.pool.idle_count() <= 1
+            # and the winner's connection IS reusable
+            hits0 = _counter("kdtree_router_pool_hits_total")
+            status, out = _post(router, {"queries": [[0.0] * DIM]})
+            assert status == 200 and out["ids"] == [[3]]
+            assert _counter("kdtree_router_pool_hits_total") - hits0 >= 1
+        finally:
+            router.stop()
+    finally:
+        stub.stop()
+
+
+def test_stale_pooled_connection_retried_crisply(shards, oracle_tree):
+    """The keep-alive shard-restart pin: a pooled connection whose
+    server side went away (restart, idle reaper) fails the next reuse
+    CRISPLY and the router transparently retries that one attempt on a
+    fresh connection — with retries=0, so an un-transparent failure
+    would surface as a 503, never a hang or a wrong answer."""
+    q = _queries(2, seed=22)
+    payload = {"queries": q.tolist(), "k": K}
+    dist, ids = _oracle(oracle_tree, q, K)
+    retries0 = _counter('kdtree_router_retries_total{shard="0"}')
+    with router_for(shards, retries=0) as router:
+        status, out = _post(router, payload)
+        assert status == 200
+        # simulate every shard restarting: close the pooled sockets
+        # server-side-style (the pool cannot know — no abort mark, the
+        # entries still look fresh) so the next lease reuses them and
+        # hits the dead socket
+        with router.pool._lock:
+            stale = [pc for b in router.pool._idle.values() for pc in b]
+        assert len(stale) >= N_SHARDS
+        for pc in stale:
+            pc.conn.sock.close()
+        stale0 = _pool_discards("stale")
+        status, out = _post(router, payload)
+        assert status == 200 and out["degraded"] is None
+        assert out["ids"] == ids and out["distances"] == dist
+        assert _pool_discards("stale") - stale0 >= N_SHARDS
+    # the transparent retry is NOT a counted (backed-off) retry
+    assert _counter('kdtree_router_retries_total{shard="0"}') == retries0
+
+
+def test_optimistic_worst_proves_only_certain_shards():
+    """Unit pin for the speculative early trigger: the optimistic bound
+    assumes every pending wave-1 shard delivers k candidates AT its
+    box lower bound, so a remaining shard it still fails to prune is
+    needed under ANY actual answer — and one it prunes is not proven
+    either way."""
+    import types
+
+    host = types.SimpleNamespace(
+        _running_worst=rt.Router._running_worst)
+    nq, k = 1, 2
+    answered = [{"k": 2, "distances": [[1.0, 2.0]], "ids": [[5, 6]]}]
+    # pending wave-1 shard with lb 3.0: optimistically contributes
+    # k candidates at 3.0 -> optimistic worst = 2.0 (from answered)
+    worst, short = rt.Router._optimistic_worst(
+        host, answered, [np.asarray([3.0])], nq, k)
+    assert worst.tolist() == [2.0] and not short[0]
+    # no answers yet and no k: nothing is provable
+    worst, short = rt.Router._optimistic_worst(host, [], [None], nq, None)
+    assert worst.tolist() == [0.0] and not short[0]
+    # pending shard closer than the answered candidates caps the bound
+    # (k assumed candidates at lb 0.5 dominate the answered pair)
+    worst, _ = rt.Router._optimistic_worst(
+        host, answered, [np.asarray([0.5])], nq, k)
+    assert worst.tolist() == [0.5]
+
+
+def test_spec_wave_overlaps_slow_wave1_and_stays_exact(spatial_fleet):
+    """The speculative wave-2 tentpole pin: when the wave-1 owner is
+    slow, the router fires the conservative widening wave at the
+    p95-derived delay instead of waiting — the request still merges
+    every answer byte-identically, the extra contacts are visible, and
+    the losing bets are counted as wasted."""
+    from kdtree_tpu.serve import spatial as sp
+
+    fleet = spatial_fleet
+    center = SP_CENTERS[0]
+    owner = int(sp.owner_of(center.reshape(1, 3), fleet.plan["grid"],
+                            fleet.plan["code_ranges"])[0])
+    q = _near(center, seed=60)
+    spec0 = (_counter('kdtree_router_spec_wave_total{outcome="needed"}')
+             + _counter('kdtree_router_spec_wave_total{outcome="wasted"}'))
+    fleet.servers[owner].faults.set_spec("knn=latency:500")
+    try:
+        with spatial_router(fleet, retries=0) as router:
+            t0 = time.monotonic()
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+            elapsed = time.monotonic() - t0
+    finally:
+        fleet.servers[owner].faults.clear()
+        time.sleep(0.1)
+    assert status == 200 and out["degraded"] is None
+    dist, ids = fleet.oracle(q, K)
+    assert out["ids"] == ids and out["distances"] == dist
+    # the hedge-style bet fanned out rather than waiting serially
+    assert out["shards"]["contacted"] == SP_SHARDS
+    spec1 = (_counter('kdtree_router_spec_wave_total{outcome="needed"}')
+             + _counter('kdtree_router_spec_wave_total{outcome="wasted"}'))
+    assert spec1 - spec0 >= SP_SHARDS - 1
+    # the slow owner bounded the request, not spec-delay + owner
+    assert elapsed < 2.0, elapsed
+
+
+def test_spec_wave_off_keeps_serial_pruning(spatial_fleet):
+    """The --no-spec-wave A/B arm: the same slow-owner scenario widens
+    only on the full wave-1 evidence — no speculative contacts, fewer
+    shards touched, same exact answer."""
+    from kdtree_tpu.serve import spatial as sp
+
+    fleet = spatial_fleet
+    center = SP_CENTERS[0]
+    owner = int(sp.owner_of(center.reshape(1, 3), fleet.plan["grid"],
+                            fleet.plan["code_ranges"])[0])
+    q = _near(center, seed=61)
+    spec0 = (_counter('kdtree_router_spec_wave_total{outcome="needed"}')
+             + _counter('kdtree_router_spec_wave_total{outcome="wasted"}'))
+    fleet.servers[owner].faults.set_spec("knn=latency:300")
+    try:
+        with spatial_router(fleet, retries=0, spec_wave=False) as router:
+            status, out = _post(router, {"queries": q.tolist(), "k": K})
+    finally:
+        fleet.servers[owner].faults.clear()
+        time.sleep(0.1)
+    assert status == 200
+    dist, ids = fleet.oracle(q, K)
+    assert out["ids"] == ids and out["distances"] == dist
+    assert out["shards"]["contacted"] < SP_SHARDS
+    assert (_counter('kdtree_router_spec_wave_total{outcome="needed"}')
+            + _counter('kdtree_router_spec_wave_total{outcome="wasted"}')
+            ) == spec0
+
+
+@contextlib.contextmanager
+def _two_level(fleet, **parent_cfg):
+    """Two child routers over half the spatial fleet each, one parent
+    over the children — the ``route --parent`` topology in-process."""
+    with spatial_router(fleet) as _probe:
+        pass  # ensure the fleet is warm/probeable before splitting
+    half = len(fleet.urls) // 2
+    children = []
+    try:
+        for urls in (fleet.urls[:half], fleet.urls[half:]):
+            child = rt.make_router(urls, config=rt.RouterConfig(
+                deadline_s=30.0, retries=1, backoff_base_s=0.01,
+                health_period_s=0.1))
+            child.start(health_loop=True)
+            children.append(child)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(ss.box() is not None for c in children
+                   for ss in c.shard_sets):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("children never learned shard boxes")
+        defaults = dict(deadline_s=30.0, retries=1, backoff_base_s=0.01,
+                        health_period_s=0.1, parent=True)
+        defaults.update(parent_cfg)
+        child_urls = [
+            f"http://127.0.0.1:{c.server_address[1]}" for c in children
+        ]
+        parent = rt.make_router(child_urls,
+                                config=rt.RouterConfig(**defaults))
+        parent.start(health_loop=True)
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if all(ss.box() is not None
+                       for ss in parent.shard_sets):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("parent never learned child boxes")
+            yield parent, children
+        finally:
+            parent.stop()
+    finally:
+        for c in children:
+            c.stop()
+
+
+def test_two_level_routing_byte_identical_and_aggregates(spatial_fleet):
+    """The hierarchical tentpole pin: a parent router over two child
+    routers answers byte-identically to the single-index oracle AND to
+    a flat router over all four shards — the exact (distance, id)
+    merge is associative, so byte-identity survives the tree. Health,
+    federation, and trace context aggregate through."""
+    fleet = spatial_fleet
+    qs = np.concatenate([
+        _near(SP_CENTERS[0], 70, rows=2),
+        _near(SP_CENTERS[3], 71, rows=2),
+    ])
+    payload = {"queries": qs.tolist(), "k": K}
+    dist, ids = fleet.oracle(qs, K)
+    with _two_level(fleet) as (parent, children):
+        status, out = _post(parent, payload)
+        assert status == 200 and out["degraded"] is None, out
+        assert out["ids"] == ids and out["distances"] == dist
+        assert out["shards"]["total"] == 2  # children, at this level
+        with spatial_router(fleet, fanout="full") as flat:
+            status_f, out_f = _post(flat, payload)
+        assert status_f == 200
+        assert out_f["ids"] == out["ids"]
+        assert out_f["distances"] == out["distances"]
+        # /healthz aggregates: the parent is as ready as its children
+        status, health = _get(parent, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["total"] == 2 and health["available"] == 2
+        # the parent publishes the fleet-union box for a grandparent
+        assert "box" in health
+        # federation recurses: one parent scrape carries child-labeled
+        # series, including the children's own shard-labeled ones
+        status, text = _get_text(parent, "/metrics?federate=1")
+        assert status == 200
+        assert 'kdtree_router_federated_up{child="0"} 1' in \
+            text.splitlines()
+        assert 'child="1"' in text
+        # trace context composes: the child ADOPTS the parent's span
+        # rather than minting its own root, so the child-side route
+        # spans join the parent's trace id
+        tid = "two-level-trace-1"
+        status, out = _post(parent, payload,
+                            headers={"X-Request-Id": tid})
+        assert status == 200 and out["trace_id"] == tid
+        from kdtree_tpu.obs import trace as trace_mod
+        local = trace_mod.get_trace(tid)
+        assert local is not None
+        names = {s["name"] for s in local["spans"]}
+        # both levels recorded under ONE trace id: the parent's root +
+        # its route/shard bars and each child's own route/request span
+        assert "route/request" in names and "route/shard" in names
+        roots = [s for s in local["spans"]
+                 if s["name"] == "route/request"]
+        assert len(roots) >= 2  # parent's + adopted children's
+
+
+def test_parent_router_refuses_writes_crisply(spatial_fleet):
+    """Writes through a parent are a crisp 503 refusal — a child
+    router publishes no ownership evidence, and guessing would
+    half-apply the write across subtrees (docs/SERVING.md)."""
+    with _two_level(spatial_fleet) as (parent, _children):
+        status, out = _post_path(parent, "/v1/upsert", {
+            "ids": [99999], "points": [[0.0, 0.0, 0.0]]})
+        assert status == 503
+        assert "parent" in out["error"]
+        # and reads keep working after the refusal
+        q = _near(SP_CENTERS[1], 72)
+        status, out = _post(parent, {"queries": q.tolist(), "k": K})
+        assert status == 200

@@ -541,3 +541,79 @@ def test_fanout_not_reset_by_interposed_fanoutless_capacity_run(tmp_path):
     findings, _ = tr.analyze([tr.load_run(p) for p in paths])
     assert [f["rule"] for f in findings] == ["fanout-growth"]
     assert findings[0]["from"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# knee-drop: the embedded A/B gate (PR 17 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _ab_report(knee, baseline_knee, baseline_p99=None, variant="pooled",
+               rates=(25, 50, 100), p99s=(20.0, 40.0, 80.0)):
+    rep = _loadgen_report(knee, rates=rates, p99s=p99s)
+    rep["capacity"]["variant"] = variant
+    rep["capacity"]["ab"] = {
+        "baseline_file": "base.json",
+        "baseline_variant": "fresh",
+        "baseline_knee_rate": baseline_knee,
+        "baseline_p99_ms_at_knee": baseline_p99,
+        "knee_delta": knee - baseline_knee,
+    }
+    return rep
+
+
+def test_knee_drop_judges_run_against_embedded_baseline(tmp_path):
+    # strictly higher knee: the claim holds, the gate is silent
+    run = tr.load_run(_write(tmp_path, "win.json",
+                             _ab_report(100.0, 50.0)))
+    findings, _ = tr.analyze([run])
+    assert findings == []
+    # lower knee: the arm this run claims to beat still wins
+    run = tr.load_run(_write(tmp_path, "lose.json",
+                             _ab_report(50.0, 100.0)))
+    findings, _ = tr.analyze([run])
+    assert [f["rule"] for f in findings] == ["knee-drop"]
+    assert findings[0]["metric"] == "capacity:ab"
+    assert findings[0]["from"] == "fresh" and findings[0]["to"] == "lose"
+    assert "50" in findings[0]["detail"]
+    # grandfathering works for the new rule like every other
+    base_path = str(tmp_path / "tb.json")
+    tr.save_baseline(base_path, findings)
+    assert tr.partition(findings, tr.load_baseline(base_path)) == []
+
+
+def test_knee_tie_decided_by_p99_at_the_knee_rate(tmp_path):
+    # both arms top out at the ladder's last step: a strictly lower
+    # candidate p99 at that rate is the win the knee cannot express
+    run = tr.load_run(_write(tmp_path, "tiewin.json", _ab_report(
+        100.0, 100.0, baseline_p99=90.0, p99s=(20.0, 40.0, 80.0))))
+    findings, _ = tr.analyze([run])
+    assert findings == []
+    # tied knees, tied (or worse) p99: not strictly better -> finding
+    run = tr.load_run(_write(tmp_path, "tielose.json", _ab_report(
+        100.0, 100.0, baseline_p99=80.0, p99s=(20.0, 40.0, 80.0))))
+    findings, _ = tr.analyze([run])
+    assert [f["rule"] for f in findings] == ["knee-drop"]
+    assert "tied" in findings[0]["detail"]
+    # tie with no baseline p99 recorded: no tiebreak evidence -> the
+    # strict claim fails (absence of proof is not a pass)
+    run = tr.load_run(_write(tmp_path, "tienop99.json",
+                             _ab_report(100.0, 100.0)))
+    findings, _ = tr.analyze([run])
+    assert [f["rule"] for f in findings] == ["knee-drop"]
+
+
+def test_knee_drop_tolerates_malformed_and_absent_ab(tmp_path):
+    # a malformed ab block reads as absent — old trend code never
+    # crashes on a future artifact, and no phantom finding is minted
+    rep = _loadgen_report(100.0)
+    rep["capacity"]["ab"] = {"baseline_knee_rate": "not-a-number"}
+    run = tr.load_run(_write(tmp_path, "bad.json", rep))
+    assert run["capacity"]["ab"] is None
+    findings, _ = tr.analyze([run])
+    assert findings == []
+    # variant rides through parsing for the human report
+    rep2 = _ab_report(100.0, 50.0)
+    run2 = tr.load_run(_write(tmp_path, "v.json", rep2))
+    assert run2["capacity"]["variant"] == "pooled"
+    assert run2["capacity"]["ab"]["baseline_variant"] == "fresh"
