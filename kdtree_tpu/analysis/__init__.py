@@ -18,12 +18,18 @@ Pieces:
 
 - :mod:`~kdtree_tpu.analysis.registry` — rule metadata + the
   :class:`Finding` record and checker registration;
+- :mod:`~kdtree_tpu.analysis.program` — the whole-program
+  interprocedural engine: module/import graph, call graph, and
+  fixpoint-propagated function summaries (device-value returns, I/O
+  chains, timeout/headers forwarding, drain/validation facts) that let
+  rules see through helpers;
 - :mod:`~kdtree_tpu.analysis.checkers` — the rule implementations;
 - :mod:`~kdtree_tpu.analysis.walker` — file collection, suppression
-  comments, per-file checker driving;
+  comments, per-file checker driving (and the whole-program build);
 - :mod:`~kdtree_tpu.analysis.baseline` — the committed
   grandfather file (CI fails only on findings NOT in it);
-- :mod:`~kdtree_tpu.analysis.reporting` — human and JSON output;
+- :mod:`~kdtree_tpu.analysis.reporting` — human, JSON, and SARIF
+  2.1.0 output;
 - :mod:`~kdtree_tpu.analysis.lockwatch` — the RUNTIME half of the
   KDT4xx concurrency rules: an opt-in (``KDTREE_TPU_LOCKWATCH=1``)
   instrumented lock factory that records the acquisition-order graph,
@@ -33,12 +39,14 @@ Pieces:
 
 from __future__ import annotations
 
+from kdtree_tpu.analysis.program import Program
 from kdtree_tpu.analysis.registry import Finding, Rule, all_rules
 from kdtree_tpu.analysis.walker import LintResult, lint_file, run_lint
 
 __all__ = [
     "Finding",
     "LintResult",
+    "Program",
     "Rule",
     "all_rules",
     "lint_file",

@@ -11,39 +11,115 @@ fixing some).
 Multiplicity matters: two identical syncs in one function are two
 findings, so fingerprints are counted, not set-membership-tested — fixing
 one of two and adding another elsewhere in the same shape still fails.
+
+Format v2 adds a ``scope_hash`` (content hash of the enclosing function's
+normalized source) to every entry, giving each one a second, PATH-FREE
+identity: ``git mv`` of a module keeps every scope's content byte-
+identical, so a moved file's grandfathered findings still match their
+entries instead of all turning into "NEW" CI failures. Each entry's
+count is one shared budget — a finding consumes it by exact match first,
+move match second — so a copy-paste of a grandfathered line into a
+SECOND file cannot ride the same entry twice. v1 files (no scope_hash)
+load fine and match exact-only.
+
+``stale_entries()`` (the ``--prune-baseline`` gate) reports entries whose
+budget was never consumed: debt that no longer exists must leave the
+file, not sit as a silent grandfather slot for the next violation that
+happens to collide with its fingerprint.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from collections import Counter
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 from kdtree_tpu.analysis.registry import Finding
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 
-def load(path: str) -> Counter:
-    """Fingerprint -> allowed count. A missing file is an empty baseline
-    (the common steady state: everything fixed or suppressed inline)."""
+class _Entry:
+    __slots__ = ("data", "count", "used")
+
+    def __init__(self, data: dict) -> None:
+        self.data = data
+        self.count = int(data.get("count", 1))
+        self.used = 0
+
+    @property
+    def remaining(self) -> int:
+        return self.count - self.used
+
+    def exact_fp(self) -> str:
+        return "|".join((
+            self.data["rule"], self.data["path"],
+            self.data.get("scope", "<module>"),
+            self.data.get("line_text", ""),
+        ))
+
+    def move_fp(self) -> Optional[str]:
+        sh = self.data.get("scope_hash", "")
+        if not sh:
+            return None  # v1 entry: exact-only
+        return "|".join((
+            self.data["rule"], self.data.get("scope", "<module>"),
+            self.data.get("line_text", ""), sh,
+        ))
+
+
+class Baseline:
+    """Loaded grandfather entries with shared per-entry budgets."""
+
+    def __init__(self, entries: Iterable[dict]) -> None:
+        self.entries: List[_Entry] = [_Entry(e) for e in entries]
+        self._by_exact: Dict[str, List[_Entry]] = {}
+        self._by_move: Dict[str, List[_Entry]] = {}
+        for e in self.entries:
+            self._by_exact.setdefault(e.exact_fp(), []).append(e)
+            mfp = e.move_fp()
+            if mfp is not None:
+                self._by_move.setdefault(mfp, []).append(e)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def consume(self, finding: Finding) -> bool:
+        """Spend one unit of budget for this finding: exact fingerprint
+        first, then (v2 entries only) the path-free move fingerprint."""
+        for e in self._by_exact.get(finding.fingerprint(), []):
+            if e.remaining > 0:
+                e.used += 1
+                return True
+        if finding.scope_hash:
+            for e in self._by_move.get(finding.move_fingerprint(), []):
+                if e.remaining > 0:
+                    e.used += 1
+                    return True
+        return False
+
+    def stale_entries(self) -> List[dict]:
+        """Entries with unconsumed budget after a partition pass — debt
+        the linter can no longer find. Call only after partition()."""
+        return [
+            dict(e.data, stale=e.remaining)
+            for e in self.entries
+            if e.remaining > 0
+        ]
+
+
+def load(path: str) -> Baseline:
+    """A missing file is an empty baseline (the common steady state:
+    everything fixed or suppressed inline)."""
     if not path or not os.path.exists(path):
-        return Counter()
+        return Baseline([])
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
     if not isinstance(data, dict) or "findings" not in data:
         raise ValueError(
             f"{path} is not a kdt-lint baseline (missing 'findings')"
         )
-    out: Counter = Counter()
-    for entry in data["findings"]:
-        fp = "|".join((
-            entry["rule"], entry["path"], entry.get("scope", "<module>"),
-            entry.get("line_text", ""),
-        ))
-        out[fp] += int(entry.get("count", 1))
-    return out
+    return Baseline(data["findings"])
 
 
 def save(path: str, findings: Iterable[Finding]) -> int:
@@ -62,6 +138,7 @@ def save(path: str, findings: Iterable[Finding]) -> int:
                 "path": f.path,
                 "scope": f.scope,
                 "line_text": f.line_text,
+                "scope_hash": f.scope_hash,
                 "count": 1,
             }
     entries = sorted(
@@ -76,17 +153,12 @@ def save(path: str, findings: Iterable[Finding]) -> int:
     return len(entries)
 
 
-def partition(
-    findings: Iterable[Finding], baseline: Counter
-) -> List[Finding]:
+def partition(findings: Iterable[Finding], baseline: Baseline) -> List[Finding]:
     """Mark baselined findings in place; return the NEW (unbaselined)
-    ones. Consumes baseline counts first-come within a fingerprint."""
-    budget = Counter(baseline)
+    ones. Consumes baseline budgets first-come within a fingerprint."""
     new: List[Finding] = []
     for f in findings:
-        fp = f.fingerprint()
-        if budget[fp] > 0:
-            budget[fp] -= 1
+        if baseline.consume(f):
             f.baselined = True
         else:
             new.append(f)

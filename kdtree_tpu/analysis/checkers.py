@@ -7,18 +7,37 @@ inference to fire is a linter nobody trusts or runs. Where a rule needs
 dataflow (sync-in-hot-path), it uses a small, explicit, forward-only
 taint pass whose seeds are named in this file — predictable false
 negatives over unpredictable false positives.
+
+Since the interprocedural engine (:mod:`~kdtree_tpu.analysis.program`)
+landed, several rules additionally consult ``ctx.program`` — a
+whole-program call graph with fixpoint-propagated function summaries —
+to see through helpers: KDT201's taint follows device values across
+resolved calls, KDT402 flags I/O reached via a called helper, KDT107 and
+KDT110 resolve wrapper functions that forward ``timeout=``/``headers=``,
+and the KDT5xx serving-protocol band is built on the summaries outright.
+The soundness stance is unchanged: a call the engine cannot resolve to
+exactly one function def contributes nothing.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Set
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from kdtree_tpu.analysis.program import (
+    CLIENT_TIMEOUT_POS,
+    FuncInfo,
+    scope_walk,
+)
+from kdtree_tpu.analysis.program import _IO_DOTTED as _IO_DOTTED
+from kdtree_tpu.analysis.program import _IO_LEAFS as _IO_LEAFS
+from kdtree_tpu.analysis.program import _JAX_HOST_CALLS as _JAX_HOST_CALLS
 from kdtree_tpu.analysis.registry import (
     CONCURRENCY,
     CORRECTNESS,
     HYGIENE,
     PERFORMANCE,
+    SERVING,
     Finding,
     Rule,
     checker,
@@ -201,6 +220,70 @@ R_THREAD_JOIN = register(Rule(
     "exit (or, daemonized by accident, drops the work it carried)",
 ))
 
+R_BODY_DRAIN = register(Rule(
+    "KDT501", "response-not-drained-before-release", SERVING,
+    "a response obtained via .getresponse() must be drained (resp.read() "
+    "to EOF — directly or through a called helper that reads it) before "
+    "the connection is pool.release()d, unless the release passes an "
+    "explicit drained= verdict",
+    "the router's keep-alive pool (PR 17) parks a connection for reuse "
+    "only after a CLEAN fully-drained exchange; an undrained body leaves "
+    "the previous response's bytes on the socket and the next lease "
+    "reads them as ITS response — the keep-alive desync class PR 9's "
+    "review pass first hit and PR 17's drain contract exists to kill",
+))
+
+R_CONST_TIMEOUT = register(Rule(
+    "KDT502", "constant-timeout-under-deadline", SERVING,
+    "in serve-layer request-scoped code that carries a deadline/budget/"
+    "timeout, outbound client timeouts must be DERIVED from the "
+    "remaining deadline (budget = deadline - now), not a numeric "
+    "constant — a constant either over-waits past the request deadline "
+    "or silently truncates it",
+    "the router's fan-out (PR 9) prices every hop off the remaining "
+    "request budget (max(timeout_s - elapsed, eps)); one constant-"
+    "timeout call site inside that path waits the full constant while "
+    "the caller's deadline is already blown — the client sees a timeout "
+    "the router then wastes threads finishing",
+))
+
+R_BIND_VALIDATE = register(Rule(
+    "KDT503", "bind-before-validate", SERVING,
+    "socket/server binding must come AFTER config validation in the "
+    "same function — a ValueError raised past the bind leaks the bound "
+    "socket (no close on the exception path) and the retry dies on "
+    "EADDRINUSE",
+    "the Router (PR 15) originally validated shards/quorum after "
+    "super().__init__ had bound the listener; the validation raise "
+    "leaked the bound socket and every restart-with-fixed-config died "
+    "on EADDRINUSE until the TIME_WAIT drained — validate-then-bind is "
+    "now the constructor contract",
+))
+
+R_ENV_PARSE = register(Rule(
+    "KDT504", "unguarded-env-parse-at-import", SERVING,
+    "int()/float() of an os.environ value at module import scope must "
+    "sit under a try/except (malformed value -> documented default) — "
+    "an unguarded parse turns a typo'd env var into an ImportError for "
+    "every consumer of the module",
+    "the flight recorder (PR 5) parsed KDTREE_TPU_FLIGHT_EVENTS at "
+    "import; a malformed value crashed EVERY instrumented import — the "
+    "whole serving process dead before main() — fixed by the guarded "
+    "_env_int default pattern obs/ now uses everywhere",
+))
+
+R_UNUSED_SUPPRESS = register(Rule(
+    "KDT505", "unused-suppression", SERVING,
+    "a kdt-lint suppression whose rule no longer fires at its line is "
+    "itself a finding — suppressions must not outlive their evidence, "
+    "or the comment outlives the sync/IO it excused and silently "
+    "licenses the NEXT violation someone writes on that line",
+    "the interprocedural engine (PR 18) re-sighted several grandfathered "
+    "suppressions whose underlying finding had been refactored away; a "
+    "stale disable= comment reads as documentation of a hazard that no "
+    "longer exists and masks one that may return",
+))
+
 
 # --------------------------------------------------------------------------
 # shared AST helpers
@@ -283,6 +366,22 @@ def _mk(rule: Rule, ctx, node: ast.AST, message: str) -> Finding:
         scope=func_qualname(node, ctx.parents),
         message=message,
         line_text=" ".join(ctx.line(line).split()),
+        scope_hash=(
+            ctx.scope_hash(node) if hasattr(ctx, "scope_hash") else ""
+        ),
+    )
+
+
+def _resolve(ctx, call: ast.Call) -> Optional[FuncInfo]:
+    """The unique function def this call targets per the whole-program
+    engine, or None (no engine on this ctx / ambiguous / dynamic)."""
+    prog = getattr(ctx, "program", None)
+    if prog is None:
+        return None
+    return prog.resolve_call(
+        getattr(ctx, "module", ""),
+        _enclosing_class(call, ctx.parents),
+        call,
     )
 
 
@@ -583,13 +682,9 @@ def check_nondeterminism(ctx) -> Iterator[Finding]:
 # leaf name -> the 1-based positional slot a timeout may legally occupy
 # (urlopen(url, data, timeout) / create_connection(addr, timeout) /
 # HTTP(S)Connection(host, port, timeout)); a call is clean when it passes
-# timeout= as a kwarg OR fills positionals through that slot
-_CLIENT_TIMEOUT_POS = {
-    "urlopen": 3,
-    "create_connection": 2,
-    "HTTPConnection": 3,
-    "HTTPSConnection": 3,
-}
+# timeout= as a kwarg OR fills positionals through that slot. The table
+# lives in program.py (the engine's wrapper detection reads it too).
+_CLIENT_TIMEOUT_POS = CLIENT_TIMEOUT_POS
 
 
 @checker(R_CLIENT_TIMEOUT)
@@ -597,22 +692,45 @@ def check_client_without_timeout(ctx) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
-        leaf = call_name(node).split(".")[-1]
-        slot = _CLIENT_TIMEOUT_POS.get(leaf)
-        if slot is None:
-            continue
-        if any(kw.arg == "timeout" for kw in node.keywords):
-            continue
         if any(isinstance(a, ast.Starred) for a in node.args) or \
                 any(kw.arg is None for kw in node.keywords):
             continue  # *args/**kwargs may carry it; syntactic rule stays quiet
-        if len(node.args) >= slot:
-            continue  # timeout passed positionally
+        leaf = call_name(node).split(".")[-1]
+        slot = _CLIENT_TIMEOUT_POS.get(leaf)
+        if slot is not None:
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if len(node.args) >= slot:
+                continue  # timeout passed positionally
+            yield _mk(
+                R_CLIENT_TIMEOUT, ctx, node,
+                f"{leaf}() without an explicit timeout inherits the "
+                "stdlib's block-forever default; one unreachable peer then "
+                "wedges this thread (and anything joining it) — pass "
+                "timeout=",
+            )
+            continue
+        # interprocedural: a call to a resolved WRAPPER whose timeout
+        # parameter defaults to None forwards the block-forever default
+        # just as surely as calling urlopen bare — the engine's fixpoint
+        # follows the forwarding chain any number of hops deep
+        target = _resolve(ctx, node)
+        if (
+            target is None
+            or target.timeout_param is None
+            or not target.timeout_default_none
+        ):
+            continue
+        if any(kw.arg == target.timeout_param for kw in node.keywords):
+            continue
+        if target.timeout_pos >= 0 and len(node.args) > target.timeout_pos:
+            continue
         yield _mk(
             R_CLIENT_TIMEOUT, ctx, node,
-            f"{leaf}() without an explicit timeout inherits the stdlib's "
-            "block-forever default; one unreachable peer then wedges this "
-            "thread (and anything joining it) — pass timeout=",
+            f"'{target.name}' forwards its '{target.timeout_param}' "
+            f"parameter into a stdlib client timeout and defaults it to "
+            "None (block forever); this call leaves it unbound — pass "
+            f"{target.timeout_param}=",
         )
 
 
@@ -636,14 +754,52 @@ def check_outbound_without_trace_context(ctx) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
+        if any(isinstance(a, ast.Starred) for a in node.args) or \
+                any(kw.arg is None for kw in node.keywords):
+            continue  # *args/**kwargs may carry it; syntactic rule stays quiet
         if call_name(node).split(".")[-1] != "request":
+            # interprocedural: a resolved WRAPPER that forwards a headers
+            # dict into an outbound POST is a propagation hop too — the
+            # call site owns the trace context, so the call site carries
+            # the rule: headers omitted entirely, or a literal dict
+            # missing the key, drops the context exactly like a direct
+            # conn.request would
+            target = _resolve(ctx, node)
+            if target is None or target.headers_param is None:
+                continue
+            hdr_expr = next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == target.headers_param), None,
+            )
+            if hdr_expr is None and 0 <= target.headers_pos < len(node.args):
+                hdr_expr = node.args[target.headers_pos]
+            if hdr_expr is None:
+                yield _mk(
+                    R_TRACE_CTX, ctx, node,
+                    f"'{target.name}' forwards its "
+                    f"'{target.headers_param}' dict into an outbound "
+                    f"POST; calling it without one cannot propagate "
+                    f"{_TRACE_CONTEXT_HEADER} — pass "
+                    "trace.outbound_header(ctx)",
+                )
+                continue
+            if not isinstance(hdr_expr, ast.Dict) or \
+                    any(k is None for k in hdr_expr.keys):
+                continue  # built elsewhere / spread may carry it
+            keys = {k.value for k in hdr_expr.keys
+                    if isinstance(k, ast.Constant)}
+            if _TRACE_CONTEXT_HEADER not in keys:
+                yield _mk(
+                    R_TRACE_CTX, ctx, node,
+                    f"headers passed through '{target.name}' to an "
+                    f"outbound POST lack {_TRACE_CONTEXT_HEADER!r}: this "
+                    "hop drops the trace context and orphans every "
+                    "downstream span — add the header",
+                )
             continue
         if not node.args or not isinstance(node.args[0], ast.Constant) \
                 or node.args[0].value != "POST":
             continue  # GETs (health probes, trace fetches) are exempt
-        if any(isinstance(a, ast.Starred) for a in node.args) or \
-                any(kw.arg is None for kw in node.keywords):
-            continue  # *args/**kwargs may carry it; syntactic rule stays quiet
         headers = next((kw.value for kw in node.keywords
                         if kw.arg == "headers"), None)
         if headers is None:
@@ -718,13 +874,28 @@ _HOT_DIRS = ("ops", "parallel", "pallas", "serve", "mutable")
 # stdlib handler types), the same by-detection idea as the obs.defer
 # exemption — no suppression comments needed for the normal pattern.
 _HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
-# jax.* calls that return host/callable objects, not device values
-_JAX_HOST_CALLS = {
-    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.default_backend",
-    "jax.devices", "jax.local_devices", "jax.device_count",
-}
+# jax.* calls that return host/callable objects, not device values:
+# _JAX_HOST_CALLS, imported from program.py (the engine's returns_device
+# summary shares the exemption list)
 _SYNC_METHODS = {"item", "block_until_ready"}
 _CAST_BUILTINS = {"bool", "int", "float"}
+# attribute reads that return HOST metadata of a device array, not the
+# array: int(x.shape[1]) costs nothing even when x lives on device, so
+# these launder taint out of an expression
+_HOST_META_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _walk_outside_host_meta(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk, but never descends through a ``.shape``/``.ndim``/
+    ``.dtype``/``.size`` attribute access — whatever sits under one is
+    only consulted for its host-side metadata."""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Attribute) and sub.attr in _HOST_META_ATTRS:
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
 
 
 def _in_hot_dir(relpath: str) -> bool:
@@ -741,12 +912,16 @@ class _Taint:
     shard_map(...)/jax.jit(...) results or imported with a ``_jit``
     suffix (the project convention for jitted programs); calls of
     Callable-annotated parameters (e.g. ``run_batch`` in
-    ``drive_batches``). Propagates through assignment, tuple unpack,
-    subscripts, for-targets, and comprehensions. No fixpoint — one pass
-    in statement order, which matches how this codebase is written.
+    ``drive_batches``); and — via the interprocedural engine — calls
+    RESOLVED to a function whose fixpoint summary says it returns a
+    device value, any number of helper hops away. Propagates through
+    assignment, tuple unpack, subscripts, for-targets, and
+    comprehensions. No local fixpoint — one pass in statement order,
+    which matches how this codebase is written.
     """
 
-    def __init__(self, device_callables: Set[str], parent: "_Taint" = None):
+    def __init__(self, device_callables: Set[str], parent: "_Taint" = None,
+                 resolver=None):
         self.tainted: Set[str] = set(parent.tainted) if parent else set()
         self.device_callables: Set[str] = set(device_callables)
         # parameters of the enclosing function: unknown provenance — a
@@ -754,11 +929,16 @@ class _Taint:
         # arrays through these APIs), while np.asarray() of a host-built
         # local (a Python list of ints) is not
         self.params: Set[str] = set(parent.params) if parent else set()
+        # resolver: Call -> bool (does the resolved callee return a
+        # device value?); inherited down nested scopes
+        self.resolver = resolver if resolver is not None else (
+            parent.resolver if parent else None
+        )
         if parent:
             self.device_callables |= parent.device_callables
 
     def expr_tainted(self, node: ast.AST) -> bool:
-        for sub in ast.walk(node):
+        for sub in _walk_outside_host_meta(node):
             if isinstance(sub, ast.Name) and sub.id in self.tainted:
                 return True
             if isinstance(sub, ast.Call):
@@ -770,6 +950,8 @@ class _Taint:
                 if root == "jax" and name not in _JAX_HOST_CALLS:
                     return True
                 if leaf.endswith("_jit") or name in self.device_callables:
+                    return True
+                if self.resolver is not None and self.resolver(sub):
                     return True
         return False
 
@@ -852,6 +1034,10 @@ def check_sync_in_hot_path(ctx) -> Iterator[Finding]:
     np_aliases = _numpy_aliases(ctx.tree)
     deferred = _deferred_scopes(ctx.tree)
 
+    def returns_device(call: ast.Call) -> bool:
+        target = _resolve(ctx, call)
+        return target is not None and target.returns_device
+
     def in_deferred(node: ast.AST) -> bool:
         cur = node
         while cur is not None:
@@ -920,7 +1106,7 @@ def check_sync_in_hot_path(ctx) -> Iterator[Finding]:
                 taint.expr_tainted(sub.args[0])
                 or any(
                     isinstance(n, ast.Name) and n.id in taint.params
-                    for n in ast.walk(sub.args[0])
+                    for n in _walk_outside_host_meta(sub.args[0])
                 )
             )
         ):
@@ -965,7 +1151,9 @@ def check_sync_in_hot_path(ctx) -> Iterator[Finding]:
                 if (a.asname or a.name).endswith("_jit"):
                     module_callables.add(a.asname or a.name)
 
-    yield from scan_stmts(ctx.tree.body, _Taint(module_callables))
+    yield from scan_stmts(
+        ctx.tree.body, _Taint(module_callables, resolver=returns_device)
+    )
 
 
 # --------------------------------------------------------------------------
@@ -1264,17 +1452,9 @@ def check_signal_unsafe_lock(ctx) -> Iterator[Finding]:
 # KDT402 — blocking-io-under-lock
 # --------------------------------------------------------------------------
 
-# blocking calls by DOTTED name (module-qualified stdlib I/O)...
-_IO_DOTTED = {
-    "os.replace", "os.rename", "os.remove", "os.unlink", "os.fsync",
-    "os.makedirs", "shutil.rmtree", "shutil.copy", "shutil.copyfile",
-    "time.sleep", "json.dump", "pickle.dump",
-}
-# ...and by leaf name (builtins / ctors that hit the disk or network)
-_IO_LEAFS = {
-    "open", "urlopen", "create_connection", "HTTPConnection",
-    "HTTPSConnection",
-}
+# blocking calls by DOTTED name (_IO_DOTTED) and by leaf name (_IO_LEAFS)
+# are imported from program.py — the engine's io_chain summary and this
+# rule's direct detection must agree on what "blocking I/O" means.
 
 
 def _is_io_call(node: ast.Call) -> bool:
@@ -1285,18 +1465,26 @@ def _is_io_call(node: ast.Call) -> bool:
     return leaf in _IO_LEAFS and leaf == name  # bare builtin/imported name
 
 
-def _io_in_block(stmts: List[ast.stmt]) -> Iterator[ast.Call]:
-    """Candidate I/O calls anywhere under these statements. Callers
-    filter out calls sitting inside NESTED defs/lambdas (their bodies
-    run later, usually off the lock — the flight writer-thread pattern)
-    via :func:`_under_nested_def`."""
+def _calls_in_block(stmts: List[ast.stmt]) -> Iterator[ast.Call]:
+    """Every Call anywhere under these statements, skipping nested
+    def/class statements (their bodies run later, off the lock)."""
     for stmt in stmts:
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             continue
         for sub in ast.walk(stmt):
-            if isinstance(sub, ast.Call) and _is_io_call(sub):
+            if isinstance(sub, ast.Call):
                 yield sub
+
+
+def _io_in_block(stmts: List[ast.stmt]) -> Iterator[ast.Call]:
+    """Candidate I/O calls anywhere under these statements. Callers
+    filter out calls sitting inside NESTED defs/lambdas (their bodies
+    run later, usually off the lock — the flight writer-thread pattern)
+    via :func:`_under_nested_def`."""
+    for sub in _calls_in_block(stmts):
+        if _is_io_call(sub):
+            yield sub
 
 
 def _under_nested_def(node: ast.AST, stop: ast.AST, parents) -> bool:
@@ -1314,10 +1502,33 @@ def check_blocking_io_under_lock(ctx) -> Iterator[Finding]:
     bindings = _lock_bindings(ctx)
     flagged: Set[int] = set()
 
-    def emit(call: ast.Call, lockname: str) -> Iterator[Finding]:
+    def helper_io_chain(call: ast.Call) -> Optional[Tuple[str, ...]]:
+        """The call path by which a resolved NON-I/O call reaches
+        blocking I/O ('flush_stats -> json.dump'), per the engine's
+        fixpoint io_chain summary; None when it doesn't (or the call is
+        direct I/O — handled by the syntactic path)."""
+        if _is_io_call(call):
+            return None
+        target = _resolve(ctx, call)
+        if target is not None and target.io_chain is not None:
+            return (target.name,) + target.io_chain
+        return None
+
+    def emit(call: ast.Call, lockname: str,
+             chain: Optional[Tuple[str, ...]] = None) -> Iterator[Finding]:
         if id(call) in flagged:
             return
         flagged.add(id(call))
+        if chain is not None:
+            yield _mk(
+                R_IO_UNDER_LOCK, ctx, call,
+                f"{call_name(call)}() reaches blocking I/O "
+                f"({' -> '.join(chain)}) while '{lockname}' is held: "
+                "every thread contending on that lock stalls for the "
+                "full I/O duration — snapshot under the lock, call the "
+                "helper outside it",
+            )
+            return
         yield _mk(
             R_IO_UNDER_LOCK, ctx, call,
             f"{call_name(call)}() blocks while '{lockname}' is held: "
@@ -1339,10 +1550,15 @@ def check_blocking_io_under_lock(ctx) -> Iterator[Finding]:
         ]
         if not locknames:
             continue
-        for call in _io_in_block(node.body):
+        for call in _calls_in_block(node.body):
             if _under_nested_def(call, node, ctx.parents):
                 continue
-            yield from emit(call, locknames[0])
+            if _is_io_call(call):
+                yield from emit(call, locknames[0])
+                continue
+            chain = helper_io_chain(call)
+            if chain is not None:
+                yield from emit(call, locknames[0], chain)
 
     # form 2: .acquire() ... .release() spans — including the canonical
     # `lock.acquire(); try: <I/O> finally: lock.release()` shape, so the
@@ -1406,9 +1622,15 @@ def check_blocking_io_under_lock(ctx) -> Iterator[Finding]:
                 # own I/O is judged, a release only after
                 upd_acquire(stmt)
                 if held[0] is not None:
-                    for call in _io_in_block([stmt]):
-                        if not _under_nested_def(call, stmt, ctx.parents):
+                    for call in _calls_in_block([stmt]):
+                        if _under_nested_def(call, stmt, ctx.parents):
+                            continue
+                        if _is_io_call(call):
                             yield from emit(call, held[0])
+                            continue
+                        chain = helper_io_chain(call)
+                        if chain is not None:
+                            yield from emit(call, held[0], chain)
                 upd_release(stmt)
 
         yield from walk(body)
@@ -1613,3 +1835,346 @@ def check_dynamic_slo_name(ctx) -> Iterator[Finding]:
                 "new kdtree_slo_*/history series forever — use a static "
                 "name from a bounded set",
             )
+
+
+# --------------------------------------------------------------------------
+# KDT501 — response-not-drained-before-release
+# --------------------------------------------------------------------------
+
+
+def _scope_params(func: ast.AST) -> Set[str]:
+    a = func.args
+    return {
+        x.arg
+        for x in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs))
+    }
+
+
+@checker(R_BODY_DRAIN)
+def check_response_not_drained(ctx) -> Iterator[Finding]:
+    # per function scope: responses = names assigned from .getresponse();
+    # a pool-ish .release(...) in the same scope asserts the exchange was
+    # clean, so every response must be provably drained by then —
+    # resp.read() directly, or resp passed to a RESOLVED callee whose
+    # fixpoint summary drains that parameter (any number of hops deep).
+    # Escapes stay quiet (predictable false negatives): resp returned or
+    # yielded, stored onto an attribute/container, or passed to a call
+    # the engine cannot resolve. A resolved callee that does NOT drain is
+    # not an escape — that is the knowledge the engine buys.
+    for func in iter_funcs(ctx.tree):
+        responses: Dict[str, ast.AST] = {}
+        drained: Set[str] = set()
+        escaped: Set[str] = set()
+        releases: List[ast.Call] = []
+        for node in scope_walk(func):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "getresponse"
+            ):
+                responses[node.targets[0].id] = node
+                continue
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = getattr(node, "value", None)
+                if val is not None:
+                    for sub in ast.walk(val):
+                        if isinstance(sub, ast.Name):
+                            escaped.add(sub.id)
+                continue
+            if isinstance(node, ast.Assign) and not (
+                len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                # stored into self.X / a container: ownership left scope
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name):
+                        escaped.add(sub.id)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "read"
+                and isinstance(node.func.value, ast.Name)
+            ):
+                drained.add(node.func.value.id)
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"
+                and "pool" in dotted_name(node.func.value).lower()
+            ):
+                verdict = next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "drained"), None,
+                )
+                if verdict is None:
+                    releases.append(node)
+                # an explicit drained= verdict (False, or a computed
+                # flag) means the caller decided — the pool degrades
+                # undrained releases to discards by contract
+                continue
+            # resp as an argument to some call
+            args = list(node.args) + [
+                kw.value for kw in node.keywords if kw.value is not None
+            ]
+            names = {
+                sub.id
+                for a in args
+                if not isinstance(a, ast.Starred)
+                for sub in ast.walk(a)
+                if isinstance(sub, ast.Name)
+            }
+            hit = names & set(responses)
+            if not hit:
+                continue
+            target = _resolve(ctx, node)
+            if target is None:
+                escaped.update(hit)  # unknown callee: stay quiet
+                continue
+            tparams = target.params()
+            for resp in hit:
+                expr_params = []
+                for i, a in enumerate(node.args):
+                    if isinstance(a, ast.Name) and a.id == resp:
+                        if i < len(tparams):
+                            expr_params.append(tparams[i])
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) \
+                            and kw.value.id == resp and kw.arg:
+                        expr_params.append(kw.arg)
+                if any(p in target.drains_params for p in expr_params):
+                    drained.add(resp)
+                elif not expr_params:
+                    # buried in an expression / *args: can't track
+                    escaped.add(resp)
+        if not releases:
+            continue
+        undrained = sorted(set(responses) - drained - escaped)
+        for resp in undrained:
+            for rel in releases:
+                yield _mk(
+                    R_BODY_DRAIN, ctx, rel,
+                    f"connection released to the pool while response "
+                    f"'{resp}' is not drained to EOF: the leftover body "
+                    "bytes stay on the socket and the NEXT lease reads "
+                    "them as its own response (keep-alive desync) — "
+                    f"{resp}.read() before release, or pass an explicit "
+                    "drained= verdict",
+                )
+
+
+# --------------------------------------------------------------------------
+# KDT502 — constant-timeout-under-deadline
+# --------------------------------------------------------------------------
+
+_DEADLINE_HINTS = ("deadline", "budget", "remaining", "timeout")
+
+
+def _deadline_names(func: ast.AST) -> Set[str]:
+    """Deadline-ish names in this function's parameters and locals — the
+    evidence that this code runs under a request deadline it should be
+    pricing its outbound waits against."""
+    out = {
+        p for p in _scope_params(func)
+        if any(h in p.lower() for h in _DEADLINE_HINTS)
+    }
+    for node in scope_walk(func):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and any(
+                    h in t.id.lower() for h in _DEADLINE_HINTS
+                ):
+                    out.add(t.id)
+    return out
+
+
+@checker(R_CONST_TIMEOUT)
+def check_constant_timeout_under_deadline(ctx) -> Iterator[Finding]:
+    # serve-layer only: that is where request deadlines live; a constant
+    # timeout in a CLI tool or test client has no deadline to honor
+    if "serve" not in ctx.relpath.split("/"):
+        return
+    for func in iter_funcs(ctx.tree):
+        deadlines = _deadline_names(func)
+        if not deadlines:
+            continue
+        for node in scope_walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            timeout_expr = None
+            leaf = call_name(node).split(".")[-1]
+            slot = _CLIENT_TIMEOUT_POS.get(leaf)
+            if slot is not None:
+                timeout_expr = next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "timeout"), None,
+                )
+                if timeout_expr is None and len(node.args) >= slot:
+                    timeout_expr = node.args[slot - 1]
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "settimeout"
+                and node.args
+            ):
+                timeout_expr = node.args[0]
+            else:
+                target = _resolve(ctx, node)
+                if target is not None and target.timeout_param:
+                    timeout_expr = next(
+                        (kw.value for kw in node.keywords
+                         if kw.arg == target.timeout_param), None,
+                    )
+                    if timeout_expr is None and \
+                            0 <= target.timeout_pos < len(node.args):
+                        timeout_expr = node.args[target.timeout_pos]
+            if timeout_expr is None:
+                continue
+            if not _is_const_expr(timeout_expr):
+                continue  # derived from a Name: assumed deadline-priced
+            yield _mk(
+                R_CONST_TIMEOUT, ctx, node,
+                f"constant timeout in a function that carries "
+                f"'{sorted(deadlines)[0]}': the wait ignores the "
+                "remaining request deadline — derive it "
+                "(max(deadline - elapsed, eps)) so one slow hop cannot "
+                "overshoot the budget the caller is holding",
+            )
+
+
+# --------------------------------------------------------------------------
+# KDT503 — bind-before-validate
+# --------------------------------------------------------------------------
+
+_VALIDATE_PREFIXES = ("validate", "check_")
+
+
+def _under_try(node: ast.AST, stop: ast.AST, parents) -> bool:
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, ast.Try):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+@checker(R_BIND_VALIDATE)
+def check_bind_before_validate(ctx) -> Iterator[Finding]:
+    # per function: a bind event (sock.bind / server_bind /
+    # SomeServer(...) construction / super().__init__ in a *Server
+    # subclass) followed — in source order — by a validation event (a
+    # straight-line raise of ValueError/TypeError/KeyError, a call to a
+    # validate*/check_* helper, or a RESOLVED callee whose summary says
+    # it raises a config error). The raise on the validation path then
+    # leaks the bound socket: nothing closes it, and the retry dies on
+    # EADDRINUSE until TIME_WAIT drains.
+    for func in iter_funcs(ctx.tree):
+        cls = _enclosing_class(func, ctx.parents)
+        binds: List[ast.AST] = []
+        validations: List[ast.AST] = []
+        for node in scope_walk(func):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                leaf = name.split(".")[-1]
+                if leaf in ("bind", "server_bind") and \
+                        isinstance(node.func, ast.Attribute):
+                    binds.append(node)
+                    continue
+                if leaf.endswith("Server") and leaf != "Server":
+                    binds.append(node)
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "__init__"
+                    and isinstance(node.func.value, ast.Call)
+                    and call_name(node.func.value) == "super"
+                    and cls is not None
+                ):
+                    # super().__init__ binds iff a base is Server-ish
+                    cur: Optional[ast.AST] = ctx.parents.get(func)
+                    while cur is not None and not isinstance(
+                        cur, ast.ClassDef
+                    ):
+                        cur = ctx.parents.get(cur)
+                    if cur is not None and any(
+                        "Server" in dotted_name(b) for b in cur.bases
+                    ):
+                        binds.append(node)
+                    continue
+                if any(leaf.startswith(p) for p in _VALIDATE_PREFIXES):
+                    validations.append(node)
+                    continue
+                target = _resolve(ctx, node)
+                if target is not None and target.raises_config_error:
+                    validations.append(node)
+                    continue
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                if _under_try(node, func, ctx.parents):
+                    continue  # error translation, not validation
+                exc = node.exc
+                exc_leaf = dotted_name(
+                    exc.func if isinstance(exc, ast.Call) else exc
+                ).split(".")[-1]
+                if exc_leaf in ("ValueError", "TypeError", "KeyError"):
+                    validations.append(node)
+        for bind in binds:
+            later = [
+                v for v in validations
+                if getattr(v, "lineno", 0) > getattr(bind, "lineno", 0)
+            ]
+            if later:
+                yield _mk(
+                    R_BIND_VALIDATE, ctx, bind,
+                    "socket bound before config validation (a raise at "
+                    f"line {getattr(later[0], 'lineno', '?')} can still "
+                    "reject the config): the exception path leaks the "
+                    "bound socket and the retry dies on EADDRINUSE — "
+                    "validate everything, then bind",
+                )
+
+
+# --------------------------------------------------------------------------
+# KDT504 — unguarded-env-parse-at-import
+# --------------------------------------------------------------------------
+
+
+def _mentions_environ(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+            "environ", "getenv",
+        ):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in ("environ", "getenv"):
+            return True
+    return False
+
+
+@checker(R_ENV_PARSE)
+def check_unguarded_env_parse(ctx) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("int", "float")
+        ):
+            continue
+        if not node.args or not _mentions_environ(node.args[0]):
+            continue
+        if func_qualname(node, ctx.parents) != "<module>":
+            continue  # inside a function: lazily evaluated, guardable
+        if any(
+            isinstance(anc, ast.Try)
+            for anc in _ancestors(node, ctx.parents, ctx.tree)
+        ):
+            continue
+        yield _mk(
+            R_ENV_PARSE, ctx, node,
+            f"{node.func.id}() of an environment variable at import "
+            "scope: a malformed value raises at import time and takes "
+            "down every consumer of this module — wrap in try/except "
+            "with a documented default (the obs._env_int pattern)",
+        )

@@ -1,0 +1,538 @@
+"""Whole-program interprocedural engine: import graph, call graph, and
+fixpoint-propagated per-function summaries.
+
+The per-file checkers (:mod:`~kdtree_tpu.analysis.checkers`) are
+deliberately syntactic, and their catalog entries document the blind
+spots that buys: "``**kwargs`` pass-throughs stay quiet", "nested defs
+stay quiet" — every one a *function boundary*. This module is the other
+half of the bargain. It parses the whole lint tree once, resolves
+imports into a module graph, resolves calls into a call graph, and
+computes a small, fixed vocabulary of **function summaries**:
+
+- ``returns_device`` — calling this function yields a device value
+  (KDT201's taint pass seeds on resolved calls, so a sync of a value
+  that crossed two helpers is still a sync);
+- ``io_chain`` — the call path by which this function reaches blocking
+  I/O (KDT402 flags a helper call under a lock, naming the chain);
+- ``timeout_wrapper`` — this function forwards a ``timeout``-carrying
+  parameter into a stdlib client's timeout slot, possibly through
+  further wrappers (KDT107 flags call sites that leave it unbound);
+- ``headers_wrapper`` — same for a ``headers`` dict forwarded into an
+  outbound POST (KDT110 follows the wrapper instead of staying quiet);
+- ``drains_params`` / ``raises_config_error`` — the KDT501/KDT503
+  serving-protocol band's cross-function evidence.
+
+Summaries are propagated to a fixpoint over the call graph (all facts
+are monotone booleans/sets, so iteration terminates; depth is bounded
+by the longest wrapper chain). Resolution is conservative by
+construction — a name it cannot map to exactly one function def simply
+does not resolve, and an unresolved call contributes nothing. That
+keeps the soundness stance of the per-file rules: predictable false
+negatives over unpredictable false positives.
+
+The engine is stdlib-only (``ast``), like everything on the lint path.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# -- shared leaf-name vocabulary (kept here, not imported from checkers,
+# so checkers.py may import program.py without a cycle) ---------------------
+
+# stdlib client constructors/calls and the 1-based positional slot a
+# timeout may legally occupy (mirrors checkers._CLIENT_TIMEOUT_POS; the
+# two are pinned equal by a test)
+CLIENT_TIMEOUT_POS = {
+    "urlopen": 3,
+    "create_connection": 2,
+    "HTTPConnection": 3,
+    "HTTPSConnection": 3,
+}
+
+_IO_DOTTED = {
+    "os.replace", "os.rename", "os.remove", "os.unlink", "os.fsync",
+    "os.makedirs", "shutil.rmtree", "shutil.copy", "shutil.copyfile",
+    "time.sleep", "json.dump", "pickle.dump",
+}
+_IO_LEAFS = {
+    "open", "urlopen", "create_connection", "HTTPConnection",
+    "HTTPSConnection",
+}
+
+_JAX_HOST_CALLS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.default_backend",
+    "jax.devices", "jax.local_devices", "jax.device_count",
+}
+
+_CONFIG_ERRORS = {"ValueError", "TypeError", "KeyError"}
+
+_MAX_FIXPOINT_ITERS = 32  # >> any real wrapper-chain depth; a backstop
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)
+
+
+def is_io_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name in _IO_DOTTED:
+        return True
+    leaf = name.split(".")[-1]
+    return leaf in _IO_LEAFS and leaf == name
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module name for a posix relpath ('pkg/sub/mod.py' ->
+    'pkg.sub.mod'; a package __init__ is the package itself)."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def scope_walk(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` WITHOUT descending into nested function/class/lambda
+    scopes — the summary of a function describes what *calling it* does,
+    and a nested def's body runs later (or never). Yields preorder in
+    SOURCE order: several consumers (the local taint in
+    ``_returns_device``, KDT501's assign-then-use tracking) are
+    statement-order passes."""
+    stack = list(reversed(list(ast.iter_child_nodes(root))))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _ordered_params(func: ast.AST) -> List[str]:
+    """Positional-bindable parameter names, 'self'/'cls' stripped so a
+    method's positional slots are counted the way CALL SITES see them."""
+    a = func.args
+    names = [x.arg for x in list(a.posonlyargs) + list(a.args)]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _kwonly_params(func: ast.AST) -> List[str]:
+    return [x.arg for x in func.args.kwonlyargs]
+
+
+def _param_default_is_none(func: ast.AST, param: str) -> bool:
+    """True when ``param``'s declared default is literally ``None`` —
+    the one default a forwarding wrapper turns into block-forever."""
+    a = func.args
+    pos = list(a.posonlyargs) + list(a.args)
+    for arg, default in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        if arg.arg == param:
+            return isinstance(default, ast.Constant) and default.value is None
+    for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+        if arg.arg == param and default is not None:
+            return isinstance(default, ast.Constant) and default.value is None
+    return False
+
+
+@dataclass
+class FuncInfo:
+    """One function def plus its fixpoint-propagated summary."""
+
+    fq: str                      # 'pkg.mod.Class.method' / 'pkg.mod.fn'
+    module: str
+    relpath: str
+    name: str                    # leaf name
+    cls: Optional[str]           # enclosing class, methods only
+    node: ast.AST
+    # summary facts (monotone: False->True / None->chain / growing set)
+    returns_device: bool = False
+    io_chain: Optional[Tuple[str, ...]] = None
+    raises_config_error: bool = False
+    drains_params: Set[str] = field(default_factory=set)
+    # timeout/headers forwarding wrappers: (param name, positional index
+    # as call sites count it, default-is-None)
+    timeout_param: Optional[str] = None
+    timeout_pos: int = -1
+    timeout_default_none: bool = False
+    headers_param: Optional[str] = None
+    headers_pos: int = -1
+
+    def params(self) -> List[str]:
+        return _ordered_params(self.node)
+
+
+class Program:
+    """The whole-program view every :class:`FileContext` carries.
+
+    Build once per lint run from EVERY parsed file under the root (in
+    ``--changed`` mode the emission set shrinks, the program does not —
+    a wrapper's summary must not depend on which files changed).
+    """
+
+    def __init__(self, files: List[Tuple[str, ast.Module]]) -> None:
+        """``files``: (posix relpath, parsed module) pairs."""
+        self.modules: Dict[str, ast.Module] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self._imports: Dict[str, Dict[str, str]] = {}
+        for relpath, tree in files:
+            mod = module_name_for(relpath)
+            if not mod or mod in self.modules:
+                continue
+            self.modules[mod] = tree
+            self._imports[mod] = self._import_map(tree, mod)
+            self._collect_functions(mod, relpath, tree)
+        self._fixpoint()
+
+    # -- construction --------------------------------------------------------
+
+    def _collect_functions(self, module: str, relpath: str,
+                           tree: ast.Module) -> None:
+        def visit(body: List[ast.stmt], prefix: str,
+                  cls: Optional[str]) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fq = f"{module}.{prefix}{node.name}"
+                    # duplicate defs (overloads, if/else platform forks):
+                    # keep the FIRST and never merge — ambiguity must not
+                    # invent facts
+                    self.functions.setdefault(fq, FuncInfo(
+                        fq=fq, module=module, relpath=relpath,
+                        name=node.name, cls=cls, node=node,
+                    ))
+                    # nested defs are not addressable call targets from
+                    # other functions; don't recurse
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body, f"{prefix}{node.name}.", node.name)
+
+        visit(tree.body, "", None)
+
+    def _import_map(self, tree: ast.Module, module: str) -> Dict[str, str]:
+        """local name -> fully-qualified dotted target."""
+        out: Dict[str, str] = {}
+        pkg_parts = module.split(".")[:-1]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        out[a.asname] = a.name
+                    # 'import a.b' binds 'a'; dotted uses resolve via the
+                    # longest-module-prefix fallback in resolve_call
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    anchor = module.split(".")
+                    cut = len(anchor) - node.level
+                    if cut < 0:
+                        continue  # relative import escaping the tree
+                    parent = anchor[:cut]
+                    base = ".".join(parent + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name
+                    )
+        # unused but harmless: keeps the signature honest
+        del pkg_parts
+        return out
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_call(self, module: str, cls: Optional[str],
+                     call: ast.Call) -> Optional[FuncInfo]:
+        """The unique :class:`FuncInfo` this call targets, or None.
+
+        Resolves: bare same-module names, ``self.method`` within the
+        enclosing class, imported names (``from m import f`` /
+        ``import m as alias; alias.f``), and fully-dotted module paths
+        (``import a.b; a.b.f()``). Anything else — receiver-typed
+        attribute calls, getattr, callables in containers — does not
+        resolve, by design.
+        """
+        name = call_name(call)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2 and cls:
+            return self.functions.get(f"{module}.{cls}.{parts[1]}")
+        imap = self._imports.get(module, {})
+        if parts[0] in imap:
+            target = imap[parts[0]]
+            rest = ".".join(parts[1:])
+            fq = f"{target}.{rest}" if rest else target
+            return self.functions.get(fq)
+        if len(parts) == 1:
+            fi = self.functions.get(f"{module}.{parts[0]}")
+            if fi is not None:
+                return fi
+            if cls:
+                return self.functions.get(f"{module}.{cls}.{parts[0]}")
+            return None
+        # fully-dotted path: longest prefix that names a known module
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                return self.functions.get(f"{mod}.{'.'.join(parts[i:])}")
+        return None
+
+    # -- summaries -----------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        funcs = list(self.functions.values())
+        for _ in range(_MAX_FIXPOINT_ITERS):
+            changed = False
+            for fi in funcs:
+                if not fi.returns_device and self._returns_device(fi):
+                    fi.returns_device = True
+                    changed = True
+                if fi.io_chain is None:
+                    chain = self._io_chain(fi)
+                    if chain is not None:
+                        fi.io_chain = chain
+                        changed = True
+                if not fi.raises_config_error and self._raises_config(fi):
+                    fi.raises_config_error = True
+                    changed = True
+                grew = self._drains_params(fi)
+                if grew:
+                    changed = True
+                if fi.timeout_param is None and self._timeout_wrapper(fi):
+                    changed = True
+                if fi.headers_param is None and self._headers_wrapper(fi):
+                    changed = True
+            if not changed:
+                return
+
+    def _resolved(self, fi: FuncInfo, call: ast.Call) -> Optional[FuncInfo]:
+        return self.resolve_call(fi.module, fi.cls, call)
+
+    def _returns_device(self, fi: FuncInfo) -> bool:
+        """Does some return statement yield a device value? A one-pass,
+        statement-order local taint (assignment binds, return checks),
+        seeded by jnp/lax/jax calls, ``*_jit`` names, and resolved calls
+        to functions already known to return device values."""
+        tainted: Set[str] = set()
+
+        def expr_device(e: ast.AST) -> bool:
+            for sub in ast.walk(e):
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+                if isinstance(sub, ast.Call):
+                    n = call_name(sub)
+                    root = n.split(".")[0]
+                    if root in ("jnp", "lax") and "." in n:
+                        return True
+                    if root == "jax" and n not in _JAX_HOST_CALLS:
+                        return True
+                    if n.split(".")[-1].endswith("_jit"):
+                        return True
+                    t = self._resolved(fi, sub)
+                    if t is not None and t is not fi and t.returns_device:
+                        return True
+            return False
+
+        found = False
+        for node in scope_walk(fi.node):
+            if isinstance(node, ast.Assign) and expr_device(node.value):
+                for tgt in node.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            tainted.add(sub.id)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                if expr_device(node.value):
+                    found = True
+        return found
+
+    def _io_chain(self, fi: FuncInfo) -> Optional[Tuple[str, ...]]:
+        """('json.dump',) for direct I/O; ('helper', 'json.dump') when
+        reached through a resolved callee. Nested defs excluded — their
+        bodies run off this call."""
+        for node in scope_walk(fi.node):
+            if isinstance(node, ast.Call) and is_io_call(node):
+                return (call_name(node),)
+        for node in scope_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            t = self._resolved(fi, node)
+            if t is not None and t is not fi and t.io_chain is not None:
+                return (t.name,) + t.io_chain
+        return None
+
+    def _raises_config(self, fi: FuncInfo) -> bool:
+        """A straight-line ``raise ValueError/TypeError/KeyError`` — the
+        validation shape. Raises inside try/except are error translation,
+        not validation, and stay out (KDT503 consumes this fact)."""
+        def visit(body: List[ast.stmt]) -> bool:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef, ast.Try)):
+                    continue
+                if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                    exc = stmt.exc
+                    leaf = dotted_name(
+                        exc.func if isinstance(exc, ast.Call) else exc
+                    ).split(".")[-1]
+                    if leaf in _CONFIG_ERRORS:
+                        return True
+                for blk in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, blk, None)
+                    if isinstance(sub, list) and visit(sub):
+                        return True
+            return False
+
+        return visit(list(fi.node.body))
+
+    def _call_binds_param(self, call: ast.Call, target: FuncInfo,
+                          param: str, pos: int) -> Optional[bool]:
+        """Does this call bind ``param`` (positional index ``pos``) of
+        ``target``? None = can't tell (*args/**kwargs)."""
+        if any(isinstance(a, ast.Starred) for a in call.args) or \
+                any(kw.arg is None for kw in call.keywords):
+            return None
+        if any(kw.arg == param for kw in call.keywords):
+            return True
+        return pos >= 0 and len(call.args) > pos
+
+    def _arg_expr_for(self, call: ast.Call, param: str,
+                      pos: int) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        if 0 <= pos < len(call.args):
+            return call.args[pos]
+        return None
+
+    def _timeout_wrapper(self, fi: FuncInfo) -> bool:
+        """Record (param, pos, default-None) when ``fi`` forwards a
+        timeout-named parameter into a stdlib client's timeout slot or
+        into an already-known timeout wrapper."""
+        params = fi.params()
+        cands = [p for p in params + _kwonly_params(fi.node)
+                 if "timeout" in p.lower()]
+        if not cands:
+            return False
+        for node in scope_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = call_name(node).split(".")[-1]
+            slot = CLIENT_TIMEOUT_POS.get(leaf)
+            forwarded: Optional[str] = None
+            if slot is not None:
+                expr = self._arg_expr_for(node, "timeout", slot - 1)
+                if isinstance(expr, ast.Name) and expr.id in cands:
+                    forwarded = expr.id
+            else:
+                t = self._resolved(fi, node)
+                if t is not None and t is not fi and t.timeout_param:
+                    expr = self._arg_expr_for(node, t.timeout_param,
+                                              t.timeout_pos)
+                    if isinstance(expr, ast.Name) and expr.id in cands:
+                        forwarded = expr.id
+            if forwarded is not None:
+                fi.timeout_param = forwarded
+                fi.timeout_pos = (params.index(forwarded)
+                                  if forwarded in params else -1)
+                # a wrapper that REASSIGNS the param before forwarding
+                # (``if timeout is None: timeout = 5.0``) normalizes the
+                # None default away — treat it as safe
+                reassigned = any(
+                    isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                    and any(
+                        isinstance(t, ast.Name) and t.id == forwarded
+                        for t in (
+                            n.targets if isinstance(n, ast.Assign)
+                            else [n.target]
+                        )
+                    )
+                    for n in scope_walk(fi.node)
+                )
+                fi.timeout_default_none = (
+                    _param_default_is_none(fi.node, forwarded)
+                    and not reassigned
+                )
+                return True
+        return False
+
+    def _headers_wrapper(self, fi: FuncInfo) -> bool:
+        """Record (param, pos) when ``fi`` forwards a headers-named dict
+        parameter into an outbound POST (``X.request('POST', ...,
+        headers=<p>)``) or into an already-known headers wrapper."""
+        params = fi.params()
+        cands = [p for p in params + _kwonly_params(fi.node)
+                 if "headers" in p.lower()]
+        if not cands:
+            return False
+        for node in scope_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            forwarded: Optional[str] = None
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "request"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "POST"
+            ):
+                expr = next((kw.value for kw in node.keywords
+                             if kw.arg == "headers"), None)
+                if isinstance(expr, ast.Name) and expr.id in cands:
+                    forwarded = expr.id
+            else:
+                t = self._resolved(fi, node)
+                if t is not None and t is not fi and t.headers_param:
+                    expr = self._arg_expr_for(node, t.headers_param,
+                                              t.headers_pos)
+                    if isinstance(expr, ast.Name) and expr.id in cands:
+                        forwarded = expr.id
+            if forwarded is not None:
+                fi.headers_param = forwarded
+                fi.headers_pos = (params.index(forwarded)
+                                  if forwarded in params else -1)
+                return True
+        return False
+
+    def _drains_params(self, fi: FuncInfo) -> bool:
+        """Grow ``drains_params``: parameters on which ``.read()`` is
+        called, directly or through a resolved drain helper. Returns
+        whether the set grew (fixpoint bookkeeping)."""
+        params = set(fi.params()) | set(_kwonly_params(fi.node))
+        before = len(fi.drains_params)
+        for node in scope_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "read"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in params
+            ):
+                fi.drains_params.add(node.func.value.id)
+                continue
+            t = self._resolved(fi, node)
+            if t is None or t is fi or not t.drains_params:
+                continue
+            tparams = t.params()
+            for drained in t.drains_params:
+                expr = self._arg_expr_for(
+                    node, drained,
+                    tparams.index(drained) if drained in tparams else -1,
+                )
+                if isinstance(expr, ast.Name) and expr.id in params:
+                    fi.drains_params.add(expr.id)
+        return len(fi.drains_params) > before

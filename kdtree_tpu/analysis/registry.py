@@ -3,7 +3,9 @@
 Rule ids are stable API — suppression comments and baselines reference
 them — so they are never renumbered or reused. Bands by category:
 ``KDT1xx`` correctness, ``KDT2xx`` performance, ``KDT3xx`` hygiene,
-``KDT4xx`` concurrency.
+``KDT4xx`` concurrency, ``KDT5xx`` serving protocol (the rules that
+need the interprocedural engine in :mod:`~kdtree_tpu.analysis.program`
+to see across function boundaries).
 
 A checker is a function ``(ctx: FileContext) -> Iterable[Finding]``
 registered against one rule with :func:`checker`; the walker runs every
@@ -21,6 +23,7 @@ CORRECTNESS = "correctness"
 PERFORMANCE = "performance"
 HYGIENE = "hygiene"
 CONCURRENCY = "concurrency"
+SERVING = "serving"
 
 
 @dataclass(frozen=True)
@@ -33,7 +36,7 @@ class Rule:
 
     id: str
     name: str  # kebab-case slug, shown next to the id
-    category: str  # correctness | performance | hygiene | concurrency
+    category: str  # correctness | performance | hygiene | concurrency | serving
     summary: str
     origin: str
 
@@ -51,12 +54,23 @@ class Finding:
     message: str
     line_text: str = ""  # stripped source line (baseline fingerprint input)
     baselined: bool = False
+    scope_hash: str = ""  # content hash of the enclosing scope's source
 
     def fingerprint(self) -> str:
         """Line-number-free identity: unrelated edits above a grandfathered
         finding must not churn the baseline, so the fingerprint is
         (rule, file, enclosing scope, the offending line's own text)."""
         return "|".join((self.rule, self.path, self.scope, self.line_text))
+
+    def move_fingerprint(self) -> str:
+        """Path-free identity for move tolerance: a ``git mv`` keeps the
+        enclosing scope's CONTENT identical, so (rule, scope, line text,
+        scope-content hash) still matches a baseline entry written under
+        the old path. Without the hash, dropping the path would let a
+        grandfathered finding in one file excuse a brand-new copy-paste
+        of the same line in another."""
+        return "|".join((self.rule, self.scope, self.line_text,
+                         self.scope_hash))
 
     def location(self) -> str:
         return f"{self.path}:{self.line}:{self.col + 1}"

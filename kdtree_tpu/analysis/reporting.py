@@ -1,8 +1,14 @@
-"""Human and JSON rendering of a lint run.
+"""Human, JSON, and SARIF rendering of a lint run.
 
 Human output is grep/editor-friendly (``path:line:col: RULE [slug]
 message``); JSON is the machine contract CI uploads as an artifact —
-stable keys, schema versioned alongside the baseline format.
+stable keys, schema versioned alongside the baseline format. SARIF
+2.1.0 is the interchange contract GitHub code scanning ingests: one
+``run`` with the full rule catalog in the driver, one ``result`` per
+finding, baselined findings carried as ``suppressions`` of kind
+``external`` and inline-suppressed ones as kind ``inSource`` (with the
+mandatory reason as the justification) — so the annotation layer sees
+everything but alerts only on what the exit code would fail on.
 """
 
 from __future__ import annotations
@@ -10,10 +16,16 @@ from __future__ import annotations
 import json
 from typing import List, Optional
 
-from kdtree_tpu.analysis.registry import RULES
+from kdtree_tpu.analysis.registry import RULES, all_rules
 from kdtree_tpu.analysis.walker import LintResult
 
 FORMAT_VERSION = 1
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def render_human(result: LintResult, new_count: Optional[int] = None) -> str:
@@ -76,5 +88,105 @@ def render_json(result: LintResult, new_count: Optional[int] = None) -> str:
                 else sum(1 for f in result.findings if not f.baselined)
             ),
         },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def render_sarif(result: LintResult, root: str = "") -> str:
+    """SARIF 2.1.0 document for this run (GitHub code scanning upload).
+
+    Every registered rule goes into the driver (stable ``ruleIndex`` by
+    sorted id); every finding becomes a ``result`` carrying the
+    baseline's line-number-free fingerprint as a partialFingerprint so
+    the ingester's dedup survives unrelated edits, exactly like the
+    committed baseline does.
+    """
+    rules = all_rules()
+    rule_index = {r.id: i for i, r in enumerate(rules)}
+
+    def rule_obj(r):
+        return {
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.summary},
+            "fullDescription": {"text": r.origin},
+            "properties": {"category": r.category},
+        }
+
+    def location(path: str, line: int, col: int) -> dict:
+        return {
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": path,
+                    "uriBaseId": "SRCROOT",
+                },
+                "region": {
+                    "startLine": max(line, 1),
+                    "startColumn": max(col, 1),
+                },
+            }
+        }
+
+    results = []
+    for f in result.findings:
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index.get(f.rule, -1),
+            "level": "warning" if f.baselined else "error",
+            "message": {"text": f.message},
+            "locations": [location(f.path, f.line, f.col + 1)],
+            "partialFingerprints": {
+                "kdtLintFingerprint/v1": f.fingerprint(),
+                "kdtLintMoveFingerprint/v1": f.move_fingerprint(),
+            },
+        }
+        if f.baselined:
+            # grandfathered debt: visible to the ingester, suppressed
+            # from alerting — the same contract as the exit code
+            res["suppressions"] = [{
+                "kind": "external",
+                "justification": "grandfathered in lint_baseline.json",
+            }]
+        results.append(res)
+    for f, s in result.suppressed:
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index.get(f.rule, -1),
+            "level": "note",
+            "message": {"text": f.message},
+            "locations": [location(f.path, f.line, f.col + 1)],
+            "partialFingerprints": {
+                "kdtLintFingerprint/v1": f.fingerprint(),
+            },
+            "suppressions": [{
+                "kind": "inSource",
+                "justification": s.reason,
+            }],
+        }
+        results.append(res)
+
+    run = {
+        "tool": {
+            "driver": {
+                "name": "kdt-lint",
+                "informationUri": (
+                    "https://github.com/Dan-Yeh/Parallel-Kd-Tree"
+                ),
+                "version": f"{FORMAT_VERSION}.0.0",
+                "rules": [rule_obj(r) for r in rules],
+            }
+        },
+        "columnKind": "unicodeCodePoints",
+        "results": results,
+    }
+    if root:
+        uri = "file://" + root.replace("\\", "/")
+        if not uri.endswith("/"):
+            uri += "/"
+        run["originalUriBaseIds"] = {"SRCROOT": {"uri": uri}}
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [run],
     }
     return json.dumps(doc, indent=2, sort_keys=True) + "\n"

@@ -18,6 +18,7 @@ different tools for different jobs: suppressions are forever-with-a-
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import os
 import re
@@ -25,6 +26,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from kdtree_tpu.analysis.program import Program, module_name_for
 from kdtree_tpu.analysis.registry import (
     Finding,
     all_checkers,
@@ -51,16 +53,29 @@ class Suppression:
 
 @dataclass
 class FileContext:
-    """Everything a checker may ask about one parsed file."""
+    """Everything a checker may ask about one parsed file.
+
+    ``program`` is the whole-program view (module/import graph, call
+    graph, fixpoint summaries) built once per lint run over EVERY file
+    under the root — including files outside the emission set in
+    ``--changed`` mode, so a wrapper's summary never depends on which
+    files happen to be linted. ``module`` is this file's dotted module
+    name within that program.
+    """
 
     path: str
     relpath: str
     source: str
     tree: ast.Module
     parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+    program: Optional[Program] = None
+    module: str = ""
 
     def __post_init__(self) -> None:
         self._lines = self.source.splitlines()
+        self._scope_hashes: Dict[int, str] = {}
+        if not self.module:
+            self.module = module_name_for(self.relpath)
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
@@ -75,6 +90,31 @@ class FileContext:
         while cur is not None and not isinstance(cur, ast.stmt):
             cur = self.parents.get(cur)
         return cur
+
+    def scope_hash(self, node: ast.AST) -> str:
+        """Short content hash of the enclosing function def (the whole
+        file for module-scope nodes). Line-number- and path-free by
+        construction — ``ast.unparse`` normalizes formatting — so a
+        ``git mv`` of the module leaves every scope hash intact; that is
+        what lets baseline fingerprints survive file moves."""
+        cur: Optional[ast.AST] = node
+        scope: Optional[ast.AST] = None
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = cur
+                break
+            cur = self.parents.get(cur)
+        key = id(scope) if scope is not None else 0
+        if key not in self._scope_hashes:
+            target = scope if scope is not None else self.tree
+            try:
+                text = ast.unparse(target)
+            except Exception:  # pragma: no cover - unparse is total on 3.9+
+                text = self.source
+            self._scope_hashes[key] = hashlib.sha1(
+                text.encode("utf-8")
+            ).hexdigest()[:12]
+        return self._scope_hashes[key]
 
 
 @dataclass
@@ -167,8 +207,17 @@ def _extract_suppressions(
     return sups, malformed
 
 
-def lint_file(path: str, root: Optional[str] = None) -> LintResult:
-    """Run every registered checker over one file."""
+def lint_file(
+    path: str,
+    root: Optional[str] = None,
+    program: Optional[Program] = None,
+) -> LintResult:
+    """Run every registered checker over one file.
+
+    Without ``program`` (the direct-call convenience path) the file gets
+    a single-file program: interprocedural rules still resolve
+    same-module helpers, they just can't see across modules.
+    """
     result = LintResult(files=1)
     root = root or os.getcwd()
     relpath = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
@@ -179,17 +228,24 @@ def lint_file(path: str, root: Optional[str] = None) -> LintResult:
     except (OSError, SyntaxError, ValueError) as e:
         result.errors.append(f"{relpath}: cannot lint: {e}")
         return result
-    ctx = FileContext(path=path, relpath=relpath, source=source, tree=tree)
+    if program is None:
+        program = Program([(relpath, tree)])
+    ctx = FileContext(
+        path=path, relpath=relpath, source=source, tree=tree,
+        program=program, module=module_name_for(relpath),
+    )
 
     sups, malformed = _extract_suppressions(source)
     by_line: Dict[int, List[Suppression]] = {}
     for s in sups:
         by_line.setdefault(s.line, []).append(s)
+    malformed_lines = {lineno for lineno, _ in malformed}
 
     raw: List[Finding] = []
     for check in all_checkers():
         raw.extend(check(ctx))
 
+    used: set = set()  # (id(suppression), rule) pairs that silenced a finding
     for f in raw:
         matched = None
         for s in by_line.get(f.line, []):
@@ -197,17 +253,57 @@ def lint_file(path: str, root: Optional[str] = None) -> LintResult:
                 matched = s
                 break
         if matched is not None:
+            used.add((id(matched), f.rule))
             result.suppressed.append((f, matched))
         else:
             result.findings.append(f)
 
-    from kdtree_tpu.analysis.checkers import R_SUPPRESS, _mk
+    from kdtree_tpu.analysis.checkers import R_SUPPRESS, R_UNUSED_SUPPRESS, _mk
 
-    for lineno, why in malformed:
+    def marker_at(lineno: int) -> ast.AST:
         marker = ast.Module(body=[], type_ignores=[])
         marker.lineno = lineno  # type: ignore[attr-defined]
         marker.col_offset = 0  # type: ignore[attr-defined]
-        result.findings.append(_mk(R_SUPPRESS, ctx, marker, why))
+        return marker
+
+    for lineno, why in malformed:
+        result.findings.append(_mk(R_SUPPRESS, ctx, marker_at(lineno), why))
+
+    # KDT505: a suppression id that silenced nothing. Malformed comments
+    # (unknown ids, missing reason) are already KDT302 and skipped here;
+    # a KDT505 finding is itself suppressible at the comment's own line
+    # (inline `disable=KDTxxx,KDT505` or a line above), so the second
+    # match pass below checks the comment line as well as the (possibly
+    # different) line the original suppression applied to.
+    unused: List[Finding] = []
+    for s in sups:
+        if s.comment_line in malformed_lines:
+            continue
+        for rule_id in s.rule_ids:
+            if rule_id == R_UNUSED_SUPPRESS.id:
+                # no fixpoint: a disable=KDT505 comment is never itself
+                # flagged unused (predictable false negative over a
+                # self-referential cascade)
+                continue
+            if (id(s), rule_id) in used:
+                continue
+            unused.append(_mk(
+                R_UNUSED_SUPPRESS, ctx, marker_at(s.comment_line),
+                f"suppression of {rule_id} silences nothing: the rule no "
+                f"longer fires at line {s.line} — a suppression must not "
+                "outlive its evidence; delete the comment (or this id "
+                "from it)",
+            ))
+    for f in unused:
+        matched = None
+        for s in sups:
+            if f.rule in s.rule_ids and f.line in (s.line, s.comment_line):
+                matched = s
+                break
+        if matched is not None:
+            result.suppressed.append((f, matched))
+        else:
+            result.findings.append(f)
 
     result.findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return result
@@ -234,11 +330,47 @@ def collect_files(paths: Iterable[str]) -> List[str]:
     return list(out.values())
 
 
-def run_lint(paths: Iterable[str], root: Optional[str] = None) -> LintResult:
-    """Lint every .py file under ``paths``; findings carry paths relative
-    to ``root`` (default: cwd) so baselines are machine-portable."""
-    result = LintResult()
+def build_program(
+    paths: Iterable[str], root: str, result: Optional[LintResult] = None
+) -> Program:
+    """Parse every .py file under ``paths`` into one whole-program view.
+    Unparseable files are skipped (and reported on ``result`` when the
+    caller is also linting them — a context-only file that fails to
+    parse just contributes no summaries)."""
+    parsed: List[Tuple[str, ast.Module]] = []
     for path in collect_files(paths):
-        result.extend(lint_file(path, root=root))
+        relpath = os.path.relpath(
+            os.path.abspath(path), root
+        ).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            parsed.append((relpath, ast.parse(source, filename=path)))
+        except (OSError, SyntaxError, ValueError):
+            continue  # lint_file re-parses and reports the error
+    return Program(parsed)
+
+
+def run_lint(
+    paths: Iterable[str],
+    root: Optional[str] = None,
+    context_paths: Optional[Iterable[str]] = None,
+) -> LintResult:
+    """Lint every .py file under ``paths``; findings carry paths relative
+    to ``root`` (default: cwd) so baselines are machine-portable.
+
+    ``context_paths`` (diff-aware mode) widens the PROGRAM without
+    widening the emission set: the interprocedural summaries are built
+    over ``paths`` + ``context_paths``, findings are emitted only for
+    ``paths``. A helper edited out of the diff still informs the rules.
+    """
+    result = LintResult()
+    root = root or os.getcwd()
+    program_paths = list(paths)
+    if context_paths is not None:
+        program_paths += list(context_paths)
+    program = build_program(program_paths, root)
+    for path in collect_files(paths):
+        result.extend(lint_file(path, root=root, program=program))
     result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return result

@@ -171,7 +171,7 @@ class _EpochState:
             arr = np.concatenate(
                 [arr, np.full(bucket - arr.size, arr[0], dtype=np.int32)]
             )
-        idx = jnp.asarray(arr)  # kdt-lint: disable=KDT201 positions is a host-built int list (no device value); this packs it for the async .at[].set dispatch
+        idx = jnp.asarray(arr)  # host-built int list packed for the async .at[].set dispatch
         self.masked_pts = self.masked_pts.at[idx].set(jnp.inf)
         self.masked_gid = self.masked_gid.at[idx].set(-1)
 
@@ -187,7 +187,7 @@ class _EpochState:
         import jax.numpy as jnp
 
         for bucket in _MASK_PAD_BUCKETS:
-            idx = jnp.asarray(np.zeros(bucket, dtype=np.int32))  # kdt-lint: disable=KDT201 host-built warmup index vector, off the lock and off the hot path
+            idx = jnp.asarray(np.zeros(bucket, dtype=np.int32))  # host-built warmup index vector, off the lock and off the hot path
             self.masked_pts.at[idx].set(jnp.inf)
             self.masked_gid.at[idx].set(-1)
 
@@ -741,8 +741,8 @@ class MutableEngine:
         from kdtree_tpu.serve.lifecycle import ServeEngine
 
         t = old.inner.tree
-        flat_pts = np.asarray(t.bucket_pts).reshape(-1, t.dim)  # kdt-lint: disable=KDT201 epoch compaction snapshot on the rebuild thread, not the serving hot path
-        flat_gid = np.asarray(t.bucket_gid).reshape(-1)  # kdt-lint: disable=KDT201 epoch compaction snapshot on the rebuild thread, not the serving hot path
+        flat_pts = np.asarray(t.bucket_pts).reshape(-1, t.dim)  # epoch compaction snapshot on the rebuild thread, not the serving hot path
+        flat_gid = np.asarray(t.bucket_gid).reshape(-1)  # epoch compaction snapshot on the rebuild thread, not the serving hot path
         dead_sorted = np.array(sorted(dead), dtype=np.int64)  # kdt-lint: disable=KDT201 dead is a host-side python set of ids, not a device value
         alive = (flat_gid >= 0) & ~in_sorted(dead_sorted, flat_gid)
         pts = np.concatenate([flat_pts[alive], delta_pts], axis=0)
@@ -865,8 +865,8 @@ class MutableEngine:
         first post-swap batch dispatches warm — the plan store already
         makes its launch plan warm (same signature)."""
         t = inner.tree
-        lo = np.asarray(t.node_lo[0], dtype=np.float64)  # kdt-lint: disable=KDT201 once-per-epoch pre-warm on the rebuild thread
-        hi = np.asarray(t.node_hi[0], dtype=np.float64)  # kdt-lint: disable=KDT201 once-per-epoch pre-warm on the rebuild thread
+        lo = np.asarray(t.node_lo[0], dtype=np.float64)  # once-per-epoch pre-warm on the rebuild thread
+        hi = np.asarray(t.node_hi[0], dtype=np.float64)  # once-per-epoch pre-warm on the rebuild thread
         lo = np.where(np.isfinite(lo), lo, 0.0)
         hi = np.where(np.isfinite(hi) & (hi > lo), hi, lo + 1.0)
         for b in list(self.warm_buckets):

@@ -731,9 +731,9 @@ def drive_batches(
     # the result assembly below indexes batches[0] unconditionally
     if settle_first:
         dispatch(0, bcmax)
-        # kdt-lint: disable=KDT201 the deliberate cap-settling probe: one
-        # synchronous flag fetch on the FIRST batch settles a systematic
-        # undersize before ~150 async batches dispatch at the wrong cap
+        # the deliberate cap-settling probe: one synchronous flag fetch
+        # on the FIRST batch settles a systematic undersize before ~150
+        # async batches dispatch at the wrong cap
         while bool(np.asarray(batches[0][2])) and bcmax < nbp:
             bcmax = min(bcmax * 2, nbp)
             retries.inc()

@@ -1691,7 +1691,51 @@ def cmd_lint(args) -> None:
         print(f"cannot lint: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         sys.exit(2)
-    result = run_lint(paths, root=root)
+    if args.prune_baseline and args.changed is not None:
+        # prune compares the FULL finding set against the baseline;
+        # a narrowed emission set would mark live debt stale
+        print("cannot lint: --prune-baseline requires a full run "
+              "(drop --changed)", file=sys.stderr)
+        sys.exit(2)
+    if args.changed is not None:
+        # diff-aware mode: emit findings only for files changed vs REF
+        # (plus untracked ones), but build the interprocedural program
+        # over the FULL lint paths — a wrapper's summary must not depend
+        # on which files happen to be in the diff
+        import subprocess
+
+        try:
+            diff = subprocess.run(
+                ["git", "-C", root, "diff", "--name-only",
+                 "--diff-filter=d", args.changed, "--", "*.py"],
+                capture_output=True, text=True, check=True, timeout=60,
+            ).stdout
+            untracked = subprocess.run(
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard", "--", "*.py"],
+                capture_output=True, text=True, check=True, timeout=60,
+            ).stdout
+        except (OSError, subprocess.SubprocessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            print(f"cannot lint --changed {args.changed}: "
+                  f"{detail.strip()}", file=sys.stderr)
+            sys.exit(2)
+        lint_dirs = [os.path.abspath(p) for p in paths]
+        changed = []
+        for rel in sorted(set(diff.splitlines()) | set(untracked.splitlines())):
+            full = os.path.abspath(os.path.join(root, rel))
+            if not os.path.exists(full):
+                continue
+            if any(full == d or full.startswith(d + os.sep)
+                   for d in lint_dirs):
+                changed.append(full)
+        if not changed:
+            print(f"lint --changed {args.changed}: no changed .py files "
+                  "under the lint paths")
+            return
+        result = run_lint(changed, root=root, context_paths=paths)
+    else:
+        result = run_lint(paths, root=root)
     if result.errors and not result.findings:
         # un-parseable inputs with nothing else to report: that is a
         # usage-shaped failure, not a lint verdict
@@ -1710,16 +1754,38 @@ def cmd_lint(args) -> None:
               file=sys.stderr)
         sys.exit(2)
     new = bl.partition(result.findings, base)
-    render = (reporting.render_json if args.format == "json"
-              else reporting.render_human)
-    sys.stdout.write(render(result, new_count=len(new)))
-    if new:
-        print(
-            f"{len(new)} new finding(s): fix them, suppress inline with a "
-            "reason (# kdt-lint: disable=KDTxxx <why>), or grandfather "
-            f"with --update-baseline (see docs/STATIC_ANALYSIS.md)",
-            file=sys.stderr,
-        )
+    if args.format == "json":
+        render = reporting.render_json(result, new_count=len(new))
+    elif args.format == "sarif":
+        render = reporting.render_sarif(result, root=root)
+    else:
+        render = reporting.render_human(result, new_count=len(new))
+    sys.stdout.write(render)
+    stale = base.stale_entries() if args.prune_baseline else []
+    if stale:
+        for e in stale:
+            print(
+                f"stale baseline entry: {e['rule']} {e['path']} "
+                f"[{e.get('scope', '<module>')}] x{e['stale']} — the "
+                "linter no longer finds this; remove it "
+                "(--update-baseline rewrites the file)",
+                file=sys.stderr,
+            )
+    if new or stale:
+        if new:
+            print(
+                f"{len(new)} new finding(s): fix them, suppress inline "
+                "with a reason (# kdt-lint: disable=KDTxxx <why>), or "
+                "grandfather with --update-baseline (see "
+                "docs/STATIC_ANALYSIS.md)",
+                file=sys.stderr,
+            )
+        if stale:
+            print(
+                f"{len(stale)} stale baseline fingerprint(s): run "
+                "--update-baseline to burn them down",
+                file=sys.stderr,
+            )
         sys.exit(1)
 
 
@@ -2485,8 +2551,11 @@ def main(argv=None) -> None:
                     help="repo root: default paths, the relative "
                          "--baseline, and finding paths resolve against "
                          "it (default: cwd) — lint works from anywhere")
-    li.add_argument("--format", choices=["human", "json"], default="human",
-                    help="json is the machine report CI uploads")
+    li.add_argument("--format", choices=["human", "json", "sarif"],
+                    default="human",
+                    help="json is the machine report CI uploads; sarif "
+                         "is the SARIF 2.1.0 document GitHub code "
+                         "scanning ingests")
     li.add_argument("--baseline", default="lint_baseline.json",
                     metavar="PATH",
                     help="committed grandfather file; only findings NOT in "
@@ -2494,6 +2563,19 @@ def main(argv=None) -> None:
     li.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from the current findings "
                          "(burn down or grandfather debt) and exit 0")
+    li.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="diff-aware mode: emit findings only for files "
+                         "changed vs REF (default HEAD) plus untracked "
+                         "ones — the interprocedural program is still "
+                         "built over the FULL lint paths, so summaries "
+                         "do not depend on the diff; exits 0 when "
+                         "nothing relevant changed")
+    li.add_argument("--prune-baseline", action="store_true",
+                    help="fail (exit 1) when the baseline carries stale "
+                         "fingerprints the linter can no longer find — "
+                         "dead debt must leave the file, not sit as a "
+                         "grandfather slot for the next collision")
     li.set_defaults(fn=cmd_lint)
 
     tw = sub.add_parser(

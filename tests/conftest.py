@@ -86,6 +86,8 @@ def _isolate_flight_dump_rate_limit():
     test_flight's shed-burst vs test_slo's flood e2e). Clearing the
     limiter before every test makes every hand-picked order behave
     like a fresh process."""
+    import threading
+
     from kdtree_tpu.obs import flight, trace
 
     flight.recorder().reset_dump_rate_limit()
@@ -93,6 +95,13 @@ def _isolate_flight_dump_rate_limit():
     # (pinned ids, last-promoted pointers) must not leak across tests
     trace.reset()
     yield
+    # drain stray dump writers before the next test: the dump thread is
+    # deliberately non-daemon and unjoined (flight.py KDT404 note), so a
+    # test that triggered one can otherwise leak it into a neighbor that
+    # asserts on dump files or on the limiter it just reset
+    for t in threading.enumerate():
+        if t.name == "kdtree-flight-dump" and t is not threading.current_thread():
+            t.join(timeout=5.0)
 
 
 @pytest.fixture

@@ -1,0 +1,17 @@
+"""KDT504 cases: env parses at import scope, guarded and not."""
+
+import os
+
+FLUSH_MS = int(os.environ.get("KDT_FLUSH_MS", "250"))  # KDT504 TP
+
+try:
+    PORT = int(os.environ.get("KDT_PORT", "8080"))  # negative: guarded
+except ValueError:
+    PORT = 8080
+
+
+def sample_rate():
+    return float(os.environ.get("KDT_SAMPLE", "0.1"))  # negative: lazy
+
+
+RETRIES = int(os.environ.get("KDT_RETRIES", "3"))  # kdt-lint: disable=KDT504 fixture: fail fast

@@ -1,0 +1,21 @@
+"""Helpers whose summaries the engine must compute.
+
+``device_result`` returns a device value directly; ``wrapped`` only
+through a call — the KDT201 two-hop case needs the fixpoint to carry
+returns_device across both.
+"""
+
+import jax.numpy as jnp
+
+
+def device_result(x):
+    return jnp.sum(x * 2.0)
+
+
+def wrapped(x):
+    y = device_result(x)
+    return y
+
+
+def host_result(x):
+    return [v for v in x]

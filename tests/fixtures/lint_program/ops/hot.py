@@ -1,0 +1,26 @@
+"""Hot-path file: the two-hop KDT201 true positive.
+
+``np.asarray(r)`` syncs a device value that crossed TWO function
+boundaries (wrapped -> device_result, defined in another module) — the
+per-file walker has no idea ``wrapped`` returns a device value; the
+whole-program fixpoint does.
+"""
+
+import numpy as np
+
+from ops.helpers import host_result, wrapped
+
+
+def fetch_two_hop(q):
+    r = wrapped(q)
+    return np.asarray(r)  # KDT201 TP: device value via two resolved hops
+
+
+def fetch_host(q):
+    r = host_result(q)
+    return np.asarray(r)  # negative: resolved callee is host-only
+
+
+def fetch_suppressed(q):
+    r = wrapped(q)
+    return np.asarray(r)  # kdt-lint: disable=KDT201 fixture: reasoned sync

@@ -1,0 +1,33 @@
+"""KDT503 cases: bind before validate. The second TP validates through
+a RESOLVED helper (``ensure_port``) — no validate*/check_* prefix, the
+engine's raises_config_error summary carries the fact."""
+
+from http.server import ThreadingHTTPServer
+
+from serve.config import ensure_port
+
+
+def boot_bad(host, port, handler):
+    srv = ThreadingHTTPServer((host, port), handler)  # KDT503 TP
+    if port < 1024:
+        raise ValueError("privileged port")
+    return srv
+
+
+def boot_bad_helper(host, port, handler):
+    srv = ThreadingHTTPServer((host, port), handler)  # KDT503 TP (resolved)
+    ensure_port(port)
+    return srv
+
+
+def boot_good(host, port, handler):
+    ensure_port(port)
+    if not host:
+        raise ValueError("empty host")
+    return ThreadingHTTPServer((host, port), handler)  # negative
+
+
+def boot_suppressed(host, port, handler):
+    srv = ThreadingHTTPServer((host, port), handler)  # kdt-lint: disable=KDT503 fixture: probe bind
+    ensure_port(port)
+    return srv

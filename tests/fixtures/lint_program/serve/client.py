@@ -1,0 +1,65 @@
+"""KDT107/KDT110 wrapper chains.
+
+``post``/``send`` forward timeout/headers into stdlib client calls;
+``post2``/``send2`` forward into THOSE — the two-hop wrapper cases the
+per-file walker cannot see. Call sites below carry the findings.
+"""
+
+from urllib.request import urlopen
+
+
+def post(url, data, timeout=None):
+    return urlopen(url, data, timeout)
+
+
+def post2(url, data, timeout=None):
+    return post(url, data, timeout=timeout)
+
+
+def post_safe(url, data, timeout=None):
+    if timeout is None:
+        timeout = 5.0
+    return urlopen(url, data, timeout)
+
+
+def send(conn, body, headers=None):
+    conn.request("POST", "/ingest", body, headers=headers)
+
+
+def send2(conn, body, headers=None):
+    send(conn, body, headers=headers)
+
+
+def ping(url):
+    return post2(url, b"{}")  # KDT107 TP: two-hop wrapper, timeout unbound
+
+
+def ping_bounded(url, remaining):
+    return post2(url, b"{}", timeout=remaining)  # negative: bound
+
+
+def ping_normalized(url):
+    return post_safe(url, b"{}")  # negative: wrapper normalizes None away
+
+
+def ping_suppressed(url):
+    return post2(url, b"{}")  # kdt-lint: disable=KDT107 fixture: repl tool
+
+
+def announce(conn):
+    send2(conn, b"{}")  # KDT110 TP: two-hop wrapper, headers omitted
+
+
+def announce_untraced(conn):
+    send(conn, b"{}", headers={"Content-Type": "application/json"})  # KDT110 TP
+
+
+def announce_traced(conn, tid):
+    send(conn, b"{}", headers={
+        "X-Trace-Context": tid,
+        "Content-Type": "application/json",
+    })  # negative: header present
+
+
+def announce_suppressed(conn):
+    send2(conn, b"{}")  # kdt-lint: disable=KDT110 fixture: trace root

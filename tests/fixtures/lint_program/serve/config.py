@@ -1,0 +1,7 @@
+"""Config validation helper — its raises_config_error summary is what
+lets KDT503 recognize ``ensure_port`` as a validation event."""
+
+
+def ensure_port(port):
+    if port <= 0 or port > 65535:
+        raise ValueError("port out of range")

@@ -1,0 +1,28 @@
+"""KDT502 cases: constant outbound waits inside deadline-carrying
+functions — direct stdlib calls and resolved timeout-wrappers both.
+"""
+
+from urllib.request import urlopen
+
+from serve.client import post
+
+
+def fetch_bad(url, deadline):
+    return urlopen(url, None, 2.0)  # KDT502 TP: constant under a deadline
+
+
+def fetch_wrapped_bad(url, deadline):
+    return post(url, b"{}", timeout=0.5)  # KDT502 TP: via resolved wrapper
+
+
+def fetch_good(url, deadline, started):
+    remaining = max(deadline - started, 0.01)
+    return urlopen(url, None, remaining)  # negative: deadline-priced
+
+
+def fetch_cli(url):
+    return urlopen(url, None, 5.0)  # negative: no deadline in scope
+
+
+def fetch_suppressed(url, deadline):
+    return urlopen(url, None, 2.0)  # kdt-lint: disable=KDT502 fixture: floor

@@ -1,0 +1,16 @@
+"""Response helpers whose drain behavior the fixpoint must learn:
+``drain`` reads to EOF directly, ``drain2`` only through it (two hops),
+``log_status`` touches metadata and drains nothing.
+"""
+
+
+def log_status(resp):
+    return resp.status
+
+
+def drain(r):
+    r.read()
+
+
+def drain2(r):
+    drain(r)

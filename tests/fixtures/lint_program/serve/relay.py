@@ -1,0 +1,40 @@
+"""KDT501 cases: response drained (or not) before the pooled release.
+
+The TP passes the response to ``log_status`` — a RESOLVED helper the
+engine knows does not drain, so the release still fires. The negative
+drains through ``drain2``, two resolved hops from the ``.read()``.
+"""
+
+from serve.http_util import drain2, log_status
+
+
+def relay_bad(pool, url):
+    conn = pool.lease()
+    conn.request("GET", url)
+    resp = conn.getresponse()
+    log_status(resp)
+    pool.release(conn)  # KDT501 TP: log_status leaves the body on the socket
+
+
+def relay_good(pool, url):
+    conn = pool.lease()
+    conn.request("GET", url)
+    resp = conn.getresponse()
+    drain2(resp)  # negative: two-hop resolved drain
+    pool.release(conn)
+
+
+def relay_verdict(pool, url):
+    conn = pool.lease()
+    conn.request("GET", url)
+    resp = conn.getresponse()
+    ok = log_status(resp) == 200
+    pool.release(conn, drained=ok)  # negative: explicit verdict passed
+
+
+def relay_suppressed(pool, url):
+    conn = pool.lease()
+    conn.request("GET", url)
+    resp = conn.getresponse()
+    log_status(resp)
+    pool.release(conn)  # kdt-lint: disable=KDT501 fixture: HEAD-only peer

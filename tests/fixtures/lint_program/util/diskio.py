@@ -1,0 +1,16 @@
+"""Blocking-I/O helpers two calls deep — KDT402's io_chain fodder."""
+
+import json
+
+
+def _write(obj, path):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def persist(obj, path):
+    _write(obj, path)
+
+
+def shape_only(obj):
+    return len(obj)

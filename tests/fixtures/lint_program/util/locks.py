@@ -1,0 +1,33 @@
+"""The two-hop KDT402 true positive: blocking I/O reached through a
+called helper (persist -> _write -> json.dump/open) while a lock is
+held. The per-file walker only flags syntactic I/O calls inside the
+``with`` body; the engine's io_chain summary names the whole path.
+"""
+
+import threading
+
+from util.diskio import persist, shape_only
+
+_lock = threading.Lock()
+STATE = {"n": 0}
+
+
+def snapshot_bad(path):
+    with _lock:
+        persist(STATE, path)  # KDT402 TP: helper reaches json.dump
+
+
+def snapshot_good(path):
+    with _lock:
+        copy = dict(STATE)
+    persist(copy, path)  # negative: I/O after the lock is dropped
+
+
+def snapshot_meta():
+    with _lock:
+        return shape_only(STATE)  # negative: resolved helper does no I/O
+
+
+def snapshot_suppressed(path):
+    with _lock:
+        persist(STATE, path)  # kdt-lint: disable=KDT402 fixture: reasoned hold

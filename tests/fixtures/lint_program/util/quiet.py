@@ -1,0 +1,10 @@
+"""KDT505 cases: a stale suppression (its rule never fires here), and
+one acknowledged as kept-on-purpose via a KDT505 self-suppression."""
+
+
+def touch(path):
+    return path  # kdt-lint: disable=KDT402 fixture: stale — nothing fires
+
+
+def hold(path):
+    return path  # kdt-lint: disable=KDT402,KDT505 fixture: kept for parity

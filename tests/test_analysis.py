@@ -330,11 +330,12 @@ def test_suppression_id_list_allows_comma_space(tmp_path):
     # 'KDT101, KDT201 reason' must parse as TWO ids + reason, not eat
     # KDT201 into the reason and leave the finding unsuppressed
     res = lint_snippet(tmp_path, (
+        "import numpy as np\n"
         "import jax.numpy as jnp\n"
         "def build(points):\n"
         "    n = points.shape[0]\n"
         "    # kdt-lint: disable=KDT101, KDT201 both covered by the entry guard\n"
-        "    gid = jnp.arange(n, dtype=jnp.int32)\n"
+        "    gid = np.asarray(jnp.arange(n, dtype=jnp.int32))\n"
         "    return int(jnp.max(gid))"
         "  # kdt-lint: disable=KDT201 test sync\n"
     ))

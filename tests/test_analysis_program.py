@@ -1,0 +1,414 @@
+"""Interprocedural lint engine: the checked-in fixture tree pins every
+KDT5xx true positive, the two-hop KDT201/KDT402 cases the old per-file
+walker misses, and the KDT107/KDT110 wrapper upgrades; plus the engine's
+resolution/summary unit behavior, baseline move-tolerance, SARIF output,
+and the --changed / --prune-baseline CLI lifecycles.
+
+No jax API anywhere on this path, so these tests are tier-1-cheap.
+"""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from kdtree_tpu.analysis import baseline as bl
+from kdtree_tpu.analysis import run_lint
+from kdtree_tpu.analysis.program import CLIENT_TIMEOUT_POS, Program
+from kdtree_tpu.analysis.walker import lint_file
+from kdtree_tpu.utils import cli
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint_program"
+)
+
+
+@pytest.fixture(scope="module")
+def fixture_result():
+    return run_lint([FIXTURE], root=FIXTURE)
+
+
+def _keys(findings):
+    return {(f.rule, f.path, f.scope) for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the acceptance fixture tree: exact finding set
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_tree_finds_exactly_the_pinned_set(fixture_result):
+    assert _keys(fixture_result.findings) == {
+        # the five KDT5xx true positives
+        ("KDT501", "serve/relay.py", "relay_bad"),
+        ("KDT502", "serve/deadline.py", "fetch_bad"),
+        ("KDT502", "serve/deadline.py", "fetch_wrapped_bad"),
+        ("KDT503", "serve/boot.py", "boot_bad"),
+        ("KDT503", "serve/boot.py", "boot_bad_helper"),
+        ("KDT504", "obs/env.py", "<module>"),
+        ("KDT505", "util/quiet.py", "<module>"),
+        # the two-hop cases the per-file walker misses
+        ("KDT201", "ops/hot.py", "fetch_two_hop"),
+        ("KDT402", "util/locks.py", "snapshot_bad"),
+        # wrapper-resolution upgrades
+        ("KDT107", "serve/client.py", "ping"),
+        ("KDT110", "serve/client.py", "announce"),
+        ("KDT110", "serve/client.py", "announce_untraced"),
+    }
+    assert not fixture_result.errors
+
+
+def test_fixture_tree_suppressions_all_consumed(fixture_result):
+    # one inline suppression per upgraded/new rule, all of them USED
+    # (an unused one would itself be a KDT505 finding above)
+    assert _keys(f for f, _ in fixture_result.suppressed) == {
+        ("KDT201", "ops/hot.py", "fetch_suppressed"),
+        ("KDT402", "util/locks.py", "snapshot_suppressed"),
+        ("KDT107", "serve/client.py", "ping_suppressed"),
+        ("KDT110", "serve/client.py", "announce_suppressed"),
+        ("KDT501", "serve/relay.py", "relay_suppressed"),
+        ("KDT502", "serve/deadline.py", "fetch_suppressed"),
+        ("KDT503", "serve/boot.py", "boot_suppressed"),
+        ("KDT504", "obs/env.py", "<module>"),
+        # quiet.hold keeps a stale KDT402 id on purpose, acknowledged
+        # by a KDT505 self-suppression on the same comment
+        ("KDT505", "util/quiet.py", "<module>"),
+    }
+
+
+def test_two_hop_kdt402_names_the_call_chain(fixture_result):
+    f = next(x for x in fixture_result.findings if x.rule == "KDT402")
+    assert "persist -> _write ->" in f.message
+
+
+def test_old_per_file_walker_misses_the_two_hop_cases():
+    # lint_file without a whole-program view falls back to a
+    # single-file program: the imported helpers don't resolve, so the
+    # cross-module facts are simply absent — the documented
+    # false-negative the engine exists to close
+    hot = lint_file(os.path.join(FIXTURE, "ops", "hot.py"), root=FIXTURE)
+    assert "KDT201" not in [f.rule for f in hot.findings]
+    locks = lint_file(
+        os.path.join(FIXTURE, "util", "locks.py"), root=FIXTURE
+    )
+    assert "KDT402" not in [f.rule for f in locks.findings]
+
+
+# ---------------------------------------------------------------------------
+# engine unit behavior: resolution and summaries
+# ---------------------------------------------------------------------------
+
+
+def _program(*files):
+    import ast
+
+    return Program([(rel, ast.parse(src)) for rel, src in files])
+
+
+def test_returns_device_propagates_across_modules_and_hops():
+    prog = _program(
+        ("a/helpers.py", (
+            "import jax.numpy as jnp\n"
+            "def direct(x):\n"
+            "    return jnp.sum(x)\n"
+            "def hop(x):\n"
+            "    y = direct(x)\n"
+            "    return y\n"
+            "def host(x):\n"
+            "    return list(x)\n"
+        )),
+    )
+    assert prog.functions["a.helpers.direct"].returns_device
+    assert prog.functions["a.helpers.hop"].returns_device
+    assert not prog.functions["a.helpers.host"].returns_device
+
+
+def test_io_chain_and_drains_cross_module():
+    prog = _program(
+        ("u/d.py", (
+            "import json\n"
+            "def _write(obj, path):\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump(obj, f)\n"
+            "def persist(obj, path):\n"
+            "    _write(obj, path)\n"
+        )),
+        ("u/h.py", (
+            "def drain(r):\n"
+            "    r.read()\n"
+            "def drain2(r):\n"
+            "    drain(r)\n"
+        )),
+    )
+    assert prog.functions["u.d._write"].io_chain is not None
+    chain = prog.functions["u.d.persist"].io_chain
+    assert chain is not None and chain[0] == "_write"
+    assert prog.functions["u.h.drain"].drains_params == {"r"}
+    assert prog.functions["u.h.drain2"].drains_params == {"r"}
+
+
+def test_timeout_wrapper_summary_and_normalization_guard():
+    prog = _program(
+        ("s/c.py", (
+            "from urllib.request import urlopen\n"
+            "def post(url, data, timeout=None):\n"
+            "    return urlopen(url, data, timeout)\n"
+            "def post2(url, data, timeout=None):\n"
+            "    return post(url, data, timeout=timeout)\n"
+            "def post_safe(url, data, timeout=None):\n"
+            "    if timeout is None:\n"
+            "        timeout = 5.0\n"
+            "    return urlopen(url, data, timeout)\n"
+        )),
+    )
+    post = prog.functions["s.c.post"]
+    assert (post.timeout_param, post.timeout_pos) == ("timeout", 2)
+    assert post.timeout_default_none
+    post2 = prog.functions["s.c.post2"]
+    assert post2.timeout_param == "timeout" and post2.timeout_default_none
+    # a wrapper that normalizes the None default away is safe to call bare
+    assert not prog.functions["s.c.post_safe"].timeout_default_none
+
+
+def test_resolution_is_conservative_on_ambiguity():
+    import ast
+
+    prog = _program(
+        ("m/a.py", "def f():\n    return 1\n"),
+    )
+    # unknown receiver attribute calls never resolve
+    call = ast.parse("obj.f()").body[0].value
+    assert prog.resolve_call("m.a", None, call) is None
+    # a bare known name does
+    call = ast.parse("f()").body[0].value
+    assert prog.resolve_call("m.a", None, call).fq == "m.a.f"
+
+
+def test_duplicate_defs_keep_first_never_merge():
+    prog = _program(
+        ("m/b.py", (
+            "import jax.numpy as jnp\n"
+            "def g(x):\n"
+            "    return jnp.sum(x)\n"
+            "def g(x):\n"
+            "    return 1\n"
+        )),
+    )
+    # both defs collapse onto the FIRST node's summary; the point is
+    # that ambiguity never INVENTS facts from a merge of the two
+    assert len([fq for fq in prog.functions if fq == "m.b.g"]) == 1
+
+
+def test_client_timeout_table_is_shared_with_checkers():
+    from kdtree_tpu.analysis import checkers
+
+    assert checkers._CLIENT_TIMEOUT_POS is CLIENT_TIMEOUT_POS
+
+
+# ---------------------------------------------------------------------------
+# baseline: move-tolerant fingerprints
+# ---------------------------------------------------------------------------
+
+_VIOLATION = "def plan(dim):\n    return 32 // dim\n"
+
+
+def _lint_at(tmp_path, relpath, source):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return run_lint([str(path)], root=str(tmp_path))
+
+
+def test_baseline_survives_a_file_move(tmp_path):
+    res = _lint_at(tmp_path, "ops/a.py", _VIOLATION)
+    bpath = str(tmp_path / "base.json")
+    bl.save(bpath, res.findings)
+    # same content at a new path (git mv): the exact fingerprint breaks
+    # on path, the scope-hash move fingerprint still consumes it
+    res2 = _lint_at(tmp_path, "ops/renamed.py", _VIOLATION)
+    assert bl.partition(res2.findings, bl.load(bpath)) == []
+
+
+def test_baseline_move_rejected_when_scope_content_changed(tmp_path):
+    res = _lint_at(tmp_path, "ops/a.py", _VIOLATION)
+    bpath = str(tmp_path / "base.json")
+    bl.save(bpath, res.findings)
+    # moved AND edited: the scope hash no longer matches — this is a
+    # new finding, not grandfathered debt that quietly followed the file
+    res2 = _lint_at(
+        tmp_path, "ops/renamed.py",
+        "def plan(dim):\n    x = 1\n    return 32 // dim\n",
+    )
+    assert len(bl.partition(res2.findings, bl.load(bpath))) == 1
+
+
+def test_stale_entries_reported_after_consumption(tmp_path):
+    res = _lint_at(tmp_path, "ops/a.py", _VIOLATION)
+    bpath = str(tmp_path / "base.json")
+    bl.save(bpath, res.findings)
+    base = bl.load(bpath)
+    assert bl.partition(res.findings, base) == []
+    assert base.stale_entries() == []
+    fresh = bl.load(bpath)  # nothing consumed: everything is stale
+    stale = fresh.stale_entries()
+    assert len(stale) == 1 and stale[0]["stale"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sarif_structure(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", FIXTURE, "--root", FIXTURE, "--format", "sarif",
+                  "--baseline", str(tmp_path / "b.json")])
+    assert exc.value.code == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0.json" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "kdt-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    for rid in ("KDT501", "KDT502", "KDT503", "KDT504", "KDT505"):
+        assert rid in rule_ids
+    assert run["originalUriBaseIds"]["SRCROOT"]["uri"].startswith("file://")
+    results = run["results"]
+    by_level = {}
+    for r in results:
+        assert rule_ids[r["ruleIndex"]] == r["ruleId"]
+        assert r["locations"][0]["physicalLocation"]["region"]["startLine"] >= 1
+        assert "kdtLintFingerprint/v1" in r["partialFingerprints"]
+        by_level.setdefault(r["level"], []).append(r)
+    # new findings are errors; inline-suppressed ones ride along as
+    # notes carrying the suppression reason for the ingester
+    assert len(by_level["error"]) == 12
+    sup = by_level["note"][0]["suppressions"][0]
+    assert sup["kind"] == "inSource" and sup["justification"]
+
+
+def test_sarif_marks_baselined_findings_external(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(_VIOLATION)
+    bpath = str(tmp_path / "b.json")
+    cli.main(["lint", str(pkg), "--baseline", bpath, "--update-baseline"])
+    capsys.readouterr()
+    cli.main(["lint", str(pkg), "--baseline", bpath, "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    res = doc["runs"][0]["results"][0]
+    assert res["level"] == "warning"
+    assert res["suppressions"][0]["kind"] == "external"
+
+
+# ---------------------------------------------------------------------------
+# CLI: --changed (diff-aware) and --prune-baseline
+# ---------------------------------------------------------------------------
+
+
+def _git(repo, *argv):
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.email=t@t",
+         "-c", "user.name=t", *argv],
+        check=True, capture_output=True, text=True,
+    )
+
+
+@pytest.fixture()
+def git_repo(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "helpers.py").write_text(
+        "from urllib.request import urlopen\n"
+        "def post(url, data, timeout=None):\n"
+        "    return urlopen(url, data, timeout)\n"
+        "def plan(dim):\n"
+        "    return 32 // dim\n"  # committed debt, NOT in the diff
+    )
+    (pkg / "caller.py").write_text("def ping(url):\n    return None\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    return tmp_path
+
+
+def test_changed_narrows_emission_but_not_the_program(
+        git_repo, capsys, monkeypatch):
+    monkeypatch.chdir(git_repo)
+    # edit ONLY caller.py: its new finding needs helpers.py's wrapper
+    # summary, which must come from the unchanged file as context
+    (git_repo / "pkg" / "caller.py").write_text(
+        "from pkg.helpers import post\n"
+        "def ping(url):\n"
+        "    return post(url, b'x')\n"
+    )
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "pkg", "--root", str(git_repo),
+                  "--changed", "HEAD",
+                  "--baseline", str(git_repo / "b.json")])
+    assert exc.value.code == 1
+    out = capsys.readouterr().out
+    assert "KDT107" in out          # interprocedural, in the changed file
+    assert "KDT301" not in out      # helpers.py debt: outside the diff
+    # the full run still sees both
+    with pytest.raises(SystemExit):
+        cli.main(["lint", "pkg", "--root", str(git_repo),
+                  "--baseline", str(git_repo / "b.json")])
+    out = capsys.readouterr().out
+    assert "KDT107" in out and "KDT301" in out
+
+
+def test_changed_includes_untracked_files(git_repo, capsys, monkeypatch):
+    monkeypatch.chdir(git_repo)
+    (git_repo / "pkg" / "extra.py").write_text(_VIOLATION)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "pkg", "--root", str(git_repo),
+                  "--changed", "HEAD",
+                  "--baseline", str(git_repo / "b.json")])
+    assert exc.value.code == 1
+    assert "extra.py" in capsys.readouterr().out
+
+
+def test_changed_with_clean_diff_exits_zero(git_repo, capsys, monkeypatch):
+    monkeypatch.chdir(git_repo)
+    cli.main(["lint", "pkg", "--root", str(git_repo),
+              "--changed", "HEAD",
+              "--baseline", str(git_repo / "b.json")])
+    assert "no changed .py files" in capsys.readouterr().out
+
+
+def test_prune_baseline_rejects_changed_mode(git_repo, capsys, monkeypatch):
+    monkeypatch.chdir(git_repo)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", "pkg", "--root", str(git_repo),
+                  "--changed", "HEAD", "--prune-baseline",
+                  "--baseline", str(git_repo / "b.json")])
+    assert exc.value.code == 2
+    assert "full run" in capsys.readouterr().err
+
+
+def test_prune_baseline_fails_on_stale_entries(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(_VIOLATION)
+    bpath = str(tmp_path / "b.json")
+    cli.main(["lint", str(pkg), "--baseline", bpath, "--update-baseline"])
+    capsys.readouterr()
+    # while the debt is live, prune mode passes
+    cli.main(["lint", str(pkg), "--baseline", bpath, "--prune-baseline"])
+    capsys.readouterr()
+    # fix the violation: the fingerprint goes stale and prune fails
+    (pkg / "mod.py").write_text("def plan(dim):\n    return dim\n")
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["lint", str(pkg), "--baseline", bpath, "--prune-baseline"])
+    assert exc.value.code == 1
+    err = capsys.readouterr().err
+    assert "stale baseline entry" in err and "KDT301" in err
+    # without --prune-baseline the same stale debt is tolerated
+    cli.main(["lint", str(pkg), "--baseline", bpath])
