@@ -286,6 +286,42 @@ def bench_queries(kt, pts, tree, Q: int, k: int):
     return dt, ok, plan_cache, recompiles
 
 
+def bench_verbs(kt, pts, tree, Qv: int, k: int):
+    """Radius and count throughput at selectivity MATCHED to the k-NN
+    bench: r is the median k-th-NN distance of a query sample, so the
+    mean radius answer carries ~k hits — the same result mass per query
+    the k-NN section moves, which is what makes the q/s figures
+    comparable across verbs. Count runs the identical traversal with
+    the id/distance buffers compiled out (with_ids=False).
+
+    Returns (radius_s, count_s, oracle_ok, r)."""
+    from kdtree_tpu import verbs
+    from kdtree_tpu.ops.generate import generate_queries
+    from kdtree_tpu.verbs import oracle as vo
+
+    dim = pts.shape[1]
+    qs = generate_queries(13, dim, Qv)
+    qh = np.asarray(qs)
+    bf, _ = kt.bruteforce.knn(pts, qs[:256], k=k)
+    r = float(np.sqrt(np.median(np.asarray(bf)[:, k - 1])))
+    # warmup at full Qv compiles both verb pipelines (and settles the
+    # radius hit buffer at this selectivity) outside the timed window
+    verbs.radius_search(tree, qh, r)
+    verbs.radius_search(tree, qh, r, with_ids=False)
+    t0 = time.perf_counter()
+    res = verbs.radius_search(tree, qh, r)
+    rdt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cres = verbs.radius_search(tree, qh, r, with_ids=False)
+    cdt = time.perf_counter() - t0
+    exp = vo.radius_count_oracle(np.asarray(pts), qh[:256],
+                                 np.full(256, r, np.float32))
+    ok = (np.array_equal(res.counts[:256], exp)
+          and np.array_equal(cres.counts[:256], exp)
+          and not res.truncated and not cres.truncated)
+    return rdt, cdt, ok, r
+
+
 def bench_global_morton(kt, n: int, dim: int, nq: int):
     """North-star per-device-scale capture (VERDICT r3 item 4): the scale
     engine's exact per-device program (shard generate -> Morton code ->
@@ -587,6 +623,33 @@ def main() -> None:
             "plan_cache": plan_cache,
             "recompiles": recompiles,
         })
+        # query verbs (docs/SERVING.md "Query verbs"): radius and count
+        # q/s on the same tree at selectivity matched to the k-NN
+        # section (~k hits per query) — the smoke shape's verb figures
+        # the trend gate diffs round over round
+        Qv = 1 << 16 if on_accel else 1 << 12
+        with obs.span("bench.verbs"):
+            rdt, vcdt, vok, vr = bench_verbs(kt, pts, tree, Qv, k)
+        if not vok:
+            _fail("oracle check (verbs)")
+        extra.append({
+            "metric": f"radius queries/sec (Q={Qv}, r matched to ~{k} "
+                      f"hits, {cfg} tree, {platform})",
+            "value": round(Qv / rdt),
+            "unit": "q/s",
+            "vs_baseline": None,
+            "radius": round(vr, 6),
+        })
+        extra.append({
+            "metric": f"radius-count queries/sec (Q={Qv}, r matched to "
+                      f"~{k} hits, no id buffers, {cfg} tree, "
+                      f"{platform})",
+            "value": round(Qv / vcdt),
+            "unit": "q/s",
+            "vs_baseline": None,
+            "radius": round(vr, 6),
+        })
+
         # replica cold-start split (docs/SERVING.md "Snapshots & replica
         # fleets"): the same index as a from-scratch build vs a snapshot
         # load — both as pts/s so the trend gate's drop detection points

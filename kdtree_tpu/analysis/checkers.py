@@ -867,7 +867,7 @@ def check_pooled_release_in_except(ctx) -> Iterator[Finding]:
 # KDT201 — sync-in-hot-path
 # --------------------------------------------------------------------------
 
-_HOT_DIRS = ("ops", "parallel", "pallas", "serve", "mutable")
+_HOT_DIRS = ("ops", "parallel", "pallas", "serve", "mutable", "verbs")
 # HTTP handler glue is the sanctioned response-materialization boundary:
 # a do_POST that np.asarray()s a result into JSON is the endpoint working
 # as designed, not a hot-path sync. Detected by base-class name (the

@@ -250,7 +250,8 @@ class _StepAcc:
     the lock guards list/int updates only, never I/O)."""
 
     __slots__ = ("rate", "intended", "sent", "latencies_ms",
-                 "send_lag_ms", "counts", "gears", "fanout", "slowest")
+                 "send_lag_ms", "counts", "gears", "fanout", "slowest",
+                 "verbs")
 
     def __init__(self, rate: float) -> None:
         self.rate = float(rate)
@@ -277,6 +278,11 @@ class _StepAcc:
         # names the exact trace to pull a waterfall for (kdtree-tpu
         # trace --id <it> --target <router>)
         self.slowest: Optional[Tuple[float, str]] = None
+        # per-read-verb ledger (docs/SERVING.md "Query verbs"),
+        # populated only when the schedule carries a verb mix: verb →
+        # {"lat": [...], "ok": n, "sent": n, "bad": n} — the per-verb
+        # latency/goodput columns and the per-verb knees come from here
+        self.verbs: Dict[str, Dict] = {}
 
 
 def _classify(op: str, status: int, body: Optional[dict]) -> List[str]:
@@ -533,11 +539,19 @@ def run_load(
     timeout_s: float = DEFAULT_TIMEOUT_S,
     scrape: bool = True,
     on_step=None,
+    verb_radius: float = 0.1,
 ) -> Dict:
     """Replay ``schedule`` against ``target``; return the full report
     (see the module docstring for the measurement contract). ``on_step``
     is an optional callback ``(step_index, rate)`` fired at each ladder
-    transition — the CLI's progress line."""
+    transition — the CLI's progress line. ``verb_radius`` is the search
+    radius (and range-box half-width) non-knn query verbs carry, in the
+    unit-cube coordinates the schedule draws queries from — it pins
+    verb selectivity so two runs at the same mix measure the same
+    work."""
+    # per-verb accounting only when the schedule mixes verbs: an
+    # unmixed run's artifact stays byte-identical to pre-verb loadgen
+    track_verbs = bool(getattr(schedule, "verb_mix", None))
     accs = [_StepAcc(r) for r in schedule.rates]
     for a in schedule.arrivals:
         accs[a.step].intended += 1
@@ -587,6 +601,17 @@ def run_load(
                 acc.gears[gear] = acc.gears.get(gear, 0) + 1
             if fanout is not None:
                 acc.fanout.append(fanout)
+            if track_verbs and arrival.op == "query":
+                verb = getattr(arrival, "verb", "knn") or "knn"
+                led = acc.verbs.setdefault(
+                    verb, {"lat": [], "ok": 0, "sent": 0, "bad": 0})
+                led["sent"] += 1
+                led["lat"].append(lat_ms)
+                if "ok" in tags:
+                    led["ok"] += 1
+                if any(tag in ("shed", "errors", "timeouts")
+                       for tag in tags):
+                    led["bad"] += 1
 
     def do_request(conn: _WorkerConn, arrival, intended: float,
                    seq: int) -> None:
@@ -598,8 +623,21 @@ def run_load(
             "X-Request-Id": f"lg{schedule.seed}-{arrival.step}-{seq}",
         }
         if arrival.op == "query":
-            path, body = "/v1/knn", {
-                "queries": [arrival.point.tolist()], "k": int(k)}
+            verb = getattr(arrival, "verb", "knn") or "knn"
+            point = arrival.point.tolist()
+            if verb == "radius":
+                path, body = "/v1/radius", {
+                    "queries": [point], "r": float(verb_radius)}
+            elif verb == "count":
+                path, body = "/v1/count", {
+                    "queries": [point], "r": float(verb_radius)}
+            elif verb == "range":
+                lo = (arrival.point - verb_radius).tolist()
+                hi = (arrival.point + verb_radius).tolist()
+                path, body = "/v1/range", {"lo": [lo], "hi": [hi]}
+            else:
+                path, body = "/v1/knn", {
+                    "queries": [point], "k": int(k)}
             if getattr(arrival, "recall", None) is not None:
                 body["recall_target"] = float(arrival.recall)
         elif arrival.op == "upsert":
@@ -724,9 +762,51 @@ def run_load(
             "conn_reuse_frac": _reuse_frac(pool_snaps.get(si),
                                            pool_snaps.get(si + 1)),
         }
+        if track_verbs:
+            # per-verb latency/goodput columns (additive key — only
+            # mixed runs carry it, and trend treats runs at differing
+            # verb mixes as incommensurable): a mixed step's aggregate
+            # quantiles blend verbs with different unit costs, so the
+            # per-verb split is what a knee regression localizes with
+            row["verbs"] = {
+                verb: {
+                    "sent": led["sent"],
+                    "ok": led["ok"],
+                    "goodput_rps": round(
+                        led["ok"] / schedule.step_seconds, 3),
+                    "bad_frac": (round(led["bad"] / led["sent"], 5)
+                                 if led["sent"] else None),
+                    **_quantiles_ms(led["lat"]),
+                }
+                for verb, led in sorted(acc.verbs.items())
+            }
         steps.append(row)
     knee = compute_knee(steps, slo_ms=slo_ms, slo_quantile=slo_quantile,
                         max_bad_frac=max_bad_frac)
+    verb_block = None
+    if track_verbs:
+        # per-verb knee: the highest OFFERED (total) ladder rate whose
+        # step met the SLO judged on that verb's own samples — the
+        # capacity verdict per read verb, same bar as the aggregate
+        verb_block = {}
+        all_verbs = sorted({v for acc in accs for v in acc.verbs})
+        for verb in all_verbs:
+            vsteps = []
+            for acc in accs:
+                led = acc.verbs.get(verb)
+                if not led or not led["sent"]:
+                    continue
+                vsteps.append({
+                    "rate": acc.rate,
+                    "sent": led["sent"],
+                    "bad_frac": round(led["bad"] / led["sent"], 5),
+                    **_quantiles_ms(led["lat"]),
+                })
+            verb_block[verb] = {
+                "knee_rate": compute_knee(
+                    vsteps, slo_ms=slo_ms, slo_quantile=slo_quantile,
+                    max_bad_frac=max_bad_frac),
+            }
     server_block = scrape_server_block(target) if scrape else None
     all_fanout = [f for acc in accs for f in acc.fanout]
     capacity = {
@@ -749,6 +829,10 @@ def run_load(
         "steps": steps,
         "server": server_block,
     }
+    if verb_block is not None:
+        # additive key, same versioning posture as fanout_frac: the
+        # per-verb capacity verdicts next to the aggregate knee
+        capacity["verbs"] = verb_block
     flight.record("loadgen.knee", knee_rate=knee, slo_ms=float(slo_ms),
                   steps=len(steps), target=target)
     return {

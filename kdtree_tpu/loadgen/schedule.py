@@ -47,9 +47,13 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Arrival", "MixSpec", "Schedule", "build_schedule",
-           "parse_mix", "parse_recall_mix"]
+           "parse_mix", "parse_recall_mix", "parse_verb_mix"]
 
 OPS = ("query", "upsert", "delete")
+# the read verbs a QUERY arrival can carry (docs/SERVING.md "Query
+# verbs"); "knn" is the default and the only verb pre-verb schedules
+# ever drew
+QUERY_VERBS = ("knn", "radius", "range", "count")
 DEFAULT_REGIONS = 64
 DEFAULT_ZIPF_S = 1.1
 _JITTER_STD = 0.05  # query scatter around its region center (unit cube)
@@ -166,23 +170,71 @@ def parse_recall_mix(raw: Optional[str]):
     return [(t, w / total) for t, w in out]
 
 
+def parse_verb_mix(raw: Optional[str]):
+    """``--verb-mix`` → ``[(verb, weight), ...]`` or None (pure knn).
+
+    ``"knn:0.7,radius:0.2,count:0.1"`` draws each QUERY arrival's read
+    verb by the normalized weights — still seeded, still
+    response-blind, and the extra rng draw happens only when a mix is
+    configured, so an unmixed schedule stays byte-identical to what
+    pre-verb loadgen built from the same seed. Unknown verb names are
+    an error, never a silently-pure-knn run (the fault-spec grammar's
+    lesson)."""
+    if raw is None or not raw.strip():
+        return None
+    weights: Dict[str, float] = {}
+    for clause in raw.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if ":" not in clause:
+            raise ValueError(
+                f"bad verb-mix clause {clause!r}: expected verb:weight"
+            )
+        verb, val = (part.strip() for part in clause.split(":", 1))
+        if verb not in QUERY_VERBS:
+            raise ValueError(
+                f"unknown verb {verb!r}: expected one of "
+                f"{', '.join(QUERY_VERBS)}"
+            )
+        try:
+            weight = float(val)
+        except ValueError:
+            raise ValueError(
+                f"bad verb-mix weight {val!r} in {clause!r}: must be a "
+                "number"
+            ) from None
+        if weight < 0:
+            raise ValueError(f"verb-mix weight {weight:g} in "
+                             f"{clause!r} must be >= 0")
+        weights[verb] = weights.get(verb, 0.0) + weight
+    total = sum(weights.values())
+    if total <= 0:
+        raise ValueError("verb-mix weights must not all be zero")
+    return [(v, weights[v] / total) for v in QUERY_VERBS
+            if v in weights]
+
+
 class Arrival:
     """One scheduled request: when (offset seconds from run start),
-    what (op + payload + the query's recall target, None = exact), and
-    which rate step it belongs to."""
+    what (op + payload + the query's recall target, None = exact, and
+    its read verb — knn/radius/range/count), and which rate step it
+    belongs to."""
 
-    __slots__ = ("t", "step", "op", "point", "gid", "recall")
+    __slots__ = ("t", "step", "op", "point", "gid", "recall", "verb")
 
     def __init__(self, t: float, step: int, op: str,
                  point: Optional[np.ndarray] = None,
                  gid: Optional[int] = None,
-                 recall: Optional[float] = None) -> None:
+                 recall: Optional[float] = None,
+                 verb: str = "knn") -> None:
         self.t = float(t)
         self.step = int(step)
         self.op = op
         self.point = point
         self.gid = gid
         self.recall = recall
+        self.verb = verb
 
     def key(self):
         """Comparable identity for determinism tests: timing, step, op,
@@ -191,7 +243,7 @@ class Arrival:
             round(self.t, 9), self.step, self.op, self.gid,
             None if self.point is None
             else tuple(round(float(x), 9) for x in self.point),
-            self.recall,
+            self.recall, self.verb,
         )
 
 
@@ -201,7 +253,7 @@ class Schedule:
     def __init__(self, arrivals: List[Arrival], rates: List[float],
                  step_seconds: float, seed: int, mix: MixSpec,
                  dim: int, write_base: int, shape: str,
-                 recall_mix=None) -> None:
+                 recall_mix=None, verb_mix=None) -> None:
         self.arrivals = arrivals
         self.rates = [float(r) for r in rates]
         self.step_seconds = float(step_seconds)
@@ -211,6 +263,7 @@ class Schedule:
         self.write_base = int(write_base)
         self.shape = shape
         self.recall_mix = recall_mix
+        self.verb_mix = verb_mix
 
     @property
     def duration_s(self) -> float:
@@ -239,6 +292,13 @@ class Schedule:
                 ["exact" if t is None else t, w]
                 for t, w in self.recall_mix
             ]
+        if self.verb_mix:
+            out["verb_mix"] = [[v, w] for v, w in self.verb_mix]
+            verbs = {v: 0 for v, _ in self.verb_mix}
+            for a in self.arrivals:
+                if a.op == "query":
+                    verbs[a.verb] = verbs.get(a.verb, 0) + 1
+            out["verbs"] = verbs
         return out
 
 
@@ -260,6 +320,7 @@ def build_schedule(
     diurnal_amp: float = 0.3,
     write_base: int = 10_000_000,
     recall_mix=None,
+    verb_mix=None,
 ) -> Schedule:
     """Materialize the whole schedule from the seed — see the module
     docstring for the open-loop rationale.
@@ -271,7 +332,11 @@ def build_schedule(
     :func:`parse_recall_mix`) draws each QUERY arrival's
     ``recall_target`` from a weighted set — still seeded, still
     response-blind — so capacity curves can be driven per serving
-    gear; ``None`` keeps every query exact."""
+    gear; ``None`` keeps every query exact. ``verb_mix`` (from
+    :func:`parse_verb_mix`) likewise draws each query arrival's read
+    verb (knn/radius/range/count); ``None`` keeps every query a knn
+    lookup AND skips the draw entirely, so unmixed schedules stay
+    byte-identical to pre-verb ones from the same seed."""
     if not rates or any(r <= 0 for r in rates):
         raise ValueError(f"rates must be positive, got {list(rates)}")
     if step_seconds <= 0:
@@ -293,6 +358,10 @@ def build_schedule(
     if recall_mix:
         recall_targets = [t for t, _ in recall_mix]
         recall_probs = [w for _, w in recall_mix]
+    verb_names = verb_probs = None
+    if verb_mix:
+        verb_names = [v for v, _ in verb_mix]
+        verb_probs = [w for _, w in verb_mix]
 
     arrivals: List[Arrival] = []
     upserted: List[int] = []  # gids minted so far, in schedule order
@@ -333,8 +402,13 @@ def build_schedule(
                         int(rng.choice(len(recall_targets),
                                        p=recall_probs))
                     ]
+                verb = "knn"
+                if verb_names is not None:
+                    verb = verb_names[
+                        int(rng.choice(len(verb_names), p=verb_probs))
+                    ]
                 arrivals.append(Arrival(t, step, "query", point=point,
-                                        recall=recall))
+                                        recall=recall, verb=verb))
             elif op == "upsert":
                 gid = next_gid
                 next_gid += 1
@@ -350,4 +424,5 @@ def build_schedule(
                 gid = upserted.pop(pick)
                 arrivals.append(Arrival(t, step, "delete", gid=gid))
     return Schedule(arrivals, list(rates), step_seconds, seed, mix, dim,
-                    write_base, shape, recall_mix=recall_mix)
+                    write_base, shape, recall_mix=recall_mix,
+                    verb_mix=verb_mix)

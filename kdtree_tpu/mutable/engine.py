@@ -222,7 +222,8 @@ class _Snapshot:
     """One query's consistent view of the epoch (plain references)."""
 
     __slots__ = ("inner", "epoch", "delta_rows", "delta_view",
-                 "dead_sorted", "masked_pts", "masked_gid")
+                 "dead_sorted", "masked_pts", "masked_gid",
+                 "gid_sorted", "gid_pos")
 
     def __init__(self, st: _EpochState) -> None:
         self.inner = st.inner
@@ -232,6 +233,11 @@ class _Snapshot:
         self.dead_sorted = st.dead_sorted
         self.masked_pts = st.masked_pts
         self.masked_gid = st.masked_gid
+        # the epoch's host id map (built once per epoch, never replaced):
+        # the verb overlays use it to locate tombstoned main rows when a
+        # count answer must subtract dead points it cannot see by id
+        self.gid_sorted = st.gid_sorted
+        self.gid_pos = st.gid_pos
 
     @property
     def empty(self) -> bool:
@@ -286,6 +292,8 @@ class MutableEngine:
         self.last_visit_cap: Optional[int] = None
         self.last_recall_estimate: float = 1.0
         self._rebuilding = False
+        # (dead_sorted identity, host coords) — see _dead_points
+        self._dead_pts_cache: Optional[tuple] = None
         self._journal: Optional[List[tuple]] = None
         self._rebuild_thread: Optional[threading.Thread] = None
         self._closed = False
@@ -406,6 +414,203 @@ class MutableEngine:
             ids = np.concatenate([ids, dids], axis=1)
         d2, ids = merge_rows(d2, ids, k)
         return _pad_cols(d2, ids, k)
+
+    # -- query verbs (radius / range / count) --------------------------------
+
+    def radius_batch(
+        self, queries: np.ndarray, r: np.ndarray,
+        recall_target: Optional[float] = None, with_ids: bool = True,
+    ):
+        """Radius (or radius-count) with the write overlay: the main
+        tree's pruned answer, minus tombstoned hits, plus delta hits.
+        The overlay is always EXACT regardless of the gear — like
+        :meth:`knn_batch`, an approximate (truncated) answer's
+        incompleteness comes only from the main tree's bounded visit,
+        never from missed writes; dead-hit subtraction keeps a
+        truncated count a sound lower bound (clamped at 0)."""
+        snap = self._snapshot()
+        res = snap.inner.radius_batch(queries, r, recall_target,
+                                      with_ids=with_ids)
+        self.last_visit_cap = snap.inner.last_visit_cap
+        self.last_recall_estimate = snap.inner.last_recall_estimate
+        self.last_answer_epoch = snap.epoch
+        if snap.empty:
+            return res
+        return self._verb_overlay("radius", res, snap, queries=queries,
+                                  r=r, with_ids=with_ids)
+
+    def range_batch(
+        self, box_lo: np.ndarray, box_hi: np.ndarray,
+        recall_target: Optional[float] = None, with_ids: bool = True,
+    ):
+        """Box-range (or box-count) with the write overlay — same
+        contract as :meth:`radius_batch`."""
+        snap = self._snapshot()
+        res = snap.inner.range_batch(box_lo, box_hi, recall_target,
+                                     with_ids=with_ids)
+        self.last_visit_cap = snap.inner.last_visit_cap
+        self.last_recall_estimate = snap.inner.last_recall_estimate
+        self.last_answer_epoch = snap.epoch
+        if snap.empty:
+            return res
+        return self._verb_overlay("range", res, snap, box_lo=box_lo,
+                                  box_hi=box_hi, with_ids=with_ids)
+
+    def fallback_radius(self, queries: np.ndarray, r: np.ndarray,
+                        with_ids: bool = True):
+        """The verb degradation path, mutable-aware: brute force over
+        the tombstone-masked flat storage (masked rows carry +inf
+        coords / -1 ids and self-exclude) merged with the delta — exact
+        over the surviving points."""
+        snap = self._snapshot()
+        if snap.empty:
+            return snap.inner.fallback_radius(queries, r,
+                                              with_ids=with_ids)
+        from kdtree_tpu.verbs import device as verb_device
+        from kdtree_tpu.verbs import oracle as verb_oracle
+
+        main = verb_oracle.radius_oracle(
+            np.asarray(snap.masked_pts),
+            queries, r,
+            gid=np.asarray(snap.masked_gid),
+            with_ids=with_ids,
+        )
+        if not snap.delta_rows:
+            return main
+        return verb_device.merge_results(
+            "radius", main,
+            self._delta_verb("radius", snap, queries=queries, r=r,
+                             with_ids=with_ids))
+
+    def fallback_range(self, box_lo: np.ndarray, box_hi: np.ndarray,
+                       with_ids: bool = True):
+        """Brute-force box-range over masked storage + delta."""
+        snap = self._snapshot()
+        if snap.empty:
+            return snap.inner.fallback_range(box_lo, box_hi,
+                                             with_ids=with_ids)
+        from kdtree_tpu.verbs import device as verb_device
+        from kdtree_tpu.verbs import oracle as verb_oracle
+
+        main = verb_oracle.range_oracle(
+            np.asarray(snap.masked_pts),
+            box_lo, box_hi,
+            gid=np.asarray(snap.masked_gid),
+            with_ids=with_ids,
+        )
+        if not snap.delta_rows:
+            return main
+        return verb_device.merge_results(
+            "range", main,
+            self._delta_verb("range", snap, box_lo=box_lo, box_hi=box_hi,
+                             with_ids=with_ids))
+
+    def _verb_overlay(self, kind: str, res, snap: _Snapshot, *,
+                      queries=None, r=None, box_lo=None, box_hi=None,
+                      with_ids: bool = True):
+        """Correct a main-tree verb answer for writes.
+
+        Id-materializing form: tombstoned hits are struck from the
+        buffers (and the counts — verb results are not k-capped, so
+        unlike k-NN no replacement fetch is ever needed: removing a
+        dead hit cannot make a correct answer shorter), delta hits are
+        brute-forced and unioned, rows re-canonicalized.
+
+        Count form (no ids to strike by): main count minus the dead
+        points inside the region (their coordinates gathered once per
+        write generation and cached) plus the delta's count. With a
+        truncated main count L, L <= full implies
+        max(L - dead_in, 0) + delta_in <= exact — the lower-bound
+        contract survives the overlay."""
+        from kdtree_tpu.verbs import device as verb_device
+        from kdtree_tpu.verbs.device import VerbResult
+
+        if not with_ids:
+            counts = res.counts.copy()
+            dead_pts = self._dead_points(snap)
+            if dead_pts is not None:
+                from kdtree_tpu.verbs import oracle as verb_oracle
+
+                if kind == "radius":
+                    dw = verb_oracle.radius_count_oracle(dead_pts,
+                                                         queries, r)
+                else:
+                    dw = verb_oracle.range_count_oracle(dead_pts,
+                                                        box_lo, box_hi)
+                counts = np.maximum(counts - dw, 0)
+            if snap.delta_rows:
+                counts = counts + self._delta_verb(
+                    kind, snap, queries=queries, r=r, box_lo=box_lo,
+                    box_hi=box_hi, with_ids=False).counts
+            return VerbResult(counts, None, None, res.truncated,
+                              res.retries)
+        counts = res.counts.copy()
+        ids = res.ids.copy()
+        d2 = res.d2.copy() if res.d2 is not None else None
+        if snap.dead_sorted.size:
+            hit = in_sorted(snap.dead_sorted, ids)
+            if hit.any():
+                counts = counts - hit.sum(axis=1)
+                ids[hit] = -1
+                if d2 is not None:
+                    d2[hit] = np.inf
+        out = VerbResult(counts, d2, ids, res.truncated, res.retries)
+        if kind == "radius":
+            cd2, cids = verb_device.canonical_radius_rows(
+                out.d2, out.ids)
+            out = VerbResult(counts, cd2, cids, res.truncated,
+                             res.retries)
+        else:
+            out = VerbResult(counts, None,
+                             verb_device.canonical_range_rows(out.ids),
+                             res.truncated, res.retries)
+        if snap.delta_rows:
+            out = verb_device.merge_results(
+                kind, out,
+                self._delta_verb(kind, snap, queries=queries, r=r,
+                                 box_lo=box_lo, box_hi=box_hi,
+                                 with_ids=True))
+        return verb_device.trim_result(out)
+
+    def _delta_verb(self, kind: str, snap: _Snapshot, *, queries=None,
+                    r=None, box_lo=None, box_hi=None,
+                    with_ids: bool = True):
+        """Exact verb answer over the delta buffer — dropped slots hold
+        +inf coords / -1 gid and self-exclude, the same convention as
+        the k-NN delta scan."""
+        from kdtree_tpu.verbs import oracle as verb_oracle
+
+        dev_pts, gid_host = snap.delta_view
+        pts = np.asarray(dev_pts)
+        if kind == "radius":
+            return verb_oracle.radius_oracle(pts, queries, r,
+                                             gid=gid_host,
+                                             with_ids=with_ids)
+        return verb_oracle.range_oracle(pts, box_lo, box_hi,
+                                        gid=gid_host, with_ids=with_ids)
+
+    def _dead_points(self, snap: _Snapshot) -> Optional[np.ndarray]:
+        """Host coordinates of the tombstoned main rows, for the count
+        overlay's subtraction. Gathered once per write generation — the
+        write path replaces ``dead_sorted`` (never mutates it), so the
+        array's identity keys the cache."""
+        ds = snap.dead_sorted
+        if ds.size == 0:
+            return None
+        cached = self._dead_pts_cache
+        if cached is not None and cached[0] is ds:
+            return cached[1]
+        import jax.numpy as jnp
+
+        idx = np.searchsorted(snap.gid_sorted, ds)
+        idx_c = np.minimum(idx, max(snap.gid_sorted.size - 1, 0))
+        ok = (idx < snap.gid_sorted.size) & \
+            (snap.gid_sorted[idx_c] == ds)
+        pos = snap.gid_pos[idx_c][ok]
+        pts = np.asarray(  # kdt-lint: disable=KDT201 once-per-write-generation gather of the (bounded) tombstone set, cached for every later count overlay
+            snap.inner._flat_pts[jnp.asarray(pos.astype(np.int32))])
+        self._dead_pts_cache = (ds, pts)
+        return pts
 
     # -- query overlay -------------------------------------------------------
 

@@ -210,6 +210,16 @@ METRIC_HELP = {
     "kdtree_serve_ready": "1 once the index is loaded and warmup compiled",
     "kdtree_serve_warmup_buckets":
         "pow2 row buckets compiled by the warmup ladder",
+    # query verbs (docs/SERVING.md "Query verbs")
+    "kdtree_verb_requests_total":
+        "verb requests dispatched, by verb (radius/range/count)",
+    "kdtree_verb_batch_rows":
+        "coalesced rows per dispatched verb micro-batch, by verb",
+    "kdtree_verb_truncated_total":
+        "verb answers flagged truncated (sound lower bound under a "
+        "visit cap), by verb",
+    "kdtree_verb_overflow_retries_total":
+        "verb hit-buffer doubling re-runs (buffer settling)",
     # routing (docs/SERVING.md "Routing & fault tolerance")
     "kdtree_router_requests_total":
         "routed k-NN requests by outcome (ok/partial/unavailable/...)",
@@ -539,6 +549,14 @@ def _capacity_lines(cap: Dict) -> list:
     if fanout is not None:
         out.append(f"fan-out fraction:    {fanout:.1%} of shards "
                    "contacted per routed query (selective fan-out)")
+    verbs = cap.get("verbs")
+    if isinstance(verbs, dict) and verbs:
+        knees = "  ".join(
+            f"{verb}={info.get('knee_rate', 0):g}"
+            for verb, info in sorted(verbs.items())
+            if isinstance(info, dict))
+        out.append(f"per-verb knees:      {knees} req/s (offered "
+                   "ladder rate each verb's own samples cleared)")
     # the run's worst exchange, by trace id: the id a waterfall pull
     # (kdtree-tpu trace --id <it> --target <router>) starts from
     worst = None

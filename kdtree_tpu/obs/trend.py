@@ -209,6 +209,8 @@ def _capacity_facts(cap) -> Optional[dict]:
     steps = []
     gears = set()
     gears_known = False
+    verbs = set()
+    verbs_known = False
     for s in cap.get("steps") or []:
         if not isinstance(s, dict) or "rate" not in s:
             continue
@@ -218,6 +220,9 @@ def _capacity_facts(cap) -> Optional[dict]:
         if isinstance(s.get("gears"), dict):
             gears_known = True
             gears.update(s["gears"])
+        if isinstance(s.get("verbs"), dict):
+            verbs_known = True
+            verbs.update(s["verbs"])
     fanout = cap.get("fanout_frac")
     try:
         fanout = None if fanout is None else float(fanout)
@@ -251,7 +256,12 @@ def _capacity_facts(cap) -> Optional[dict]:
             # (None for pre-gear artifacts): the knee comparison must
             # not cross a changed mix — a knee measured half-approx is
             # not comparable to an all-exact one
-            "gears": sorted(gears) if gears_known else None}
+            "gears": sorted(gears) if gears_known else None,
+            # the read verbs the run's queries were drawn over (None
+            # for unmixed/pre-verb artifacts): same incommensurability
+            # rule — a knee measured 30% radius/count is not comparable
+            # to a pure-knn one
+            "verbs": sorted(verbs) if verbs_known else None}
 
 
 def _recall_facts(block) -> Optional[dict]:
@@ -435,9 +445,13 @@ def analyze(runs: List[dict], band: Optional[float] = None):
             # run driven half-approximate meets the latency SLO at
             # rates an all-exact run cannot, and comparing them would
             # mint false drops (or mask real ones). Pre-gear
-            # artifacts (gears None) compare as before.
+            # artifacts (gears None) compare as before. A changed
+            # VERB mix is incommensurable for the same reason — the
+            # verbs do different amounts of work per request.
             pg, cg = prev_cap[1].get("gears"), cap.get("gears")
-            comparable = pg is None or cg is None or pg == cg
+            pv, cv = prev_cap[1].get("verbs"), cap.get("verbs")
+            comparable = (pg is None or cg is None or pg == cg) and \
+                (pv is None or cv is None or pv == cv)
             if comparable and pknee and pknee > 0 and \
                     cknee is not None and \
                     (pknee - cknee) / pknee > used:

@@ -58,7 +58,8 @@ class PendingRequest:
     __slots__ = (
         "queries", "k", "deadline", "enqueued_at", "dispatched_at",
         "event", "d2", "ids", "degraded", "error", "trace_id",
-        "recall_target", "gear", "trace_ctx",
+        "recall_target", "gear", "trace_ctx", "verb", "radius",
+        "box_hi", "counts", "truncated",
     )
 
     def __init__(
@@ -67,9 +68,26 @@ class PendingRequest:
         trace_id: str = "",
         recall_target: Optional[float] = None,
         trace_ctx=None,
+        verb: str = "knn",
+        radius: Optional[np.ndarray] = None,
+        box_hi: Optional[np.ndarray] = None,
     ) -> None:
         self.queries = queries  # f32[q, D], validated by the handler
         self.k = k
+        # the query verb (docs/SERVING.md "Query verbs"): "knn" (the
+        # default, result in d2/ids at k columns), "radius" / "range" /
+        # "count_radius" / "count_box". Per-query parameters ride WITH
+        # the request — radius f32[q] for the radius forms, box corners
+        # as (queries=lo, box_hi=hi) for the box forms — so a batch
+        # only needs a shared (verb, recall_target), not shared
+        # geometry. The worker fills counts (+ truncated) for verb
+        # requests; d2/ids stay the k-NN result channel (verbs reuse
+        # ids for their hit lists, d2 for radius distances).
+        self.verb = verb
+        self.radius = radius
+        self.box_hi = box_hi
+        self.counts: Optional[np.ndarray] = None
+        self.truncated: bool = False
         self.deadline = deadline  # absolute time.monotonic(), or None
         # the request's recall dial (docs/SERVING.md "Degradation
         # ladder"): None = exact (the default contract), a float < 1 =
@@ -108,12 +126,16 @@ class PendingRequest:
             (now if now is not None else time.monotonic()) > self.deadline
 
     def fulfill(
-        self, d2: np.ndarray, ids: np.ndarray,
+        self, d2: Optional[np.ndarray], ids: Optional[np.ndarray],
         degraded: Optional[str] = None,
         gear: Optional[str] = None,
+        counts: Optional[np.ndarray] = None,
+        truncated: bool = False,
     ) -> None:
         self.d2, self.ids, self.degraded = d2, ids, degraded
         self.gear = gear
+        self.counts = counts
+        self.truncated = truncated
         self.event.set()
 
     def fail(self, message: str) -> None:

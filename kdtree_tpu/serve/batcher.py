@@ -142,6 +142,28 @@ class MicroBatcher:
             for gear in ("exact", "approx", "brute-deadline")
         }
         self._errors = reg.counter("kdtree_serve_batch_errors_total")
+        # the query verbs (docs/SERVING.md "Query verbs"): request and
+        # batch-row accounting per verb FAMILY — a bounded label set
+        # (KDT106): the two count forms share the "count" label, the
+        # geometry rides in the flight ring
+        self._verb_requests = {
+            v: reg.counter("kdtree_verb_requests_total",
+                           labels={"verb": v})
+            for v in ("radius", "range", "count")
+        }
+        self._verb_rows = {
+            v: reg.histogram("kdtree_verb_batch_rows",
+                             buckets=_BATCH_ROW_BUCKETS,
+                             labels={"verb": v})
+            for v in ("radius", "range", "count")
+        }
+        self._verb_truncated = {
+            v: reg.counter("kdtree_verb_truncated_total",
+                           labels={"verb": v})
+            for v in ("radius", "range", "count")
+        }
+        self._verb_retries = reg.counter(
+            "kdtree_verb_overflow_retries_total")
         # the online recall sampler (docs/SERVING.md "Degradation
         # ladder"): every Nth APPROXIMATE batch is shadow-answered
         # exactly and the measured recall@k published as
@@ -193,9 +215,13 @@ class MicroBatcher:
     def _collect(self, first: PendingRequest) -> List[PendingRequest]:
         """Absorb arrivals behind ``first`` until the batch is full or
         ``max_wait`` has elapsed since coalescing began. Only requests
-        sharing ``first``'s recall target join: one batch = one gear
-        (a mixed batch would either over-serve the approximate
-        requests or approximate the exact ones)."""
+        sharing ``first``'s (verb, recall target) join: one batch = one
+        gear AND one dispatch kind (per-query geometry — radii, boxes —
+        rides in each request, so a verb batch needs no shared
+        parameters, but a mixed-verb batch has no single engine call).
+        The padded row count is still the plan-signature bucket, so
+        per-verb batches reuse the same pow2 quantization the k-NN
+        plan store is keyed by."""
         batch = [first]
         rows = first.rows
         t_end = time.monotonic() + self.max_wait
@@ -207,7 +233,8 @@ class MicroBatcher:
             if nxt is None:
                 break
             if rows + nxt.rows > self.max_batch or \
-                    nxt.recall_target != first.recall_target:
+                    nxt.recall_target != first.recall_target or \
+                    nxt.verb != first.verb:
                 self.queue.push_front(nxt)  # keeps FIFO; next batch leads with it
                 break
             batch.append(nxt)
@@ -241,6 +268,8 @@ class MicroBatcher:
                 # LAST step of the ladder instead of its only one
                 for req in live:
                     self._run_fallback(req, reason="brute-deadline")
+            elif live[0].verb != "knn":
+                self._run_verb_batch(live, spec)
             else:
                 self._run_batch(live, spec)
         for req in late:
@@ -388,6 +417,138 @@ class MicroBatcher:
                 self._sample_tick = 0
                 self._shadow_sample(q, rows, ids, estimate)
 
+    @staticmethod
+    def _verb_family(verb: str) -> str:
+        """Metric label for a request verb: the two count forms share
+        one bounded "count" label (KDT106)."""
+        return "count" if verb.startswith("count") else verb
+
+    def _run_verb_batch(self, live: List[PendingRequest],
+                        spec=None) -> None:
+        """Dispatch one verb-homogeneous batch (radius / range / either
+        count form) through the engine's verb methods. Same pow2 row
+        quantization, gear resolution, and gear accounting as the k-NN
+        path; the result rides back per request as (counts, ids,
+        distances) slices. ``truncated`` is a BATCH-level flag — every
+        request of a cut batch is flagged, conservatively: calling an
+        exact row a lower bound is sound, the reverse is not."""
+        verb = live[0].verb
+        fam = self._verb_family(verb)
+        rows = sum(r.rows for r in live)
+        bucket = batch_bucket(rows, self.max_batch, self.min_bucket)
+        q = np.concatenate([r.queries for r in live], axis=0)
+        aux = None  # radius f32[rows] | box_hi f32[rows, D] | None
+        if verb in ("radius", "count_radius"):
+            aux = np.concatenate([r.radius for r in live])
+        elif verb in ("range", "count_box"):
+            aux = np.concatenate([r.box_hi for r in live], axis=0)
+        if bucket > rows:
+            pad = np.broadcast_to(q[-1], (bucket - rows, q.shape[1]))
+            q = np.concatenate([q, pad], axis=0)
+            if aux is not None:
+                ap = np.broadcast_to(aux[-1], (bucket - rows,)
+                                     + aux.shape[1:])
+                aux = np.concatenate([aux, ap], axis=0)
+        ladder_t = spec.recall_target if spec is not None else None
+        req_t = live[0].recall_target
+        asked = [t for t in (ladder_t, req_t) if t is not None]
+        effective = min(asked) if asked else None
+        lead = next((r for r in live if r.trace_ctx is not None), None)
+        dispatch_ctx = lead.trace_ctx.child() if lead is not None \
+            else None
+        with_ids = not verb.startswith("count")
+        try:
+            with trace_mod.active(dispatch_ctx):
+                if verb in ("radius", "count_radius"):
+                    res = self.engine.radius_batch(
+                        q, aux, recall_target=effective,
+                        with_ids=with_ids)
+                else:
+                    res = self.engine.range_batch(
+                        q, aux, recall_target=effective,
+                        with_ids=with_ids)
+        except Exception as e:
+            self._errors.inc()
+            flight.record("serve.batch_error", rows=rows,
+                          requests=len(live), verb=verb,
+                          error=repr(e)[:200],
+                          traces=[r.trace_id for r in live])
+            flight.auto_dump("serve-error")
+            for r in live:
+                r.fail(f"batch dispatch failed: {e!r}")
+            return
+        done = time.monotonic()
+        visit_cap = getattr(self.engine, "last_visit_cap", None)
+        estimate = getattr(self.engine, "last_recall_estimate", 1.0)
+        gear = None
+        forced = None
+        if effective is not None and visit_cap is not None:
+            gear = f"approx:{effective:g}"
+            if ladder_t is not None and (req_t is None
+                                         or ladder_t < req_t):
+                forced = gear
+                self._degraded["ladder"].inc(len(live))
+        self._by_gear["approx" if gear else "exact"].inc(len(live))
+        if self.ladder is not None and forced is not None:
+            self.ladder.engaged(estimate)
+        self._verb_requests[fam].inc(len(live))
+        self._verb_rows[fam].observe(rows)
+        if res.truncated:
+            self._verb_truncated[fam].inc(len(live))
+        if res.retries:
+            self._verb_retries.inc(res.retries)
+        self._batch_rows.observe(rows)
+        self._batch_reqs.observe(len(live))
+        flight.record(
+            "serve.batch", rows=rows, bucket=bucket, requests=len(live),
+            verb=verb, gear=gear or "exact", visit_cap=visit_cap,
+            truncated=bool(res.truncated), retries=int(res.retries),
+            dispatch_ms=round((done - live[0].dispatched_at) * 1e3, 3),
+            epoch=getattr(self.engine, "last_answer_epoch", 0),
+            traces=[r.trace_id for r in live],
+        )
+        done_unix = time.time()
+        off = 0
+        for r in live:
+            self._lat["dispatch"].observe(done - r.dispatched_at)
+            self._lat["total"].observe(done - r.enqueued_at,
+                                       exemplar=r.trace_id)
+            if r.trace_ctx is not None:
+                ctx = r.trace_ctx
+                trace_mod.record_span(
+                    ctx.trace_id, trace_mod.new_span_id(), ctx.span_id,
+                    "serve/queue",
+                    done_unix - (done - r.enqueued_at),
+                    done_unix - (done - r.dispatched_at),
+                    rows=r.rows,
+                )
+                trace_mod.record_span(
+                    ctx.trace_id,
+                    (dispatch_ctx.span_id
+                     if lead is r and dispatch_ctx is not None
+                     else trace_mod.new_span_id()),
+                    ctx.span_id, "serve/dispatch",
+                    done_unix - (done - r.dispatched_at), done_unix,
+                    rows=rows, bucket=bucket, coalesced=len(live),
+                    verb=verb, gear=gear or "exact",
+                )
+            flight.record(
+                "serve.request", trace=r.trace_id, rows=r.rows,
+                verb=verb,
+                queue_ms=round((r.dispatched_at - r.enqueued_at) * 1e3,
+                               3),
+                device_ms=round((done - r.dispatched_at) * 1e3, 3),
+                total_ms=round((done - r.enqueued_at) * 1e3, 3),
+            )
+            r.fulfill(
+                None if res.d2 is None else res.d2[off:off + r.rows],
+                None if res.ids is None else res.ids[off:off + r.rows],
+                degraded=forced, gear=gear,
+                counts=res.counts[off:off + r.rows],
+                truncated=bool(res.truncated),
+            )
+            off += r.rows
+
     def _shadow_sample(self, q: np.ndarray, rows: int,
                        approx_ids: np.ndarray, estimate: float) -> None:
         """One online recall sample: re-answer the (already padded)
@@ -427,8 +588,26 @@ class MicroBatcher:
         # is the brute-deadline class)
         self._by_gear["brute-deadline" if reason == "brute-deadline"
                       else "exact"].inc()
+        counts = None
+        truncated = False
         try:
-            d2, ids = self.engine.fallback_knn(req.queries, req.k)
+            if req.verb == "knn":
+                d2, ids = self.engine.fallback_knn(req.queries, req.k)
+            else:
+                # verb stragglers go through the mutable-aware exact
+                # brute-force verb path — same contract as fallback_knn
+                # (exact, no batch coupling), counts included
+                with_ids = not req.verb.startswith("count")
+                if req.verb in ("radius", "count_radius"):
+                    res = self.engine.fallback_radius(
+                        req.queries, req.radius, with_ids=with_ids)
+                else:
+                    res = self.engine.fallback_range(
+                        req.queries, req.box_hi, with_ids=with_ids)
+                d2, ids, counts = res.d2, res.ids, res.counts
+                fam = self._verb_family(req.verb)
+                self._verb_requests[fam].inc()
+                self._verb_rows[fam].observe(req.rows)
         except Exception as e:
             self._errors.inc()
             flight.record("serve.batch_error", rows=req.rows, requests=1,
@@ -466,4 +645,5 @@ class MicroBatcher:
         # batch path above
         req.fulfill(d2, ids, degraded=reason,
                     gear="brute-deadline" if reason == "brute-deadline"
-                    else None)
+                    else None,
+                    counts=counts, truncated=truncated)

@@ -70,7 +70,12 @@ _KINDS = ("latency", "error", "hang", "drop")
 SITE_KNN = "knn"
 SITE_HEALTHZ = "healthz"
 SITE_BATCH = "batch"
-KNOWN_SITES = (SITE_KNN, SITE_HEALTHZ, SITE_BATCH)
+# the verb endpoints (/v1/radius, /v1/range, /v1/count) share one site:
+# they share one handler path and one batch-worker dispatch, so a drill
+# that faults "verb" faults all three — per-verb granularity would
+# triple the enum without a failure mode that distinguishes them
+SITE_VERB = "verb"
+KNOWN_SITES = (SITE_KNN, SITE_HEALTHZ, SITE_BATCH, SITE_VERB)
 
 
 class FaultSpecError(ValueError):

@@ -129,6 +129,110 @@ class ServeEngine:
             else 1.0
         return out[0], out[1], plan.source
 
+    def _verb_visit_cap(self, Q: int,
+                        recall_target: Optional[float]):
+        """Resolve the bounded-visit cap for a verb batch through the
+        SAME plan-store calibration the k-NN path uses (the pow2 row
+        bucket's signature): a verb's truncated answer rides the gear/
+        recall contract, so the cap-per-target mapping must be the one
+        the ladder and the recall sampler already measure."""
+        if recall_target is None:
+            return None, 1.0
+        from kdtree_tpu import approx, tuning
+        from kdtree_tpu.ops.tile_query import plan_tiled
+
+        t = self.tree
+        plan = plan_tiled(Q, t.dim, t.n_real, t.num_buckets,
+                          t.bucket_size, self.k)
+        prof = tuning.profile_for(plan.sig) if plan.sig is not None \
+            else None
+        visit_cap = approx.resolve_visit_cap(
+            recall_target, t.num_buckets, self.k, t.bucket_size,
+            profile=prof,
+        )
+        estimate = 1.0
+        if visit_cap is not None:
+            measured = (prof or {}).get("recall_measured") or {}
+            try:
+                estimate = float(measured.get(
+                    f"{float(recall_target):g}", recall_target))
+            except (TypeError, ValueError):
+                estimate = float(recall_target)
+        return visit_cap, estimate
+
+    def radius_batch(
+        self, queries: np.ndarray, r: np.ndarray,
+        recall_target: Optional[float] = None, with_ids: bool = True,
+    ):
+        """Radius (or radius-count, ``with_ids=False``) for one
+        micro-batch via the tree-pruned verb kernel. Exact by default;
+        under a ``recall_target`` the resolved visit cap truncates the
+        lb-ascending candidate list and the answer is a flagged SOUND
+        LOWER BOUND (``result.truncated``) — the verbs' analog of the
+        k-NN recall contract. Returns a host
+        :class:`~kdtree_tpu.verbs.device.VerbResult`."""
+        from kdtree_tpu.verbs import device as verb_device
+
+        Q = queries.shape[0]
+        visit_cap, estimate = self._verb_visit_cap(Q, recall_target)
+        with obs.span("serve.verb", sync=False, verb="radius", q=Q,
+                      visit_cap=visit_cap, ids=with_ids):
+            res = verb_device.radius_search(
+                self.tree, queries, r, visit_cap=visit_cap,
+                with_ids=with_ids,
+            )
+        self.last_visit_cap = visit_cap
+        self.last_recall_estimate = estimate if visit_cap is not None \
+            else 1.0
+        return res
+
+    def range_batch(
+        self, box_lo: np.ndarray, box_hi: np.ndarray,
+        recall_target: Optional[float] = None, with_ids: bool = True,
+    ):
+        """Box-range (or box-count) for one micro-batch — same contract
+        as :meth:`radius_batch`."""
+        from kdtree_tpu.verbs import device as verb_device
+
+        Q = box_lo.shape[0]
+        visit_cap, estimate = self._verb_visit_cap(Q, recall_target)
+        with obs.span("serve.verb", sync=False, verb="range", q=Q,
+                      visit_cap=visit_cap, ids=with_ids):
+            res = verb_device.range_search(
+                self.tree, box_lo, box_hi, visit_cap=visit_cap,
+                with_ids=with_ids,
+            )
+        self.last_visit_cap = visit_cap
+        self.last_recall_estimate = estimate if visit_cap is not None \
+            else 1.0
+        return res
+
+    def fallback_radius(self, queries: np.ndarray, r: np.ndarray,
+                        with_ids: bool = True):
+        """Brute-force radius over the flat bucket storage — the verb
+        analog of :meth:`fallback_knn` (exact, no batch coupling);
+        padding rows self-exclude through the gid mask."""
+        from kdtree_tpu.verbs import oracle as verb_oracle
+
+        return verb_oracle.radius_oracle(
+            np.asarray(self._flat_pts),  # kdt-lint: disable=KDT201 degraded-path brute force runs on host storage by design, like fallback_knn
+            queries, r,
+            gid=np.asarray(self._flat_gid),  # kdt-lint: disable=KDT201 degraded-path brute force runs on host storage by design, like fallback_knn
+            with_ids=with_ids,
+        )
+
+    def fallback_range(self, box_lo: np.ndarray, box_hi: np.ndarray,
+                       with_ids: bool = True):
+        """Brute-force box-range over the flat bucket storage."""
+        from kdtree_tpu.verbs import oracle as verb_oracle
+
+        return verb_oracle.range_oracle(
+            np.asarray(self._flat_pts),  # kdt-lint: disable=KDT201 degraded-path brute force runs on host storage by design, like fallback_knn
+            box_lo, box_hi,
+            gid=np.asarray(self._flat_gid),  # kdt-lint: disable=KDT201 degraded-path brute force runs on host storage by design, like fallback_knn
+            with_ids=with_ids,
+        )
+
     def fallback_knn(
         self, queries: np.ndarray, k: int,
     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -238,6 +342,19 @@ class ServeState:
                     np.float32
                 )
                 self.engine.knn_batch(q)
+                # the verb kernels too (docs/SERVING.md "Query verbs"):
+                # each verb/bucket pair is its own jit cache entry, and
+                # a compile on the serving path stalls the process long
+                # enough to fail health probes and get the replica
+                # ejected — exactly what the warmup ladder exists to
+                # prevent. A tiny radius keeps the hit buffers at their
+                # floor; the box form shares the range kernel.
+                if hasattr(self.engine, "radius_batch"):
+                    tiny = np.full(b, 1e-6, dtype=np.float32)
+                    self.engine.radius_batch(q, tiny)
+                    self.engine.radius_batch(q, tiny, with_ids=False)
+                    self.engine.range_batch(q, q)
+                    self.engine.range_batch(q, q, with_ids=False)
         if hasattr(self.engine, "warm_buckets"):
             # tell the mutable engine's epoch rebuilder which batch
             # shapes serving actually compiled, so a rebuilt epoch is

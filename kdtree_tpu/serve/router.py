@@ -587,6 +587,55 @@ def merge_gear(payloads: List[dict]) -> Optional[str]:
     return "brute-deadline" if brute else None
 
 
+def merge_verb(endpoint: str, payloads: List[dict]) -> dict:
+    """Merge per-shard verb payloads (docs/SERVING.md "Query verbs")
+    into the single-index answer shape. Shards partition the points, so:
+
+    - ``count`` is the SUM over answering shards — exact by
+      construction, every live point is counted on exactly one shard;
+    - ``radius`` is the per-query union of (distance, id) rows, deduped
+      by id keeping the minimum distance (replica/box overlap safety —
+      identical arithmetic on every shard makes duplicates carry
+      identical distances anyway) and re-sorted by (distance, id), the
+      same two-key order every shard and the oracle emit — so an
+      all-shards merge is byte-identical to the single-index answer;
+    - ``range`` is the per-query sorted dedup union of ids.
+
+    ``truncated`` ORs across shards: one shard's lower bound makes the
+    union/sum a lower bound."""
+    if not payloads:
+        raise ValueError("merge_verb needs at least one shard payload")
+    nq = len(payloads[0]["counts"])
+    out: dict = {"truncated": any(bool(p.get("truncated"))
+                                  for p in payloads)}
+    if endpoint == "count":
+        out["counts"] = [sum(int(p["counts"][q]) for p in payloads)
+                         for q in range(nq)]
+        return out
+    if endpoint == "radius":
+        out_ids: List[List[int]] = []
+        out_d: List[List[float]] = []
+        for q in range(nq):
+            best: dict = {}
+            for p in payloads:
+                for d, i in zip(p["distances"][q], p["ids"][q]):
+                    if i not in best or d < best[i]:
+                        best[i] = d
+            rows = sorted((d, i) for i, d in best.items())
+            out_d.append([d for d, _ in rows])
+            out_ids.append([i for _, i in rows])
+        out["ids"] = out_ids
+        out["distances"] = out_d
+        out["counts"] = [len(r) for r in out_ids]
+        return out
+    # range
+    ids = [sorted(set(i for p in payloads for i in p["ids"][q]))
+           for q in range(nq)]
+    out["ids"] = ids
+    out["counts"] = [len(r) for r in ids]
+    return out
+
+
 class RouterHandler(JsonRequestHandler):
     """Scatter/gather glue; pure host code (no jax anywhere in the
     router process's request path). Serialization + keep-alive timeout
@@ -683,7 +732,8 @@ class RouterHandler(JsonRequestHandler):
 
     def do_POST(self) -> None:
         path = self.path.split("?", 1)[0]
-        if path not in ("/v1/knn", "/v1/upsert", "/v1/delete"):
+        if path not in ("/v1/knn", "/v1/upsert", "/v1/delete",
+                        "/v1/radius", "/v1/range", "/v1/count"):
             self._send_json(404, {"error": f"no such path: {path}"})
             return
         # the router is an SLO-paging front a loadgen run can target:
@@ -729,6 +779,27 @@ class RouterHandler(JsonRequestHandler):
             code, out = self.server.route_write(op, payload, trace,
                                                 ctx=ctx)
             self._send_json(code, out)
+            return
+        if path in ("/v1/radius", "/v1/range", "/v1/count"):
+            if not isinstance(payload, dict):
+                self._send_json(400, {"error": "body must be a JSON "
+                                               "object"})
+                return
+            # shared dial, shared validator — reject here instead of
+            # fanning out a request every shard will 400 (the geometry
+            # itself is validated authoritatively by the shards, which
+            # know the index dim; the router only reads it for pruning)
+            from kdtree_tpu.approx.search import (
+                RECALL_TARGET_ERROR as _RT_ERR,
+                parse_recall_target as _parse_rt,
+            )
+
+            if not _parse_rt(payload.get("recall_target"))[0]:
+                self._send_json(400, {"error": _RT_ERR})
+                return
+            code, out, headers = self.server.route_verb(
+                path, body, payload, trace, ctx=ctx)
+            self._send_json(code, out, extra_headers=headers)
             return
         if not isinstance(payload, dict) or "queries" not in payload:
             self._send_json(400, {"error": 'body must be a JSON object '
@@ -1091,7 +1162,14 @@ class Router(GracefulHTTPServer):
         except (UnicodeDecodeError, ValueError):
             raise ShardError(f"shard {shard.index}: unparseable 200 body",
                              outcome="network") from None
-        want_key = "ids" if path == "/v1/knn" else "applied"
+        # the per-endpoint sanity key: a 200 whose body lacks the
+        # endpoint's result channel is a malformed shard, not an answer
+        if path == "/v1/knn":
+            want_key = "ids"
+        elif path in ("/v1/radius", "/v1/range", "/v1/count"):
+            want_key = "counts"
+        else:
+            want_key = "applied"
         if not isinstance(payload, dict) or want_key not in payload:
             raise ShardError(f"shard {shard.index}: malformed payload",
                              outcome="network")
@@ -1106,7 +1184,7 @@ class Router(GracefulHTTPServer):
         self, shard: ShardState, body: bytes, deadline: float, trace: str,
         allow_hedge: bool = True, hedge_shard: Optional[ShardState] = None,
         ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
-        spec: bool = False,
+        spec: bool = False, path: str = "/v1/knn",
     ) -> Tuple[dict, ShardState]:
         """One logical attempt = a primary call plus (maybe) one hedge.
         The first success wins and the loser's connection is closed;
@@ -1151,6 +1229,7 @@ class Router(GracefulHTTPServer):
                     # aborts itself before sending anything
                     abort_check=lambda: result.get("winner") not in
                     (None, tag),
+                    path=path,
                     tp=trace_mod.outbound_header(a_ctx),
                 )
                 with cond:
@@ -1264,7 +1343,7 @@ class Router(GracefulHTTPServer):
     def _shard_task(
         self, sset: ReplicaSet, body: bytes, deadline: float, trace: str,
         ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
-        spec: bool = False,
+        spec: bool = False, path: str = "/v1/knn",
     ):
         """The full per-shard policy, replica-aware: pick a routable
         replica round-robin (ejection and breaker checks per replica),
@@ -1313,7 +1392,7 @@ class Router(GracefulHTTPServer):
                     # aim the hedge at a sibling replica when one is
                     # routable (None falls back to the same process)
                     hedge_shard=sset.hedge_candidate(shard),
-                    ctx=ctx, wave=wave, spec=spec,
+                    ctx=ctx, wave=wave, spec=spec, path=path,
                 )
             except ShardError as e:
                 last = e
@@ -1382,6 +1461,7 @@ class Router(GracefulHTTPServer):
         ctx: Optional[trace_mod.TraceContext] = None, wave: int = 1,
         spec: bool = False,
         on_done: Optional[Callable[[], None]] = None,
+        path: str = "/v1/knn",
     ) -> List[threading.Thread]:
         """Launch one concurrent scatter wave over the named shard
         sets; results land in ``results`` by set index (waves touch
@@ -1398,7 +1478,8 @@ class Router(GracefulHTTPServer):
             def task(s=self.shard_sets[i]):
                 results[s.index] = self._shard_task(s, body, deadline,
                                                     trace, ctx=ctx,
-                                                    wave=wave, spec=spec)
+                                                    wave=wave, spec=spec,
+                                                    path=path)
                 if on_done is not None:
                     on_done()
 
@@ -1819,6 +1900,186 @@ class Router(GracefulHTTPServer):
                      f"(quorum {required}); failing shards: {missing}",
             "trace_id": trace,
             "shards": shards_block(),
+        }, {"Retry-After": str(int(max(self.config.breaker_reset_s, 1.0)))}
+
+    # -- query verbs ---------------------------------------------------------
+
+    @staticmethod
+    def _verb_inputs(payload) -> Optional[Tuple[str, np.ndarray,
+                                                np.ndarray]]:
+        """The verb request's pruning geometry: ``("ball", centers
+        f32[Q, D], r2 f32[Q])`` for the radius forms or ``("box", lo
+        f32[Q, D], hi f32[Q, D])`` for the box forms. Lenient like
+        :meth:`_spatial_inputs`: anything that fails to parse disables
+        pruning (full fan-out; the shards issue the authoritative 400).
+        ``r2`` is computed in float32 — the SAME arithmetic the shard
+        kernel prunes with, so the router can never prune a shard whose
+        kernel would have reported a hit."""
+        try:
+            if "r" in payload or "queries" in payload:
+                q = np.asarray(payload.get("queries"), dtype=np.float32)  # kdt-lint: disable=KDT201 router process holds no jax: geometry is parsed JSON
+                r = np.asarray(payload.get("r"), dtype=np.float32)  # kdt-lint: disable=KDT201 router process holds no jax: geometry is parsed JSON
+                if q.ndim == 2 and q.shape[0] >= 1 and \
+                        bool(np.isfinite(q).all()) and \
+                        r.ndim in (0, 1) and bool(np.isfinite(r).all()) \
+                        and bool((r >= 0).all()):
+                    r = np.broadcast_to(r, (q.shape[0],)) \
+                        .astype(np.float32)
+                    return "ball", q, r * r
+            else:
+                lo = np.asarray(payload.get("lo"), dtype=np.float32)  # kdt-lint: disable=KDT201 router process holds no jax: geometry is parsed JSON
+                hi = np.asarray(payload.get("hi"), dtype=np.float32)  # kdt-lint: disable=KDT201 router process holds no jax: geometry is parsed JSON
+                if lo.ndim == 2 and lo.shape == hi.shape and \
+                        lo.shape[0] >= 1 and \
+                        bool(np.isfinite(lo).all()) and \
+                        bool(np.isfinite(hi).all()):
+                    return "box", lo, hi
+        except (TypeError, ValueError):
+            pass
+        return None
+
+    def route_verb(
+        self, path: str, body: bytes, payload: dict, trace: str,
+        ctx: Optional[trace_mod.TraceContext] = None,
+    ) -> Tuple[int, dict, Optional[dict]]:
+        """Fan one verb request out and merge per-verb
+        (:func:`merge_verb`). Selective fan-out is ONE wave, not the
+        k-NN widening loop: a verb's geometry is fixed by the request —
+        a shard either can hold a hit (box lower bound within the ball,
+        or box-vs-box overlap) or provably cannot — so the exact
+        contacted set is known before any shard answers. Boxless
+        (legacy/unprobed) sets are always contacted. A partial merge
+        (>= quorum answered) is flagged ``degraded: partial:a/m`` AND
+        ``truncated: true`` — a union/sum over a subset of the shards
+        is exactly the verbs' sound-lower-bound contract."""
+        t0 = time.monotonic()
+        t0_wall = time.time()
+        deadline = t0 + self.config.deadline_s
+        endpoint = path.rsplit("/", 1)[1]
+        n = len(self.shard_sets)
+        results: List[Optional[object]] = [None] * n
+        geom = self._verb_inputs(payload)
+        boxes = [s.box() for s in self.shard_sets]
+        contacted = list(range(n))
+        if self.config.fanout == "selective" and n > 1 and \
+                geom is not None:
+            kind, a, b = geom
+            need: List[int] = []
+            for i, box in enumerate(boxes):
+                if box is None or box[0].size != a.shape[1]:
+                    need.append(i)  # no box = no pruning argument
+                    continue
+                if kind == "ball":
+                    # same f32 gap-max-sum bound the shard kernel
+                    # prunes with: lb > r2 everywhere = provably no hit
+                    lb = spatial.box_lower_bounds(a, box[0], box[1])
+                    if bool((lb <= b).any()):
+                        need.append(i)
+                else:
+                    # box-vs-box disjointness, exact comparisons
+                    overlap = np.logical_and(
+                        a <= box[1][None, :], box[0][None, :] <= b
+                    ).all(axis=1)
+                    if bool(overlap.any()):
+                        need.append(i)
+            contacted = need
+        m = len(contacted)
+        pruned = n - m
+        if m == 0:
+            # every shard provably holds no hit: the exact answer is
+            # empty, no fan-out at all (counts all-zero, empty rows)
+            nq = int(geom[1].shape[0])
+            self._contacted.observe(0)
+            self._pruned.inc(pruned)
+            self._count_request("ok")
+            self._trace_route_finish(ctx, t0_wall, time.time(), "ok",
+                                     None, 0, 0, pruned)
+            out = {"counts": [0] * nq, "truncated": False,
+                   "degraded": None, "trace_id": trace,
+                   "shards": {"total": n, "contacted": 0, "answered": 0,
+                              "missing": [], "pruned": pruned}}
+            if endpoint == "radius":
+                out["ids"] = [[] for _ in range(nq)]
+                out["distances"] = [[] for _ in range(nq)]
+            elif endpoint == "range":
+                out["ids"] = [[] for _ in range(nq)]
+            return 200, out, None
+        threads = self._scatter_start(contacted, body, deadline, trace,
+                                      results, ctx=ctx, path=path)
+        self._scatter_join(threads, deadline + 0.25)
+        self._contacted.observe(m)
+        if pruned:
+            self._pruned.inc(pruned)
+            flight.record("route.fanout", trace=trace, contacted=m,
+                          total=n, pruned=pruned, verb=endpoint)
+        snapshot = list(results)
+        t_merge0 = time.time()
+        payloads = [snapshot[i] for i in contacted
+                    if isinstance(snapshot[i], dict)]
+        errors = {i: snapshot[i] for i in contacted
+                  if isinstance(snapshot[i], ShardError)}
+        for err in errors.values():
+            if err.outcome == "client_error" and err.body is not None:
+                self._count_request("client_error")
+                out = dict(err.body)
+                out["trace_id"] = trace
+                self._trace_route_finish(
+                    ctx, t0_wall, None, "client_error", None, m,
+                    len(payloads), pruned)
+                return err.status or 400, out, None
+        self._req_lat.observe(time.monotonic() - t0, exemplar=trace)
+        missing = sorted(set(contacted)
+                         - {i for i in contacted
+                            if isinstance(snapshot[i], dict)})
+        answered = len(payloads)
+        required = min(self.quorum, m)
+        shards_block = {"total": n, "contacted": m, "answered": answered,
+                        "missing": missing, "pruned": pruned}
+        if answered >= required and answered > 0:
+            merged = merge_verb(endpoint, payloads)
+            partial = answered < m
+            degraded = (f"partial:{answered}/{m}" if partial else next(
+                (p["degraded"] for p in payloads if p.get("degraded")),
+                None))
+            gear = merge_gear(payloads)
+            out = dict(merged)
+            if partial:
+                # a subset union/sum is a sound lower bound — the same
+                # flag a truncated single-shard answer carries
+                out["truncated"] = True
+            out["degraded"] = degraded
+            out["trace_id"] = trace
+            out["shards"] = shards_block
+            if gear is not None:
+                out["gear"] = gear
+            status = "partial" if partial else "ok"
+            self._count_request(status)
+            self._trace_route_finish(ctx, t0_wall, t_merge0, status,
+                                     degraded, m, answered, pruned)
+            if partial:
+                self._partial.inc()
+                flight.record(
+                    "route.partial", trace=trace, answered=answered,
+                    total=n, contacted=m, missing=missing,
+                    outcomes={str(i): e.outcome
+                              for i, e in errors.items()},
+                )
+                flight.auto_dump("route-partial")
+            return 200, out, None
+        self._count_request("unavailable")
+        self._trace_route_finish(ctx, t0_wall, t_merge0, "unavailable",
+                                 None, m, answered, pruned)
+        flight.record(
+            "route.unavailable", trace=trace, answered=answered,
+            total=n, contacted=m, quorum=self.quorum, missing=missing,
+            outcomes={str(i): e.outcome for i, e in errors.items()},
+        )
+        flight.auto_dump("route-unavailable")
+        return 503, {
+            "error": f"only {answered}/{m} contacted shards answered "
+                     f"(quorum {required}); failing shards: {missing}",
+            "trace_id": trace,
+            "shards": shards_block,
         }, {"Retry-After": str(int(max(self.config.breaker_reset_s, 1.0)))}
 
     # -- distributed-trace assembly ------------------------------------------

@@ -5,6 +5,16 @@
   "k": int, "degraded": null | reason}`` out. Distances are Euclidean
   (sqrt of the engines' d2, float64 — the same transform the protocol
   lines use), ids are the original point rows.
+- ``POST /v1/radius`` / ``/v1/range`` / ``/v1/count`` — the query
+  verbs (docs/SERVING.md "Query verbs"), on the k-NN stack's exactness
+  contract: all points within ``r`` of each query, all points inside
+  each axis-aligned box, or the exact cardinality of either (count
+  never materializes ids on the wire). Responses carry ``counts``
+  always; ``ids`` (+ ``distances`` for radius) for the
+  id-materializing verbs; ``truncated: true`` whenever a
+  ``recall_target``-bounded visit made the answer a SOUND LOWER BOUND
+  instead of exact. Request/response shapes live in
+  ``kdtree_tpu.verbs.wire`` (shared with the router).
 - ``POST /v1/upsert`` / ``POST /v1/delete`` — the mutable-index write
   path (docs/SERVING.md "Mutable index"): ``{"ids": [...], "points":
   [[...]]}`` / ``{"ids": [...]}`` with GLOBAL ids (this shard's
@@ -79,10 +89,12 @@ from kdtree_tpu.serve.batcher import (
 from kdtree_tpu.serve.faults import (
     SITE_HEALTHZ,
     SITE_KNN,
+    SITE_VERB,
     FaultSpecError,
     from_env,
 )
 from kdtree_tpu.serve.lifecycle import ServeState
+from kdtree_tpu.verbs import wire as verb_wire
 
 __all__ = ["GracefulHTTPServer", "JsonRequestHandler", "KnnRequestHandler",
            "KnnServer", "make_server",
@@ -474,6 +486,9 @@ class KnnRequestHandler(JsonRequestHandler):
         if path in ("/v1/upsert", "/v1/delete"):
             self._do_write("upsert" if path == "/v1/upsert" else "delete")
             return
+        if path in ("/v1/radius", "/v1/range", "/v1/count"):
+            self._do_verb(path.rsplit("/", 1)[1])
+            return
         if path != "/v1/knn":
             self._send_json(404, {"error": f"no such path: {path}"})
             return
@@ -667,6 +682,213 @@ class KnnRequestHandler(JsonRequestHandler):
             self._send_json(400, {"error": RECALL_TARGET_ERROR})
             return None
         return queries, k, deadline_s, recall_target
+
+    def _do_verb(self, endpoint: str) -> None:
+        """``POST /v1/radius`` / ``/v1/range`` / ``/v1/count``: the
+        query verbs (docs/SERVING.md "Query verbs"). The flow is the
+        k-NN flow — parse, admit, block on the request future, answer —
+        with the verb and its per-query geometry riding the
+        :class:`PendingRequest` so the batcher can group per-verb
+        micro-batches; the oversized degradation runs the brute-force
+        verb oracle right here, exactly like oversized k-NN."""
+        if self._fire_fault(SITE_VERB):
+            return
+        trace = _trace_id(self.headers)
+        import time as _time
+
+        ctx = trace_mod.adopt(self.headers, trace) \
+            if trace_mod.enabled() else None
+        root_id = trace_mod.new_span_id() if ctx is not None else ""
+        t_req0 = _time.time()
+        parsed = self._parse_verb_body(endpoint)
+        if parsed is None:
+            return  # error response already sent
+        verb, queries, radius, box_hi, deadline_s, recall_target = parsed
+        state: ServeState = self.server.state
+        if not state.ready:
+            _count_request("unready")
+            self._send_json(503, {"error": "index is still warming up"},
+                            extra_headers={"Retry-After": "1"})
+            return
+        if queries.shape[0] > state.max_batch:
+            # oversized verb request: answer via the brute-force verb
+            # oracle here (exact, flagged degraded), charging the
+            # admission budget like the oversized k-NN path — the
+            # biggest scans must be the first the 429 gate can refuse
+            try:
+                charge = self.server.queue.reserve(queries.shape[0],
+                                                   trace_id=trace)
+            except QueueFullError:
+                _count_request("shed")
+                self._send_json(429, {"error": "overloaded: admission "
+                                               "queue at capacity",
+                                      "trace_id": trace},
+                                extra_headers=self._retry_after(
+                                    queries.shape[0]))
+                return
+            except QueueClosedError:
+                _count_request("unready")
+                self._send_json(503, {"error": "server is shutting down",
+                                      "trace_id": trace})
+                return
+            obs.get_registry().counter(
+                "kdtree_serve_degraded_total", labels={"reason": "oversized"}
+            ).inc()
+            flight.record("serve.oversized", trace=trace, verb=verb,
+                          rows=int(queries.shape[0]))
+            try:
+                with_ids = not verb.startswith("count")
+                if verb in ("radius", "count_radius"):
+                    res = state.engine.fallback_radius(
+                        queries, radius, with_ids=with_ids)
+                else:
+                    res = state.engine.fallback_range(
+                        queries, box_hi, with_ids=with_ids)
+            except Exception as e:
+                _count_request("error")
+                flight.record("serve.error", trace=trace,
+                              error=repr(e)[:200])
+                flight.auto_dump("serve-error")
+                self._trace_finish(ctx, root_id, t_req0, "error", None,
+                                   int(queries.shape[0]))
+                self._send_json(500, {"error": f"engine failure: {e!r}",
+                                      "trace_id": trace})
+                return
+            finally:
+                self.server.queue.release(charge)
+            _count_request("degraded")
+            self._trace_finish(ctx, root_id, t_req0, "degraded",
+                               "oversized", int(queries.shape[0]))
+            self._send_json(200, self._verb_result_json(
+                verb, res.counts, res.d2, res.ids, bool(res.truncated),
+                degraded="oversized", trace_id=trace))
+            return
+        deadline = (_time.monotonic() + deadline_s) if deadline_s else None
+        req = PendingRequest(
+            queries, state.engine.k, deadline, trace_id=trace,
+            recall_target=recall_target,
+            trace_ctx=(trace_mod.TraceContext(ctx.trace_id, root_id,
+                                              ctx.sampled)
+                       if ctx is not None else None),
+            verb=verb, radius=radius, box_hi=box_hi,
+        )
+        try:
+            self.server.queue.submit(req)
+        except QueueFullError:
+            _count_request("shed")
+            self._send_json(429, {"error": "overloaded: admission queue "
+                                           "at capacity",
+                                  "trace_id": trace},
+                            extra_headers=self._retry_after(req.rows))
+            return
+        except QueueClosedError:
+            _count_request("unready")
+            self._send_json(503, {"error": "server is shutting down",
+                                  "trace_id": trace})
+            return
+        if not req.event.wait(timeout=state.request_timeout_s):
+            _count_request("timeout")
+            flight.record("serve.timeout", trace=trace, rows=req.rows)
+            flight.auto_dump("serve-error")
+            self._trace_finish(ctx, root_id, t_req0, "timeout", None,
+                               req.rows)
+            self._send_json(504, {"error": "request timed out in service",
+                                  "trace_id": trace})
+            return
+        if req.error is not None:
+            _count_request("error")
+            self._trace_finish(ctx, root_id, t_req0, "error", None, req.rows)
+            self._send_json(500, {"error": req.error, "trace_id": trace})
+            return
+        _count_request("degraded" if req.degraded else "ok")
+        self._trace_finish(ctx, root_id, t_req0,
+                           "degraded" if req.degraded else "ok",
+                           req.degraded, req.rows)
+        self._send_json(200, self._verb_result_json(
+            verb, req.counts, req.d2, req.ids, req.truncated,
+            degraded=req.degraded, trace_id=trace, gear=req.gear))
+
+    def _parse_verb_body(
+        self, endpoint: str,
+    ) -> Optional[Tuple[str, np.ndarray, Optional[np.ndarray],
+                        Optional[np.ndarray], Optional[float],
+                        Optional[float]]]:
+        """Validated (verb, queries|lo, r|None, hi|None, deadline
+        seconds | None, recall_target | None) for a verb endpoint, or
+        None with the 4xx already written. Geometry validation lives in
+        ``kdtree_tpu.verbs.wire`` (shared with the router); the
+        deadline/recall optionals reuse the k-NN validators so the
+        shared dials cannot drift between endpoints."""
+        state: ServeState = self.server.state
+        payload = self._read_json_object()
+        if payload is None:
+            return None
+        dim = state.engine.tree.dim
+        radius: Optional[np.ndarray] = None
+        box_hi: Optional[np.ndarray] = None
+        try:
+            if endpoint == "radius":
+                verb = "radius"
+                queries, radius = verb_wire.parse_radius_body(payload, dim)
+            elif endpoint == "range":
+                verb = "range"
+                queries, box_hi = verb_wire.parse_range_body(payload, dim)
+            else:
+                form, q_or_lo, r, lo, hi = verb_wire.parse_count_body(
+                    payload, dim)
+                if form == "radius":
+                    verb, queries, radius = "count_radius", q_or_lo, r
+                else:
+                    verb, queries, box_hi = "count_box", lo, hi
+        except verb_wire.VerbParseError as e:
+            self._send_json(400, {"error": str(e)})
+            return None
+        deadline_ms = payload.get("deadline_ms")
+        deadline_s: Optional[float] = None
+        if deadline_ms is not None:
+            if not isinstance(deadline_ms, (int, float)) or \
+                    isinstance(deadline_ms, bool) or deadline_ms <= 0:
+                self._send_json(400, {"error": "deadline_ms must be a "
+                                               "positive number"})
+                return None
+            deadline_s = float(deadline_ms) / 1e3
+        from kdtree_tpu.approx.search import (
+            RECALL_TARGET_ERROR,
+            parse_recall_target,
+        )
+
+        ok, recall_target = parse_recall_target(
+            payload.get("recall_target"))
+        if not ok:
+            self._send_json(400, {"error": RECALL_TARGET_ERROR})
+            return None
+        return verb, queries, radius, box_hi, deadline_s, recall_target
+
+    def _verb_result_json(
+        self, verb: str, counts: np.ndarray,
+        d2: Optional[np.ndarray], ids: Optional[np.ndarray],
+        truncated: bool, degraded: Optional[str], trace_id: str = "",
+        gear: Optional[str] = None,
+    ) -> dict:
+        offset = self.server.state.id_offset
+        out = {
+            "counts": np.asarray(counts).astype(np.int64).tolist(),  # kdt-lint: disable=KDT201 response materialization boundary: the verb answer becomes JSON here
+            # the soundness flag (docs/SERVING.md "Query verbs"): when a
+            # recall_target-bounded visit truncated the candidate walk,
+            # counts/ids are a LOWER BOUND on the exact answer, never a
+            # wrong answer — false on exact responses
+            "truncated": bool(truncated),
+            "degraded": degraded,
+            "trace_id": trace_id,
+        }
+        if verb == "radius" and ids is not None and d2 is not None:
+            out["ids"], out["distances"] = verb_wire.radius_rows_json(
+                d2, ids, counts, offset)
+        elif verb == "range" and ids is not None:
+            out["ids"] = verb_wire.range_rows_json(ids, counts, offset)
+        if gear is not None:
+            out["gear"] = gear
+        return out
 
     def _do_write(self, op: str) -> None:
         """``POST /v1/upsert`` / ``/v1/delete``: the mutable-index write

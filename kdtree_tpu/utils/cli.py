@@ -1371,6 +1371,11 @@ def cmd_loadgen(args) -> None:
     except ValueError as e:
         print(f"bad --recall-target: {e}", file=sys.stderr)
         sys.exit(1)
+    try:
+        verb_mix = lg_schedule.parse_verb_mix(args.verb_mix)
+    except ValueError as e:
+        print(f"bad --verb-mix: {e}", file=sys.stderr)
+        sys.exit(1)
     if round(args.slo_quantile, 4) not in (0.5, 0.95, 0.99):
         # fail BEFORE the sweep runs: the knee must be judged at a
         # quantile the steps actually report, never silently at p99
@@ -1413,7 +1418,7 @@ def cmd_loadgen(args) -> None:
             rates, args.step_seconds, args.seed, dim, mix=mix,
             regions=args.regions, zipf_s=args.zipf_s, shape=args.shape,
             diurnal_amp=args.diurnal_amp, write_base=write_base,
-            recall_mix=recall_mix,
+            recall_mix=recall_mix, verb_mix=verb_mix,
         )
     except ValueError as e:
         print(f"cannot build schedule: {e}", file=sys.stderr)
@@ -1432,7 +1437,7 @@ def cmd_loadgen(args) -> None:
         args.target, sched, k=k, slo_ms=args.slo_ms,
         slo_quantile=args.slo_quantile, max_bad_frac=args.max_bad_frac,
         max_inflight=args.max_inflight, timeout_s=args.timeout_ms / 1e3,
-        on_step=on_step,
+        on_step=on_step, verb_radius=args.verb_radius,
     )
     cap = report["capacity"]
     if args.variant:
@@ -2357,6 +2362,23 @@ def main(argv=None) -> None:
                          "curves are driven per serving gear; each "
                          "step records the gear distribution it was "
                          "answered at (default: all exact)")
+    lg.add_argument("--verb-mix", default=None, metavar="MIX",
+                    help="read-verb mix for the QUERY share of the "
+                         "schedule ('knn:0.7,radius:0.2,count:0.1'; "
+                         "verbs: knn/radius/range/count, weights "
+                         "normalized): each query arrival draws its "
+                         "verb seeded and response-blind, per-step "
+                         "rows and the capacity block gain per-verb "
+                         "latency/goodput columns and knees, and "
+                         "trend treats runs at differing mixes as "
+                         "incommensurable (default: pure knn, "
+                         "schedule byte-identical to pre-verb "
+                         "loadgen)")
+    lg.add_argument("--verb-radius", type=float, default=0.1,
+                    help="search radius (and range half-width) non-knn "
+                         "verbs carry, in the unit-cube query space — "
+                         "pins verb selectivity so runs at the same "
+                         "mix measure the same work")
     lg.add_argument("--k", type=int, default=4,
                     help="neighbors per query (clamped to the target's "
                          "k_max)")

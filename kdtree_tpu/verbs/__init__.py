@@ -1,0 +1,46 @@
+"""Query verbs beyond k-NN: exact radius, box-range, and count.
+
+Same exactness contract as the k-NN stack: tree-pruned device kernels
+(``device``) pinned byte-identical to brute-force oracles (``oracle``),
+overflow detected and retried rather than silently truncated, and
+bounded-visit answers flagged as sound lower bounds. ``wire`` holds the
+HTTP request/response contract shared by shard server and router.
+"""
+
+from kdtree_tpu.verbs.device import (
+    VerbResult,
+    canonical_radius_rows,
+    canonical_range_rows,
+    radius_search,
+    range_search,
+)
+from kdtree_tpu.verbs.oracle import (
+    radius_count_oracle,
+    radius_oracle,
+    range_count_oracle,
+    range_oracle,
+)
+from kdtree_tpu.verbs.wire import (
+    VERBS,
+    VerbParseError,
+    parse_count_body,
+    parse_radius_body,
+    parse_range_body,
+)
+
+__all__ = [
+    "VerbResult",
+    "canonical_radius_rows",
+    "canonical_range_rows",
+    "radius_search",
+    "range_search",
+    "radius_oracle",
+    "range_oracle",
+    "radius_count_oracle",
+    "range_count_oracle",
+    "VERBS",
+    "VerbParseError",
+    "parse_radius_body",
+    "parse_range_body",
+    "parse_count_body",
+]

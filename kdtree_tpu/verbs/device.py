@@ -1,0 +1,445 @@
+"""Tree-pruned device kernels for the query verbs (radius / range / count).
+
+The k-NN tile engine (ops/tile_query.py) already computes the one
+geometric fact every spatial verb needs: the exact box-to-box lower
+bound of |q - p|^2 between a tile of queries and a tree node
+(``_gathered_box_lb``), ranked lb-ascending by the level-synchronous
+frontier (``_frontier``). The verbs reuse that frontier unchanged —
+only the *bound* and the *fold* differ per verb:
+
+- **radius** (all points with d(q, p) <= r): collect every bucket whose
+  lower bound vs the tile's covering box is <= the tile's largest r^2.
+  ``lb(node, tile box) <= lb(node, q) <= d2(q, p)`` for every q in the
+  tile and p in the node, so a pruned bucket cannot contain a hit for
+  any query it covers.
+- **range** (axis-aligned box containment): the same frontier with the
+  union of the tile's query boxes as the "tile box" and bound 0 — a
+  node survives iff its box is NOT disjoint from the union box
+  (disjointness <=> lb > 0), a superset of the nodes any single query
+  box intersects.
+- **count**: either traversal with the id fold stripped — per-query
+  cardinalities only, no id buffers on the device or the wire.
+
+Exactness contract: identical to k-NN. Candidate overflow (more buckets
+pass the bound than the frontier cap holds) and hit overflow (more hits
+than the per-query result buffer holds) are both *detected* on device
+and *retried* by the host driver with doubled capacity — overflow is
+the only incompleteness signal, never silent truncation.
+
+Bounded-visit truncation (PR 14's ``visit_cap``) slices the
+lb-ascending candidate list exactly like the k-NN path does. A
+visited-prefix answer is a SUBSET of the true hit set for every query
+in the tile, so a truncated count / radius set is a sound LOWER BOUND —
+the verbs' analog of the k-NN recall contract (flagged through the same
+``gear``/``recall_target`` plumbing by the serving layer).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kdtree_tpu.ops.morton import MortonTree, default_bits
+from kdtree_tpu.ops.tile_query import _frontier, _sort_queries
+from kdtree_tpu.tuning.store import _pow2_ceil
+
+DEFAULT_TILE = 64  # queries per tile: verbs carry per-query bounds, so
+# smaller tiles keep the tile-box over-approximation (max r^2 / union
+# box) tight; pow2 like the k-NN tiles
+DEFAULT_CAP = 64  # candidate buckets per tile (doubles on frontier overflow)
+DEFAULT_HITS = 128  # per-query hit-buffer lanes (doubles on hit overflow)
+_SCAN_V = 4  # buckets folded per scan chunk (v * bucket_size distance lanes)
+_MAX_Q = 1 << 15  # queries per device program; larger sets stream in slices
+
+# the int32 "no hit" sentinel for the range fold: real gids are < 2^31
+# (guarded at build), so the sentinel always sorts last
+_ID_INF = np.int32(2**31 - 1)
+
+
+class VerbResult(NamedTuple):
+    """One verb answer over a query batch, host-materialized.
+
+    ``counts`` is exact (or a sound lower bound when ``truncated``).
+    ``d2``/``ids`` are None for count-only calls; otherwise rows are
+    canonically (d2, id)-ascending for radius and id-ascending for
+    range, padded to the common width with (+inf, -1).
+    """
+
+    counts: np.ndarray  # i64[Q]
+    d2: Optional[np.ndarray]  # f32[Q, m] | None
+    ids: Optional[np.ndarray]  # i32[Q, m] | None
+    truncated: bool  # visit_cap actually cut a tile's candidate list
+    retries: int  # overflow-retry doublings the driver paid
+
+
+def canonical_radius_rows(d2: np.ndarray, ids: np.ndarray):
+    """Row-wise canonical (d2, id)-ascending order, (+inf, -1) padding
+    last — the byte-identity normal form shared by the device driver,
+    the brute-force oracle, and the router's dedup-union merge. Two
+    stable argsorts compose into a lexsort (secondary key first)."""
+    d2 = np.where(ids < 0, np.inf, d2)
+    by_id = np.argsort(np.where(ids < 0, _ID_INF, ids), axis=1,
+                       kind="stable")
+    d2 = np.take_along_axis(d2, by_id, axis=1)
+    ids = np.take_along_axis(ids, by_id, axis=1)
+    by_d = np.argsort(d2, axis=1, kind="stable")
+    return (np.take_along_axis(d2, by_d, axis=1),
+            np.take_along_axis(ids, by_d, axis=1))
+
+
+def canonical_range_rows(ids: np.ndarray) -> np.ndarray:
+    """Row-wise id-ascending order with -1 padding last — the range
+    verb's normal form (containment has no distances)."""
+    ids = np.sort(np.where(ids < 0, _ID_INF, ids), axis=1, kind="stable")
+    return np.where(ids == _ID_INF, -1, ids)
+
+
+def merge_results(kind: str, a: VerbResult, b: VerbResult) -> VerbResult:
+    """Row-wise union of two :class:`VerbResult`\\ s over the same query
+    batch whose underlying point sets are DISJOINT (the mutable overlay:
+    masked main storage vs the delta buffer) — counts add, id rows
+    concatenate and re-canonicalize. ``kind`` is "radius" or "range"."""
+    counts = a.counts + b.counts
+    truncated = a.truncated or b.truncated
+    retries = a.retries + b.retries
+    if a.ids is None:
+        return VerbResult(counts, None, None, truncated, retries)
+    ids = np.concatenate([a.ids, b.ids], axis=1)
+    if kind == "radius":
+        d2 = np.concatenate([a.d2, b.d2], axis=1)
+        d2, ids = canonical_radius_rows(d2, ids)
+        return VerbResult(counts, d2, ids, truncated, retries)
+    return VerbResult(counts, None, canonical_range_rows(ids),
+                      truncated, retries)
+
+
+def trim_result(res: VerbResult) -> VerbResult:
+    """Drop all-padding trailing columns (rows stay canonical — padding
+    sorts last) so overlay-widened buffers leave at hit width."""
+    if res.ids is None:
+        return res
+    m = max(int(res.counts.max(initial=0)), 1)
+    if m >= res.ids.shape[1]:
+        return res
+    return VerbResult(res.counts,
+                      None if res.d2 is None else res.d2[:, :m],
+                      res.ids[:, :m], res.truncated, res.retries)
+
+
+def _chunked(cand, cand_lb, v: int):
+    """Pad the candidate list to a multiple of ``v`` and expose it as
+    scan chunks [C//v, T, v] (+lb of each chunk's first, unused here but
+    kept shape-compatible with the k-NN scan)."""
+    T, C = cand.shape
+    cpad = (-C) % v
+    if cpad:
+        cand = jnp.concatenate(
+            [cand, jnp.full((T, cpad), -1, jnp.int32)], axis=1)
+        C += cpad
+    return jnp.swapaxes(cand.reshape(T, C // v, v), 0, 1)
+
+
+def _gather_chunk(tree, cb):
+    """One chunk's flattened bucket points + masked gids:
+    cb i32[T, v] -> (pts f32[T, v*B, D], gids i32[T, v*B])."""
+    B = tree.bucket_size
+    sel = jnp.maximum(cb, 0)
+    pts = tree.bucket_pts[sel]  # [T, v, B, D]
+    gids = jnp.where((cb >= 0)[:, :, None], tree.bucket_gid[sel], -1)
+    T, v = cb.shape
+    return pts.reshape(T, v * B, -1), gids.reshape(T, v * B)
+
+
+def _truncate(cand, cand_lb, visit_cap):
+    """Slice the lb-ascending candidate list to ``visit_cap`` (the exact
+    analog of the k-NN bounded-visit slice) and report, per tile,
+    whether anything finite was actually cut."""
+    if visit_cap is None or visit_cap >= cand.shape[1]:
+        return cand, cand_lb, jnp.zeros(cand.shape[0], bool)
+    cut = jnp.sum(jnp.isfinite(cand_lb), axis=1) > visit_cap
+    return cand[:, :visit_cap], cand_lb[:, :visit_cap], cut
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "m", "visit_cap", "count_only", "v"),
+)
+def _radius_tiles(tree, tq, r2, cap: int, m: int,
+                  visit_cap: int | None, count_only: bool, v: int):
+    """Radius over tiles: tq f32[T, TQ, D], r2 f32[T, TQ] (negative =
+    padding row, never hits). Returns (counts i32[T, TQ], best_d
+    f32[T, TQ, m], best_i i32[T, TQ, m], frontier overflow any,
+    hit overflow any, truncated any)."""
+    T, TQ, D = tq.shape
+    box_lo = jnp.min(tq, axis=1)
+    box_hi = jnp.max(tq, axis=1)
+    bound = jnp.max(r2, axis=1)  # covers every query the tile holds
+    cand, cand_lb, overflow = _frontier(tree, box_lo, box_hi, bound, cap)
+    cand, cand_lb, cut = _truncate(cand, cand_lb, visit_cap)
+    chunks = _chunked(cand, cand_lb, v)
+
+    def step(carry, cb):
+        counts, best_d, best_i = carry
+        pts, gids = _gather_chunk(tree, cb)
+        diff = tq[:, :, None, :] - pts[:, None, :, :]
+        d2 = jnp.sum(diff * diff, axis=-1)  # [T, TQ, v*B]
+        hit = (gids[:, None, :] >= 0) & (d2 <= r2[:, :, None])
+        counts = counts + jnp.sum(hit, axis=-1, dtype=jnp.int32)
+        if not count_only:
+            key = jnp.where(hit, d2, jnp.inf)
+            all_d = jnp.concatenate([best_d, key], axis=-1)
+            all_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(gids[:, None, :], key.shape)],
+                axis=-1)
+            neg, sel = lax.top_k(-all_d, m)
+            best_d = -neg
+            best_i = jnp.take_along_axis(all_i, sel, axis=-1)
+        return (counts, best_d, best_i), None
+
+    width = 0 if count_only else m
+    init = (
+        jnp.zeros((T, TQ), jnp.int32),
+        jnp.full((T, TQ, width), jnp.inf, jnp.float32),
+        jnp.full((T, TQ, width), -1, jnp.int32),
+    )
+    (counts, best_d, best_i), _ = lax.scan(step, init, chunks)
+    best_i = jnp.where(jnp.isfinite(best_d), best_i, -1)
+    return (counts, best_d, best_i, jnp.any(overflow), jnp.any(cut))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cap", "m", "visit_cap", "count_only", "v"),
+)
+def _range_tiles(tree, qlo, qhi, cap: int, m: int,
+                 visit_cap: int | None, count_only: bool, v: int):
+    """Box containment over tiles: qlo/qhi f32[T, TQ, D] per-query
+    boxes (padding rows carry the empty box lo=+inf/hi=-inf). The tile
+    box is the UNION of its query boxes; bound 0 keeps exactly the
+    nodes not disjoint from it."""
+    T, TQ, D = qlo.shape
+    box_lo = jnp.min(qlo, axis=1)
+    box_hi = jnp.max(qhi, axis=1)
+    bound = jnp.zeros(T, jnp.float32)
+    cand, cand_lb, overflow = _frontier(tree, box_lo, box_hi, bound, cap)
+    cand, cand_lb, cut = _truncate(cand, cand_lb, visit_cap)
+    chunks = _chunked(cand, cand_lb, v)
+
+    def step(carry, cb):
+        counts, best_i = carry
+        pts, gids = _gather_chunk(tree, cb)
+        hit = gids[:, None, :] >= 0  # [T, TQ, v*B] after broadcast
+        hit = jnp.broadcast_to(hit, (T, TQ, pts.shape[1]))
+        # per-axis containment, gathered one axis at a time like
+        # _gathered_box_lb (no [T, TQ, W, D] intermediate)
+        for d in range(D):
+            pd = pts[:, None, :, d]
+            hit = hit & (pd >= qlo[:, :, d:d + 1]) & \
+                (pd <= qhi[:, :, d:d + 1])
+        counts = counts + jnp.sum(hit, axis=-1, dtype=jnp.int32)
+        if not count_only:
+            key = jnp.where(hit, jnp.broadcast_to(gids[:, None, :],
+                                                  hit.shape), _ID_INF)
+            all_i = jnp.concatenate([best_i, key], axis=-1)
+            neg, _ = lax.top_k(-all_i, m)
+            best_i = -neg  # the m SMALLEST ids, ascending
+        return (counts, best_i), None
+
+    width = 0 if count_only else m
+    init = (
+        jnp.zeros((T, TQ), jnp.int32),
+        jnp.full((T, TQ, width), _ID_INF, jnp.int32),
+    )
+    (counts, best_i), _ = lax.scan(step, init, chunks)
+    best_i = jnp.where(best_i == _ID_INF, -1, best_i)
+    return counts, best_i, jnp.any(overflow), jnp.any(cut)
+
+
+def _tile_for(q: int, tile: int | None) -> int:
+    t = DEFAULT_TILE if tile is None else int(tile)
+    return max(1, min(_pow2_ceil(t), _pow2_ceil(max(q, 1))))
+
+
+def _cap_ceiling(tree) -> int:
+    return _pow2_ceil(tree.num_buckets)
+
+
+def _slices(q: int):
+    for s in range(0, q, _MAX_Q):
+        yield s, min(s + _MAX_Q, q)
+
+
+def radius_search(
+    tree: MortonTree,
+    queries,
+    r,
+    *,
+    visit_cap: int | None = None,
+    with_ids: bool = True,
+    tile: int | None = None,
+    cap: int | None = None,
+    max_hits: int | None = None,
+) -> VerbResult:
+    """All points within Euclidean distance ``r`` of each query
+    (inclusive: d2 <= r^2 in f32, the same arithmetic the oracle uses).
+
+    ``r`` is a scalar or per-query [Q] array. ``with_ids=False`` is the
+    count verb: per-query cardinalities only, no id buffers anywhere.
+    ``visit_cap`` truncates the lb-ascending candidate list per tile —
+    the answer is then a flagged lower bound (``truncated``).
+    """
+    queries = np.asarray(queries, dtype=np.float32)  # kdt-lint: disable=KDT201 verb API boundary: normalizes caller-provided host rows (HTTP JSON, oracles)
+    Q, D = queries.shape
+    r = np.broadcast_to(np.asarray(r, dtype=np.float32), (Q,))  # kdt-lint: disable=KDT201 verb API boundary: r is a host scalar or per-query host list
+    r2 = (r * r).astype(np.float32)
+
+    parts = [
+        _radius_slice(tree, queries[s:e], r2[s:e], visit_cap, with_ids,
+                      tile, cap, max_hits)
+        for s, e in _slices(Q)
+    ]
+    return _concat_results(parts, with_dists=with_ids)
+
+
+def _radius_slice(tree, queries, r2, visit_cap, with_ids, tile, cap,
+                  max_hits) -> VerbResult:
+    Q, D = queries.shape
+    t = _tile_for(Q, tile)
+    qpad = (-Q) % t
+    sq, order = _sort_queries(jnp.asarray(queries), default_bits(D), qpad)
+    # padding duplicates the last query; a NEGATIVE r2 makes those rows
+    # hit nothing (d2 <= r2 < 0 is impossible)
+    r2p = np.concatenate([r2, np.full(qpad, -1.0, np.float32)])
+    order_h = np.asarray(order)  # kdt-lint: disable=KDT201 one [Q]-sized permutation fetch per verb call, amortized over the whole batch
+    r2s = jnp.asarray(r2p[order_h]).reshape(-1, t)
+    tq = sq.reshape(-1, t, D)
+
+    c = min(DEFAULT_CAP if cap is None else _pow2_ceil(int(cap)),
+            _cap_ceiling(tree))
+    m = _pow2_ceil(DEFAULT_HITS if max_hits is None else int(max_hits))
+    retries = 0
+    while True:
+        counts, bd, bi, ovf, cut = _radius_tiles(
+            tree, tq, r2s, c, m if with_ids else 0, visit_cap,
+            not with_ids, _SCAN_V)
+        counts_h = np.asarray(counts).reshape(-1)  # kdt-lint: disable=KDT201 driver boundary: per-query counts decide the overflow retry and ARE the count verb's answer
+        if visit_cap is None and bool(ovf) and c < _cap_ceiling(tree):  # kdt-lint: disable=KDT201 driver-level overflow flag fetch, the retry contract's only signal
+            c = min(c * 2, _cap_ceiling(tree))
+            retries += 1
+            continue
+        if with_ids and int(counts_h.max(initial=0)) > m:  # kdt-lint: disable=KDT201 retry sizing over the already-fetched host counts
+            # counts are exact regardless of m, so ONE retry sized to
+            # the measured maximum always suffices
+            m = _pow2_ceil(int(counts_h.max()))  # kdt-lint: disable=KDT201 retry sizing over the already-fetched host counts
+            retries += 1
+            continue
+        break
+    truncated = bool(cut)  # kdt-lint: disable=KDT201 one scalar truncation flag per verb call, rides the response contract
+    counts_out = np.zeros(Q + qpad, np.int64)
+    counts_out[order_h] = counts_h
+    if not with_ids:
+        return VerbResult(counts_out[:Q], None, None, truncated, retries)
+    d2s = np.asarray(bd).reshape(len(order_h), -1)  # kdt-lint: disable=KDT201 response boundary: radius hits are host-materialized to answer the caller
+    idss = np.asarray(bi).reshape(len(order_h), -1)  # kdt-lint: disable=KDT201 response boundary: radius hits are host-materialized to answer the caller
+    d2_out = np.empty_like(d2s)
+    ids_out = np.empty_like(idss)
+    d2_out[order_h] = d2s
+    ids_out[order_h] = idss
+    d2c, idc = canonical_radius_rows(d2_out[:Q], ids_out[:Q])
+    return VerbResult(counts_out[:Q], d2c, idc, truncated, retries)
+
+
+def range_search(
+    tree: MortonTree,
+    box_lo,
+    box_hi,
+    *,
+    visit_cap: int | None = None,
+    with_ids: bool = True,
+    tile: int | None = None,
+    cap: int | None = None,
+    max_hits: int | None = None,
+) -> VerbResult:
+    """All points inside each axis-aligned box [box_lo, box_hi]
+    (inclusive on both faces). Boxes where lo > hi on any axis are
+    legitimately empty. Returns ids ascending per query (containment
+    has no distances); ``with_ids=False`` is the count form."""
+    box_lo = np.asarray(box_lo, dtype=np.float32)  # kdt-lint: disable=KDT201 verb API boundary: normalizes caller-provided host rows (HTTP JSON, oracles)
+    box_hi = np.asarray(box_hi, dtype=np.float32)  # kdt-lint: disable=KDT201 verb API boundary: normalizes caller-provided host rows (HTTP JSON, oracles)
+    Q, D = box_lo.shape
+    parts = [
+        _range_slice(tree, box_lo[s:e], box_hi[s:e], visit_cap, with_ids,
+                     tile, cap, max_hits)
+        for s, e in _slices(Q)
+    ]
+    return _concat_results(parts, with_dists=False)
+
+
+def _range_slice(tree, box_lo, box_hi, visit_cap, with_ids, tile, cap,
+                 max_hits) -> VerbResult:
+    Q, D = box_lo.shape
+    t = _tile_for(Q, tile)
+    qpad = (-Q) % t
+    if qpad:
+        # pad with the EMPTY box: +inf lo / -inf hi contains nothing and
+        # cannot widen the tile's union box
+        box_lo = np.concatenate(
+            [box_lo, np.full((qpad, D), np.inf, np.float32)])
+        box_hi = np.concatenate(
+            [box_hi, np.full((qpad, D), -np.inf, np.float32)])
+    qlo = jnp.asarray(box_lo).reshape(-1, t, D)
+    qhi = jnp.asarray(box_hi).reshape(-1, t, D)
+
+    c = min(DEFAULT_CAP if cap is None else _pow2_ceil(int(cap)),
+            _cap_ceiling(tree))
+    m = _pow2_ceil(DEFAULT_HITS if max_hits is None else int(max_hits))
+    retries = 0
+    while True:
+        out = _range_tiles(tree, qlo, qhi, c, m if with_ids else 0,
+                           visit_cap, not with_ids, _SCAN_V)
+        counts, bi, ovf, cut = out
+        counts_h = np.asarray(counts).reshape(-1)  # kdt-lint: disable=KDT201 driver boundary: per-query counts decide the overflow retry and ARE the count verb's answer
+        if visit_cap is None and bool(ovf) and c < _cap_ceiling(tree):  # kdt-lint: disable=KDT201 driver-level overflow flag fetch, the retry contract's only signal
+            c = min(c * 2, _cap_ceiling(tree))
+            retries += 1
+            continue
+        if with_ids and int(counts_h.max(initial=0)) > m:  # kdt-lint: disable=KDT201 retry sizing over the already-fetched host counts
+            m = _pow2_ceil(int(counts_h.max()))  # kdt-lint: disable=KDT201 retry sizing over the already-fetched host counts
+            retries += 1
+            continue
+        break
+    truncated = bool(cut)  # kdt-lint: disable=KDT201 one scalar truncation flag per verb call, rides the response contract
+    counts_out = counts_h[:Q].astype(np.int64)
+    if not with_ids:
+        return VerbResult(counts_out, None, None, truncated, retries)
+    ids = np.asarray(bi).reshape(len(counts_h), -1)[:Q]  # kdt-lint: disable=KDT201 response boundary: range hits are host-materialized to answer the caller
+    return VerbResult(counts_out, None, canonical_range_rows(ids),
+                      truncated, retries)
+
+
+def _concat_results(parts, with_dists: bool) -> VerbResult:
+    if len(parts) == 1:
+        return parts[0]
+    counts = np.concatenate([p.counts for p in parts])
+    truncated = any(p.truncated for p in parts)
+    retries = sum(p.retries for p in parts)
+    if parts[0].ids is None:
+        return VerbResult(counts, None, None, truncated, retries)
+    m = max(p.ids.shape[1] for p in parts)
+
+    def widen(a, fill, dtype):
+        return np.concatenate([
+            np.concatenate([x, np.full((x.shape[0], m - x.shape[1]),
+                                       fill, dtype)], axis=1)
+            for x in a
+        ])
+
+    ids = widen([p.ids for p in parts], -1, np.int32)
+    d2 = (widen([p.d2 for p in parts], np.inf, np.float32)
+          if with_dists else None)
+    return VerbResult(counts, d2, ids, truncated, retries)

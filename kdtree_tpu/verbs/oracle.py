@@ -1,0 +1,126 @@
+"""Brute-force oracles for the query verbs.
+
+These are the exactness referees: every verb answer — from the device
+kernels, through MutableEngine overlays, through the multi-shard router
+merge — must be byte-identical (counts: exactly equal) to the oracle
+over the same point set. To make byte-identity achievable rather than
+aspirational, the oracle computes squared distances with the SAME f32
+arithmetic as the device fold (``_block_d2_exact``: diff then
+sum-of-squares, f32 throughout) and normalizes rows to the same
+canonical forms (``canonical_radius_rows`` / ``canonical_range_rows``).
+
+Oracles accept the flat padded storage the serving engines already
+hold (+inf padding rows, gid -1) — padding and tombstone holes
+self-exclude via the gid mask, never via distance screening.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kdtree_tpu.ops.bruteforce import _block_d2_exact
+
+# JITTED, like the k-NN oracle's scan: under jit, XLA:CPU fuses the
+# diff/square/reduce chain and LLVM may contract mul+add into fma —
+# the device fold compiles the same way, so the jitted panel is
+# bit-identical to it, while an EAGER _block_d2_exact (one kernel per
+# op, no cross-op contraction) can differ by 1 ulp. The byte-identity
+# contract is defined over the jitted arithmetic.
+_jit_block_d2 = jax.jit(_block_d2_exact)
+from kdtree_tpu.verbs.device import (
+    VerbResult,
+    canonical_radius_rows,
+    canonical_range_rows,
+)
+
+_ORACLE_TILE = 1 << 13  # points per distance block (bounds the [Q, N] panel)
+
+
+def _gid_mask(points: np.ndarray, gid) -> np.ndarray:
+    if gid is None:
+        return np.arange(points.shape[0], dtype=np.int32)
+    return np.asarray(gid, dtype=np.int32)  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+
+
+def _pad_rows(rows, fill, dtype):
+    m = max((len(r) for r in rows), default=0)
+    m = max(m, 1)
+    out = np.full((len(rows), m), fill, dtype)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return out
+
+
+def radius_oracle(points, queries, r, *, gid=None,
+                  with_ids: bool = True) -> VerbResult:
+    """Exhaustive radius answer: every live point with d2 <= r^2 in
+    f32, using the device fold's exact distance arithmetic."""
+    points = np.asarray(points, dtype=np.float32)  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+    queries = np.asarray(queries, dtype=np.float32)  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+    Q = queries.shape[0]
+    gid = _gid_mask(points, gid)
+    r = np.broadcast_to(np.asarray(r, dtype=np.float32), (Q,))  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+    r2 = (r * r).astype(np.float32)
+
+    counts = np.zeros(Q, np.int64)
+    rows_d = [[] for _ in range(Q)] if with_ids else None
+    rows_i = [[] for _ in range(Q)] if with_ids else None
+    qd = jnp.asarray(queries)
+    for s in range(0, points.shape[0], _ORACLE_TILE):
+        e = min(s + _ORACLE_TILE, points.shape[0])
+        d2 = np.asarray(_jit_block_d2(qd, jnp.asarray(points[s:e])))  # kdt-lint: disable=KDT201 oracle is a host-side referee by definition
+        live = gid[s:e] >= 0
+        hit = (d2 <= r2[:, None]) & live[None, :]
+        counts += hit.sum(axis=1)
+        if with_ids:
+            for q in range(Q):
+                idx = np.nonzero(hit[q])[0]
+                rows_d[q].append(d2[q, idx])
+                rows_i[q].append(gid[s:e][idx])
+    if not with_ids:
+        return VerbResult(counts, None, None, False, 0)
+    d2p = _pad_rows([np.concatenate(r) for r in rows_d], np.inf,
+                    np.float32)
+    idp = _pad_rows([np.concatenate(r) for r in rows_i], -1, np.int32)
+    d2c, idc = canonical_radius_rows(d2p, idp)
+    return VerbResult(counts, d2c, idc, False, 0)
+
+
+def range_oracle(points, box_lo, box_hi, *, gid=None,
+                 with_ids: bool = True) -> VerbResult:
+    """Exhaustive box-containment answer (inclusive faces). Pure f32
+    comparisons — no arithmetic, so exactness is trivial."""
+    points = np.asarray(points, dtype=np.float32)  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+    box_lo = np.asarray(box_lo, dtype=np.float32)  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+    box_hi = np.asarray(box_hi, dtype=np.float32)  # kdt-lint: disable=KDT201 oracle reference code: host brute force by definition
+    Q = box_lo.shape[0]
+    gid = _gid_mask(points, gid)
+    live = gid >= 0
+
+    counts = np.zeros(Q, np.int64)
+    rows = [] if with_ids else None
+    for q in range(Q):
+        inside = live.copy()
+        for d in range(points.shape[1]):
+            inside &= (points[:, d] >= box_lo[q, d]) & \
+                (points[:, d] <= box_hi[q, d])
+        idx = np.nonzero(inside)[0]
+        counts[q] = idx.size
+        if with_ids:
+            rows.append(gid[idx])
+    if not with_ids:
+        return VerbResult(counts, None, None, False, 0)
+    idp = _pad_rows(rows, -1, np.int32)
+    return VerbResult(counts, None, canonical_range_rows(idp), False, 0)
+
+
+def radius_count_oracle(points, queries, r, *, gid=None) -> np.ndarray:
+    return radius_oracle(points, queries, r, gid=gid,
+                         with_ids=False).counts
+
+
+def range_count_oracle(points, box_lo, box_hi, *, gid=None) -> np.ndarray:
+    return range_oracle(points, box_lo, box_hi, gid=gid,
+                        with_ids=False).counts

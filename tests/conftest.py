@@ -78,6 +78,31 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.mesh)
 
 
+# Every compiled XLA executable holds ~9 anonymous mappings in the CPU
+# client; a full tier-1 run accumulates tens of thousands against the
+# kernel's vm.max_map_count ceiling (65530 default). Past the ceiling
+# mmap fails and XLA SEGFAULTS mid-compile — observed twice at the
+# suite's alphabetical tail once the verb kernels pushed the total
+# over. Clear the jit caches when we get close; the handful of tests
+# that recompile afterwards cost seconds, the crash cost the suite.
+_MAP_GUARD_THRESHOLD = 52000
+
+
+@pytest.fixture(autouse=True)
+def _jit_cache_map_guard():
+    import gc
+
+    try:
+        with open("/proc/self/maps") as f:
+            n_maps = sum(1 for _ in f)
+    except OSError:  # no procfs (darwin) — the ceiling is linux-only
+        n_maps = 0
+    if n_maps > _MAP_GUARD_THRESHOLD:
+        jax.clear_caches()
+        gc.collect()
+    yield
+
+
 @pytest.fixture(autouse=True)
 def _isolate_flight_dump_rate_limit():
     """The process-wide flight recorder rate-limits auto-dumps per

@@ -89,3 +89,49 @@ def test_ensemble_gen_device_count_invariance(mesh8):
     ]
     for o in outs[1:]:
         np.testing.assert_allclose(o, outs[0], rtol=1e-6)
+
+
+def test_fused_jit_gate_matches_version_probe():
+    """_FUSED_JIT_SAFE is exactly the first-class-shard_map probe: the
+    0.4.x experimental-era SPMD partitioner miscompiles the fused
+    build+query shard_map under an outer jit (ensemble.py's caveat), so
+    legacy jax must run it eagerly and modern jax must not pay the
+    op-by-op prelude."""
+    import jax
+
+    from kdtree_tpu.parallel import ensemble
+
+    assert ensemble._FUSED_JIT_SAFE == hasattr(jax, "shard_map")
+
+
+@pytest.mark.skipif(
+    not hasattr(__import__("jax"), "shard_map"),
+    reason="legacy jax (experimental shard_map): the fused-jit path is "
+           "known to miscompile and is gated off — this pin un-skips "
+           "the day the toolchain carries first-class jax.shard_map",
+)
+def test_fused_jit_path_exact_on_first_class_shard_map(mesh8):
+    """On a jax with first-class shard_map the fused JITTED ensemble
+    must be byte-identical to the eager run and exact vs brute force —
+    the precise miscompilation signature that forced the legacy gate
+    (wrong per-shard answers under an outer jit) must be gone."""
+    from kdtree_tpu.models.tree import tree_spec
+    from kdtree_tpu.ops.build import spec_arrays
+    from kdtree_tpu.parallel import ensemble
+
+    assert ensemble._FUSED_JIT_SAFE is True
+    pts, qs = generate_problem(seed=5, dim=3, num_points=512,
+                               num_queries=10)
+    p = mesh8.shape[ensemble.SHARD_AXIS]
+    n_local = (512 + p - 1) // p
+    structure = spec_arrays(n_local, 3)
+    num_levels = tree_spec(n_local).num_levels
+    jd2, jidx = ensemble._ensemble_jit(
+        pts, qs, structure, 3, mesh8, float("inf"), num_levels)
+    ed2, eidx = ensemble._ensemble_impl(
+        pts, qs, structure, 3, mesh8, float("inf"), num_levels)
+    np.testing.assert_array_equal(np.asarray(jd2), np.asarray(ed2))
+    np.testing.assert_array_equal(np.asarray(jidx), np.asarray(eidx))
+    bf_d2, _ = bruteforce.knn_exact_d2(pts, qs, k=3)
+    np.testing.assert_allclose(np.asarray(jd2), np.asarray(bf_d2),
+                               rtol=1e-6)

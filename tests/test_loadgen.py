@@ -748,3 +748,133 @@ def test_cli_rejects_garbage_ab_baseline(tmp_path, capsys):
                   "--rates", "10", "--ab-baseline", str(missing)])
     assert e.value.code == 1
     assert "cannot read --ab-baseline" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the verb mix (ISSUE 19 satellite: docs/SERVING.md "Query verbs" —
+# radius/range/count drawn per arrival, per-verb capacity columns)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_verb_mix_forms_and_validation():
+    from kdtree_tpu.loadgen.schedule import parse_verb_mix
+
+    assert parse_verb_mix(None) is None
+    assert parse_verb_mix("") is None
+    mix = parse_verb_mix("knn:0.7,radius:0.2,count:0.1")
+    assert [v for v, _ in mix] == ["knn", "radius", "count"]
+    assert abs(sum(w for _, w in mix) - 1.0) < 1e-9
+    for bad in ("upsert:1", "radius", "radius:x", "radius:-1", "knn:0"):
+        with pytest.raises(ValueError):
+            parse_verb_mix(bad)
+
+
+def test_verb_mix_is_seeded_and_preserves_schedule_identity():
+    """The draw is seeded and response-blind, rides only on queries, and
+    happens ONLY when a mix is configured — an unmixed schedule stays
+    byte-identical to what pre-verb loadgen built from the same seed."""
+    from kdtree_tpu.loadgen.schedule import parse_verb_mix
+
+    mix = parse_verb_mix("knn:0.5,radius:0.3,count:0.2")
+    a = build_schedule([200], 1.0, 3, 3, verb_mix=mix)
+    b = build_schedule([200], 1.0, 3, 3, verb_mix=mix)
+    assert a.keys() == b.keys()
+    verbs = Counter(ar.verb for ar in a.arrivals if ar.op == "query")
+    assert set(verbs) == {"knn", "radius", "count"}
+    for ar in a.arrivals:
+        if ar.op != "query":
+            assert ar.verb == "knn"  # writes never draw a verb
+    # no mix -> no extra rng draw: identical to a pre-verb schedule
+    assert build_schedule([200], 1.0, 3, 3).keys() \
+        == build_schedule([200], 1.0, 3, 3, verb_mix=None).keys()
+    desc = a.describe()
+    assert desc["verbs"] == dict(verbs)
+    assert [v for v, _ in desc["verb_mix"]] == ["knn", "radius", "count"]
+
+
+def test_runner_routes_verbs_and_reports_per_verb_columns():
+    """A mixed run hits the right endpoints with the configured radius,
+    and the capacity block gains per-step per-verb columns plus a
+    per-verb knee; an unmixed run's artifact carries neither key."""
+    from kdtree_tpu.loadgen.schedule import parse_verb_mix
+
+    seen = []
+
+    class VerbStub(_StubHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            seen.append((self.path, payload))
+            if self.path == "/v1/knn":
+                self._answer(200, {"ids": [[0]], "distances": [[0.0]],
+                                   "degraded": None})
+            elif self.path in ("/v1/radius", "/v1/range", "/v1/count"):
+                out = {"counts": [1], "truncated": False,
+                       "degraded": None}
+                if self.path != "/v1/count":
+                    out["ids"] = [[0]]
+                self._answer(200, out)
+            else:
+                self._answer(200, {"applied": 1})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), VerbStub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    target = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        sched = build_schedule(
+            [120], 1.0, 5, 3, mix=MixSpec(1, 0, 0),
+            verb_mix=parse_verb_mix("knn:0.4,radius:0.3,count:0.3"))
+        rep = lg_runner.run_load(target, sched, scrape=False,
+                                 verb_radius=0.05)
+        paths = Counter(p for p, _ in seen)
+        assert paths["/v1/radius"] > 0 and paths["/v1/count"] > 0
+        for p, payload in seen:
+            if p in ("/v1/radius", "/v1/count"):
+                assert payload["r"] == 0.05
+        step = rep["capacity"]["steps"][0]
+        assert set(step["verbs"]) == {"knn", "radius", "count"}
+        assert sum(v["sent"] for v in step["verbs"].values()) \
+            == step["ok"] + step["errors"] + step["timeouts"] \
+            + step["shed"]
+        for col in step["verbs"].values():
+            assert {"sent", "ok", "goodput_rps", "bad_frac",
+                    "p50_ms", "p95_ms", "p99_ms"} <= set(col)
+        knees = rep["capacity"]["verbs"]
+        assert set(knees) == {"knn", "radius", "count"}
+        for verb in knees:
+            assert knees[verb]["knee_rate"] == 120.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # unmixed: the artifact stays byte-compatible with pre-verb loadgen
+    httpd, target = _stub_server()
+    try:
+        sched = build_schedule([30], 1.0, 5, 3, mix=MixSpec(1, 0, 0))
+        rep = lg_runner.run_load(target, sched, scrape=False)
+        assert "verbs" not in rep["capacity"]
+        assert "verbs" not in rep["capacity"]["steps"][0]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_trend_treats_changed_verb_mix_as_incommensurable():
+    """The knee comparison must not cross a changed verb mix — same
+    machinery as the gear gate: mixed-vs-mixed compares, changed mix
+    does not mint a capacity-drop."""
+    from kdtree_tpu.obs.trend import _capacity_facts
+
+    def cap(verbs):
+        steps = [{"rate": 100.0, "p99_ms": 5.0, "goodput_rps": 90.0}]
+        if verbs is not None:
+            steps[0]["verbs"] = {v: {"sent": 1} for v in verbs}
+        return {"capacity_version": 1, "knee_rate": 100.0,
+                "steps": steps}
+
+    plain = _capacity_facts(cap(None))
+    mixed = _capacity_facts(cap(["knn", "radius", "count"]))
+    assert plain["verbs"] is None
+    assert mixed["verbs"] == ["count", "knn", "radius"]
+    # identical mixes stay comparable
+    assert _capacity_facts(
+        cap(["knn", "radius", "count"]))["verbs"] == mixed["verbs"]

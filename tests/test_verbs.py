@@ -1,0 +1,541 @@
+"""Query-verb subsystem (docs/SERVING.md "Query verbs"): exactness.
+
+The contract under test is the verbs' extension of the k-NN stack's
+exactness rule: radius, range, and count answers are byte-identical to
+the brute-force oracle at every layer — the device kernels, the mutable
+write overlay, the live server endpoints, and the multi-shard router's
+merge under selective fan-out — and a visit-capped answer is a FLAGGED,
+sound lower bound (a subset of the truth, never a superset).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kdtree_tpu import verbs
+from kdtree_tpu.serve import lifecycle, server as srv
+from kdtree_tpu.verbs import oracle as vo
+from kdtree_tpu.verbs.device import trim_result
+
+DIM, N, K = 3, 4096, 4
+SEED = 7
+
+
+def _assert_same(res, ora):
+    """Byte-identity over the VALID hit rows: counts, ids, distances.
+    Buffers are trimmed first — the device result's hit buffer is a
+    pow2 width, the oracle's is the max count, and the contract (what
+    the server serializes) is the per-row valid prefix, which trimming
+    makes directly comparable including the padding convention."""
+    res, ora = trim_result(res), trim_result(ora)
+    assert np.array_equal(res.counts, ora.counts)
+    if ora.ids is not None:
+        assert np.array_equal(res.ids, ora.ids)
+    if ora.d2 is not None:
+        assert np.array_equal(res.d2, ora.d2)
+
+
+def _tree_and_points(seed, dim, n):
+    from kdtree_tpu.ops.generate import generate_points_rowwise
+    from kdtree_tpu.ops.morton import build_morton
+
+    raw = generate_points_rowwise(seed, dim, n)
+    return build_morton(raw), np.asarray(raw)
+
+
+def _data_queries(pts, q, rng, jitter=0.01):
+    """Queries near actual data (a uniform draw over the unit cube
+    misses the generated distribution entirely and every radius assert
+    would pass vacuously on all-zero counts)."""
+    scale = float(np.abs(pts).max())
+    picks = pts[rng.integers(0, pts.shape[0], q)]
+    return (picks + rng.normal(0.0, jitter * scale, picks.shape)
+            ).astype(np.float32), scale
+
+
+# --------------------------------------------------------------------------
+# device kernels vs oracle
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dim,n", [(2, 512), (3, 2048), (8, 1024)])
+def test_verbs_byte_identical_to_oracle(dim, n):
+    """Radius / range / both count forms, per-query radii, across
+    dims and sizes — byte-identical counts, ids, AND distances."""
+    tree, pts = _tree_and_points(SEED + dim, dim, n)
+    rng = np.random.default_rng(dim)
+    queries, scale = _data_queries(pts, 13, rng)
+    r = (rng.uniform(0.02, 0.12, 13) * scale).astype(np.float32)
+
+    res = verbs.radius_search(tree, queries, r)
+    ora = vo.radius_oracle(pts, queries, r)
+    _assert_same(res, ora)
+    assert int(res.counts.sum()) > 0, "vacuous: no radius hits"
+    assert res.truncated is False
+
+    cres = verbs.radius_search(tree, queries, r, with_ids=False)
+    assert np.array_equal(cres.counts,
+                          vo.radius_count_oracle(pts, queries, r))
+    assert cres.ids is None and cres.d2 is None
+
+    lo = (queries - 0.05 * scale).astype(np.float32)
+    hi = (queries + 0.05 * scale).astype(np.float32)
+    rres = verbs.range_search(tree, lo, hi)
+    rora = vo.range_oracle(pts, lo, hi)
+    _assert_same(rres, rora)
+    assert int(rres.counts.sum()) > 0, "vacuous: no range hits"
+    bres = verbs.range_search(tree, lo, hi, with_ids=False)
+    assert np.array_equal(bres.counts,
+                          vo.range_count_oracle(pts, lo, hi))
+
+
+def test_verb_edges_empty_and_degenerate():
+    """r=0 on a data point still hits it (inclusive d2 <= r^2), far
+    balls and inverted boxes are exactly empty, and empty answers have
+    empty id rows — not missing keys or negative counts."""
+    tree, pts = _tree_and_points(SEED, DIM, 1024)
+    # r = 0 centered ON data points: the point itself is inside
+    queries = pts[:5].astype(np.float32)
+    zero = np.zeros(5, np.float32)
+    res = verbs.radius_search(tree, queries, zero)
+    ora = vo.radius_oracle(pts, queries, zero)
+    _assert_same(res, ora)
+    assert np.all(res.counts >= 1)
+    # far away: exactly empty
+    far = np.full((3, DIM), 1e6, np.float32)
+    res = verbs.radius_search(tree, far, np.ones(3, np.float32))
+    assert np.array_equal(res.counts, np.zeros(3, np.int64))
+    assert res.ids.shape[0] == 3 and not np.any(res.ids >= 0)
+    # degenerate box (lo > hi on an axis) is legitimately empty
+    lo = np.full((2, DIM), 1.0, np.float32)
+    hi = np.full((2, DIM), -1.0, np.float32)
+    rres = verbs.range_search(tree, lo, hi)
+    assert np.array_equal(rres.counts, np.zeros(2, np.int64))
+    assert np.array_equal(rres.counts, vo.range_count_oracle(pts, lo, hi))
+
+
+def test_truncation_is_sound_lower_bound():
+    """A visit-capped answer is a SUBSET of the truth: counts bounded
+    above by the oracle, every returned id a true hit at its true
+    distance, and the cut flagged — never a silent approximation."""
+    tree, pts = _tree_and_points(SEED + 1, DIM, 8192)
+    rng = np.random.default_rng(3)
+    queries, scale = _data_queries(pts, 9, rng)
+    r = np.full(9, 0.25 * scale, np.float32)
+    full = vo.radius_oracle(pts, queries, r)
+    res = verbs.radius_search(tree, queries, r, visit_cap=1)
+    assert res.truncated is True
+    assert np.all(res.counts <= full.counts)
+    assert int(res.counts.sum()) > 0, "vacuous: cap returned nothing"
+    for q in range(9):
+        got = res.ids[q, : res.counts[q]]
+        truth = set(full.ids[q, : full.counts[q]].tolist())
+        assert set(got.tolist()) <= truth, "truncated answer invented a hit"
+        # returned distances are the true ones, not approximations
+        d2 = ((pts[got].astype(np.float32) - queries[q]) ** 2).sum(axis=1)
+        assert np.allclose(res.d2[q, : res.counts[q]], d2, rtol=1e-5)
+    # the count form truncates identically soundly
+    cres = verbs.radius_search(tree, queries, r, visit_cap=1,
+                               with_ids=False)
+    assert cres.truncated is True
+    assert np.all(cres.counts <= full.counts)
+
+
+# --------------------------------------------------------------------------
+# mutable overlay vs rebuild oracle
+# --------------------------------------------------------------------------
+
+
+def test_mutable_interleavings_vs_rebuild_oracle():
+    """Writes interleaved with verb queries: deletes inside a query
+    ball and upserts crossing a box must be visible exactly — the
+    overlay's answer byte-identical to the oracle over the live set."""
+    _, pts = _tree_and_points(SEED, DIM, 2048)
+    state = lifecycle.build_state(points=pts, k=K, max_batch=64,
+                                  max_delta_rows=64)
+    eng = state.engine
+    gid = np.arange(pts.shape[0], dtype=np.int64)
+    rng = np.random.default_rng(11)
+    queries, scale = _data_queries(pts, 7, rng)
+    r = np.full(7, 0.08 * scale, np.float32)
+    lo = (queries - 0.06 * scale).astype(np.float32)
+    hi = (queries + 0.06 * scale).astype(np.float32)
+
+    def check(live_pts, live_gid):
+        _assert_same(eng.radius_batch(queries, r),
+                     vo.radius_oracle(live_pts, queries, r,
+                                      gid=live_gid.astype(np.int32)))
+        cres = eng.radius_batch(queries, r, with_ids=False)
+        assert np.array_equal(
+            cres.counts, vo.radius_count_oracle(live_pts, queries, r))
+        _assert_same(eng.range_batch(lo, hi),
+                     vo.range_oracle(live_pts, lo, hi,
+                                     gid=live_gid.astype(np.int32)))
+
+    check(pts, gid)
+    # delete hits INSIDE the first query's ball — they must vanish
+    ball = vo.radius_oracle(pts, queries[:1], r[:1],
+                            gid=gid.astype(np.int32))
+    assert ball.counts[0] >= 2, "vacuous: ball too small to delete from"
+    dead = ball.ids[0, : min(3, int(ball.counts[0]))].astype(np.int64)
+    eng.delete(np.asarray(dead))
+    mask = ~np.isin(gid, dead)
+    check(pts[mask], gid[mask])
+    # upsert fresh points crossing the first box — they must appear
+    new_ids = np.array([pts.shape[0] + 5, pts.shape[0] + 6], np.int64)
+    new_pts = np.stack([queries[0] + 0.01, queries[0] - 0.01]
+                       ).astype(np.float32)
+    eng.upsert(new_ids, new_pts)
+    live_pts = np.concatenate([pts[mask], new_pts])
+    live_gid = np.concatenate([gid[mask], new_ids])
+    check(live_pts, live_gid)
+    # move an upserted point far away (upsert-as-update) and re-check
+    eng.upsert(new_ids[:1], np.full((1, DIM), 1e6, np.float32))
+    live_pts = np.concatenate(
+        [pts[mask], np.full((1, DIM), 1e6, np.float32), new_pts[1:]])
+    check(live_pts, live_gid)
+
+
+# --------------------------------------------------------------------------
+# live server endpoints
+# --------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def fresh_server(tree=None, *, points=None, id_offset=0):
+    if points is not None:
+        state = lifecycle.build_state(points=points, k=K, max_batch=64,
+                                      max_delta_rows=64)
+    else:
+        state = lifecycle.build_state(tree=tree, k=K, max_batch=64,
+                                      id_offset=id_offset)
+    httpd = srv.make_server(state, port=0, max_wait_ms=1.0)
+    accept = threading.Thread(target=httpd.serve_forever)
+    accept.start()
+    httpd.batcher.start()
+    state.warmup(buckets=[])
+    try:
+        yield httpd
+    finally:
+        httpd.shutdown()
+        accept.join()
+        httpd.batcher.stop()
+        httpd.server_close()
+
+
+def post(port, path, payload, timeout=120.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _expect_radius(port, pts, gid, queries, r, offset=0):
+    st, body = post(port, "/v1/radius",
+                    {"queries": queries.tolist(), "r": float(r)})
+    assert st == 200, body
+    ora = vo.radius_oracle(pts, queries,
+                           np.full(queries.shape[0], r, np.float32),
+                           gid=gid)
+    assert body["counts"] == ora.counts.astype(np.int64).tolist()
+    exp_ids = [(ora.ids[q, : ora.counts[q]].astype(np.int64)
+                + offset).tolist() for q in range(queries.shape[0])]
+    assert body["ids"] == exp_ids
+    exp_d = [np.sqrt(ora.d2[q, : ora.counts[q]].astype(np.float64)
+                     ).tolist() for q in range(queries.shape[0])]
+    assert body["distances"] == exp_d
+    assert body["truncated"] is False
+    return body
+
+
+def test_server_verb_endpoints_byte_identical():
+    """/v1/radius, /v1/range, /v1/count against a live server: answers
+    byte-identical to the oracle, global ids honored, count form id-free,
+    truncation flagged as a lower bound, bad bodies 400 crisply, and an
+    oversized batch still answered exactly (flagged oversized)."""
+    tree, pts = _tree_and_points(SEED, DIM, N)
+    gid = np.arange(N, dtype=np.int32)
+    rng = np.random.default_rng(11)
+    queries, scale = _data_queries(pts, 9, rng)
+    r_small, r_mid = 0.05 * scale, 0.1 * scale
+    with fresh_server(tree, id_offset=1000) as httpd:
+        port = httpd.server_address[1]
+        body = _expect_radius(port, pts, gid, queries, r_small,
+                              offset=1000)
+        assert sum(body["counts"]) > 0, "vacuous: no hits"
+        lo = (queries - 0.06 * scale).astype(np.float32)
+        hi = (queries + 0.06 * scale).astype(np.float32)
+        st, body = post(port, "/v1/range",
+                        {"lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 200, body
+        ora = vo.range_oracle(pts, lo, hi, gid=gid)
+        assert body["counts"] == ora.counts.astype(np.int64).tolist()
+        assert body["ids"] == [
+            (ora.ids[q, : ora.counts[q]].astype(np.int64)
+             + 1000).tolist() for q in range(lo.shape[0])]
+        # count: both forms, never materializing ids
+        st, body = post(port, "/v1/count",
+                        {"queries": queries.tolist(),
+                         "r": float(r_small)})
+        assert st == 200, body
+        assert body["counts"] == vo.radius_count_oracle(
+            pts, queries, np.full(9, r_small, np.float32)
+        ).astype(np.int64).tolist()
+        assert "ids" not in body and "distances" not in body
+        st, body = post(port, "/v1/count",
+                        {"lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 200, body
+        assert body["counts"] == vo.range_count_oracle(
+            pts, lo, hi).astype(np.int64).tolist()
+        # recall_target < 1: a sound lower bound, flagged
+        st, body = post(port, "/v1/radius",
+                        {"queries": queries.tolist(), "r": float(r_mid),
+                         "recall_target": 0.5})
+        assert st == 200, body
+        full = vo.radius_count_oracle(
+            pts, queries, np.full(9, r_mid, np.float32))
+        assert all(c <= e for c, e in zip(body["counts"], full.tolist()))
+        # bad bodies 400 naming the problem
+        st, body = post(port, "/v1/radius",
+                        {"queries": queries.tolist()})
+        assert st == 400 and '"r"' in body["error"], body
+        st, body = post(port, "/v1/count",
+                        {"queries": queries.tolist(), "r": 1.0,
+                         "lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 400 and "exactly one form" in body["error"], body
+        st, body = post(port, "/v1/range", {"lo": lo.tolist()})
+        assert st == 400, body
+        # oversized (rows > max_batch): degraded but still exact
+        big_q, _ = _data_queries(pts, 100, rng)
+        st, body = post(port, "/v1/radius",
+                        {"queries": big_q.tolist(), "r": float(r_small)})
+        assert st == 200 and body["degraded"] == "oversized", body
+        ora = vo.radius_oracle(pts, big_q,
+                               np.full(100, r_small, np.float32),
+                               gid=gid)
+        assert body["counts"] == ora.counts.astype(np.int64).tolist()
+
+
+def test_server_verbs_with_mutation_interleaved():
+    """Verb queries interleaved with /v1/upsert and /v1/delete over
+    HTTP: every answer exact over the surviving point set."""
+    _, pts = _tree_and_points(SEED, DIM, N)
+    gid = np.arange(N, dtype=np.int32)
+    rng = np.random.default_rng(11)
+    queries, scale = _data_queries(pts, 9, rng)
+    r = 0.05 * scale
+    with fresh_server(points=pts) as httpd:
+        port = httpd.server_address[1]
+        _expect_radius(port, pts, gid, queries, r)
+        ball = vo.radius_oracle(pts, queries[:1],
+                                np.full(1, r, np.float32), gid=gid)
+        dead = ball.ids[0, : min(3, int(ball.counts[0]))].tolist()
+        assert dead, "vacuous: nothing inside the ball to delete"
+        st, body = post(port, "/v1/delete", {"ids": dead})
+        assert st == 200, body
+        new_ids = [N + 5, N + 6]
+        new_pts = np.stack([queries[0] + 0.01, queries[0] - 0.01]
+                           ).astype(np.float32)
+        st, body = post(port, "/v1/upsert",
+                        {"ids": new_ids, "points": new_pts.tolist()})
+        assert st == 200, body
+        live_pts = np.concatenate([pts, new_pts])
+        live_gid = np.concatenate([gid, np.asarray(new_ids, np.int32)])
+        mask = ~np.isin(live_gid, dead)
+        _expect_radius(port, live_pts[mask], live_gid[mask], queries, r)
+        lo = (queries - 0.04 * scale).astype(np.float32)
+        hi = (queries + 0.04 * scale).astype(np.float32)
+        st, body = post(port, "/v1/range",
+                        {"lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 200, body
+        ora = vo.range_oracle(live_pts[mask], lo, hi,
+                              gid=live_gid[mask])
+        assert body["counts"] == ora.counts.astype(np.int64).tolist()
+        assert body["ids"] == [
+            ora.ids[q, : ora.counts[q]].astype(np.int64).tolist()
+            for q in range(lo.shape[0])]
+        st, body = post(port, "/v1/count",
+                        {"queries": queries.tolist(), "r": float(r)})
+        assert st == 200, body
+        assert body["counts"] == vo.radius_count_oracle(
+            live_pts[mask], queries, np.full(9, r, np.float32)
+        ).astype(np.int64).tolist()
+
+
+# --------------------------------------------------------------------------
+# multi-shard router merge vs single-index oracle
+# --------------------------------------------------------------------------
+
+SP_SHARDS = 4
+SP_CENTERS = np.array(
+    [[-60.0, -60.0, -60.0], [60.0, 60.0, 60.0],
+     [-60.0, 60.0, 0.0], [60.0, -60.0, 0.0]], dtype=np.float32)
+
+
+def test_router_verbs_byte_identical_over_sharded_fleet():
+    """The tentpole's routing half, e2e: a live 4-shard spatial fleet
+    where radius answers are the dedup union (keep-min-distance, sorted
+    (distance, id)), counts are the per-shard SUM, ranges the sorted id
+    union — each byte-identical to the single-index oracle — with
+    selective fan-out provably pruning, the all-pruned case answered
+    exactly empty with zero contacted shards, mutation through the
+    router visible exactly, and shard 400s propagated."""
+    import jax.numpy as jnp
+
+    from kdtree_tpu.ops.morton import morton_view
+    from kdtree_tpu.serve import router as rt
+    from kdtree_tpu.serve import spatial as sp
+
+    rng = np.random.default_rng(17)
+    pts = np.concatenate([
+        c + rng.normal(0.0, 3.0, (400, 3)) for c in SP_CENTERS
+    ]).astype(np.float32)
+    plan = sp.plan_partition(pts, SP_SHARDS)
+    sorted_pts = pts[plan["order"]]
+    gids = np.arange(pts.shape[0], dtype=np.int32)
+    servers, urls = [], []
+    for i, ((s, e), (c0, c1)) in enumerate(
+            zip(plan["bounds"], plan["code_ranges"])):
+        tree = morton_view(
+            jnp.asarray(sorted_pts[s:e]),
+            gid=jnp.asarray(np.arange(s, e, dtype=np.int32)),
+            n_real=int(e - s))
+        state = lifecycle.build_state(
+            tree=tree, k=K, max_batch=64, max_delta_rows=8,
+            meta={"spatial": {"grid": plan["grid"].to_json(),
+                              "code_range": [int(c0), int(c1)],
+                              "id_range": [int(s), int(e)],
+                              "shard": i, "shards": SP_SHARDS}})
+        httpd = srv.make_server(state, port=0)
+        httpd.start(warmup_buckets=[8])
+        servers.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    router = rt.make_router(urls, config=rt.RouterConfig(
+        deadline_s=30.0, retries=1, backoff_base_s=0.01,
+        health_period_s=0.1))
+    router.start(health_loop=True)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if all(ss.box() is not None for ss in router.shard_sets):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("fleet topology never learned")
+    rport = router.server_address[1]
+
+    def wait_routable():
+        dl = time.monotonic() + 20.0
+        while time.monotonic() < dl:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{rport}/healthz",
+                        timeout=5) as resp:
+                    if json.loads(resp.read()).get("available") \
+                            == SP_SHARDS:
+                        return
+            except Exception:
+                pass
+            time.sleep(0.05)
+        raise AssertionError("fleet never fully routable")
+
+    def vpost(path, payload):
+        # warm pass first: a big-hit-buffer recompile stalls a shard
+        # past the 0.1 s probe timeout and the health loop transiently
+        # ejects it — then re-issue against a fully-routable fleet for
+        # the deterministic byte-identity pin
+        post(rport, path, payload)
+        wait_routable()
+        return post(rport, path, payload)
+
+    try:
+        qrng = np.random.default_rng(5)
+        queries = (SP_CENTERS[1] + qrng.normal(0, 2.0, (7, 3))
+                   ).astype(np.float32)
+        r = 4.0
+        rv = np.full(7, r, np.float32)
+
+        st, body = vpost("/v1/radius",
+                         {"queries": queries.tolist(), "r": r})
+        assert st == 200, body
+        ora = vo.radius_oracle(sorted_pts, queries, rv, gid=gids)
+        assert body["counts"] == ora.counts.astype(np.int64).tolist()
+        assert sum(body["counts"]) > 0, "vacuous"
+        assert body["ids"] == [
+            ora.ids[q, : ora.counts[q]].astype(np.int64).tolist()
+            for q in range(7)]
+        assert body["distances"] == [
+            np.sqrt(ora.d2[q, : ora.counts[q]].astype(np.float64)
+                    ).tolist() for q in range(7)]
+        assert body["truncated"] is False
+        # the queries cluster at ONE center: selective fan-out pruned
+        assert body["shards"]["pruned"] >= 1, body["shards"]
+
+        st, body = vpost("/v1/count",
+                         {"queries": queries.tolist(), "r": r})
+        assert st == 200, body
+        assert body["counts"] == vo.radius_count_oracle(
+            sorted_pts, queries, rv).astype(np.int64).tolist()
+        assert "ids" not in body
+
+        # a box spanning TWO clusters: union merge across shards
+        lo = np.tile(np.minimum(SP_CENTERS[0], SP_CENTERS[2]) - 5.0,
+                     (3, 1)).astype(np.float32)
+        hi = np.tile(np.maximum(SP_CENTERS[0], SP_CENTERS[2]) + 5.0,
+                     (3, 1)).astype(np.float32)
+        st, body = vpost("/v1/range",
+                         {"lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 200, body
+        orr = vo.range_oracle(sorted_pts, lo, hi, gid=gids)
+        assert body["counts"] == orr.counts.astype(np.int64).tolist()
+        assert sum(body["counts"]) > 0, "vacuous"
+        exp_ids = [orr.ids[q, : orr.counts[q]].astype(np.int64).tolist()
+                   for q in range(3)]
+        assert body["ids"] == exp_ids
+
+        st, body = vpost("/v1/count",
+                         {"lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 200, body
+        assert body["counts"] == vo.range_count_oracle(
+            sorted_pts, lo, hi).astype(np.int64).tolist()
+
+        # mutation THROUGH the router, then a verb re-check
+        dead = exp_ids[0][:2]
+        st, body = post(rport, "/v1/delete", {"ids": dead})
+        assert st == 200, body
+        mask = ~np.isin(gids, dead)
+        wait_routable()
+        st, body = post(rport, "/v1/count",
+                        {"lo": lo.tolist(), "hi": hi.tolist()})
+        assert st == 200, body
+        assert body["counts"] == vo.range_count_oracle(
+            sorted_pts[mask], lo, hi).astype(np.int64).tolist()
+
+        # shard-side validation propagates as a client 400
+        st, body = post(rport, "/v1/radius",
+                        {"queries": queries.tolist()})
+        assert st == 400, (st, body)
+        # every shard pruned: the router answers exactly empty itself
+        far = np.full((2, 3), 1e6, np.float32)
+        wait_routable()
+        st, body = post(rport, "/v1/count",
+                        {"queries": far.tolist(), "r": 1.0})
+        assert st == 200 and body["counts"] == [0, 0], body
+        assert body["shards"]["contacted"] == 0, body["shards"]
+    finally:
+        router.stop()
+        for httpd in servers:
+            httpd.stop()
