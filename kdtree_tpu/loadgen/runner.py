@@ -54,9 +54,14 @@ DEFAULT_SLO_QUANTILE = 0.99
 DEFAULT_MAX_BAD_FRAC = 0.05
 DEFAULT_MAX_INFLIGHT = 64
 DEFAULT_TIMEOUT_S = 10.0
+# relative band the capacity-headroom model's predicted rate must land
+# within of the loadgen-measured knee (same posture as trend's
+# DEFAULT_BAND): a model off by more than this is not a model
+DEFAULT_KNEE_BAND = 0.5
 
 __all__ = ["discover", "run_load", "compute_knee", "scrape_server_block",
-           "scrape_pool_counters", "CAPACITY_VERSION"]
+           "scrape_pool_counters", "scrape_cost_classes",
+           "CAPACITY_VERSION", "DEFAULT_KNEE_BAND"]
 
 
 def _host_port(target: str) -> Tuple[str, int]:
@@ -523,6 +528,87 @@ def scrape_server_block(target: str,
     return None
 
 
+def scrape_cost_classes(
+        target: str, timeout_s: float = 2.0,
+) -> Optional[Dict[str, Dict[str, float]]]:
+    """One ``/metrics`` scrape distilled to the cost ledger's per-class
+    cumulative ``{requests, device_ms}`` counters, keyed
+    ``"verb/gear/outcome"`` and summed across any federation labels.
+    Falls back to the router's federated scrape when the plain
+    exposition carries no cost families (the shards hold them). None
+    when the scrape itself failed; a reachable pre-traffic target reads
+    as ``{}`` so the first window's deltas can still anchor there."""
+    for path in ("/metrics", "/metrics?federate=1"):
+        try:
+            host, port = _host_port(target)
+            conn = http.client.HTTPConnection(host, port,
+                                              timeout=timeout_s)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                status, text = resp.status, resp.read().decode(
+                    "utf-8", "replace")
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
+        if status != 200:
+            return None
+        classes = _parse_cost_classes(text)
+        if classes or path != "/metrics":
+            return classes
+        # plain scrape carried no cost families — try the router's
+        # federated exposition before concluding "no traffic yet"
+    return classes
+
+
+def _parse_cost_classes(text: str) -> Dict[str, Dict[str, float]]:
+    """Distill one exposition's cost counters to per-class cumulative
+    ``{requests, device_ms}``, keyed ``"verb/gear/outcome"`` and summed
+    across any extra (federation) labels."""
+    classes: Dict[str, Dict[str, float]] = {}
+    fields = {"kdtree_cost_requests_total": "requests",
+              "kdtree_cost_device_ms_total": "device_ms"}
+    for key, val in _parse_prom_lines(text).items():
+        field = fields.get(key.split("{", 1)[0])
+        if field is None or "{" not in key:
+            continue
+        labels = {}
+        for part in key.split("{", 1)[1].rstrip("}").split(","):
+            if "=" in part:
+                lk, lv = part.split("=", 1)
+                labels[lk] = lv.strip('"')
+        ck = "/".join((labels.get("verb", "?"),
+                       labels.get("gear", "?"),
+                       labels.get("outcome", "?")))
+        ent = classes.setdefault(
+            ck, {"requests": 0.0, "device_ms": 0.0})
+        ent[field] += val
+    return classes
+
+
+def _cost_delta(
+        start: Optional[Dict[str, Dict[str, float]]],
+        end: Optional[Dict[str, Dict[str, float]]],
+) -> Optional[Dict[str, Dict[str, float]]]:
+    """Per-class ``{requests, device_ms, cost_ms}`` deltas over a
+    [start, end) boundary window. None when either snapshot is missing
+    or no request landed in the window — absent evidence, never a fake
+    zero-cost class."""
+    if start is None or end is None:
+        return None
+    out: Dict[str, Dict[str, float]] = {}
+    for ck, ent in end.items():
+        base = start.get(ck, {})
+        req = ent.get("requests", 0.0) - base.get("requests", 0.0)
+        dev = ent.get("device_ms", 0.0) - base.get("device_ms", 0.0)
+        if req > 0:
+            out[ck] = {"requests": int(round(req)),
+                       "device_ms": round(dev, 3),
+                       "cost_ms": round(dev / req, 4)}
+    return out or None
+
+
 # --------------------------------------------------------------------------
 # the runner
 # --------------------------------------------------------------------------
@@ -540,6 +626,7 @@ def run_load(
     scrape: bool = True,
     on_step=None,
     verb_radius: float = 0.1,
+    knee_band: float = DEFAULT_KNEE_BAND,
 ) -> Dict:
     """Replay ``schedule`` against ``target``; return the full report
     (see the module docstring for the measurement contract). ``on_step``
@@ -568,13 +655,20 @@ def run_load(
     # for a fraction that moves by tens of points between the pooled
     # and --no-pool arms.
     pool_snaps: Dict[int, Tuple[float, float]] = {}
+    # cost-ledger snapshots at the same boundaries: per-step per-class
+    # cost columns and the run-wide predicted-knee check both difference
+    # these (docs/OBSERVABILITY.md "Cost accounting & capacity headroom")
+    cost_snaps: Dict[int, Dict[str, Dict[str, float]]] = {}
     snap_threads: List[threading.Thread] = []
 
     def snap_boundary(step: int) -> None:
         got = scrape_pool_counters(target)
-        if got is not None:
-            with lock:
+        costs = scrape_cost_classes(target)
+        with lock:
+            if got is not None:
                 pool_snaps[step] = got
+            if costs is not None:
+                cost_snaps[step] = costs
 
     if scrape:
         snap_boundary(0)
@@ -761,6 +855,13 @@ def run_load(
             # scrape was lost — absent evidence, never a fake zero
             "conn_reuse_frac": _reuse_frac(pool_snaps.get(si),
                                            pool_snaps.get(si + 1)),
+            # per-class cost columns for the step's boundary window
+            # (additive key; None when a boundary scrape was lost):
+            # knees measured at different class mixes are
+            # incommensurable, and this is the evidence trend's
+            # cost-growth rule compares mixes with
+            "costs": _cost_delta(cost_snaps.get(si),
+                                 cost_snaps.get(si + 1)),
         }
         if track_verbs:
             # per-verb latency/goodput columns (additive key — only
@@ -833,6 +934,26 @@ def run_load(
         # additive key, same versioning posture as fanout_frac: the
         # per-verb capacity verdicts next to the aggregate knee
         capacity["verbs"] = verb_block
+    # the capacity-headroom model's A/B (additive key): predicted
+    # sustainable rate from the run-wide measured cost-per-query
+    # (device budget 1000 ms/s — one serial batch worker) against the
+    # knee the ladder actually measured. within_band is the CI verdict.
+    run_costs = _cost_delta(cost_snaps.get(0), cost_snaps.get(len(accs)))
+    if run_costs:
+        total_req = sum(e["requests"] for e in run_costs.values())
+        total_dev = sum(e["device_ms"] for e in run_costs.values())
+        if total_req > 0 and total_dev > 0:
+            cpq = total_dev / total_req
+            predicted = 1000.0 / cpq
+            capacity["predicted"] = {
+                "cost_per_query_ms": round(cpq, 4),
+                "predicted_rate": round(predicted, 3),
+                "knee_rate": knee,
+                "band": float(knee_band),
+                "within_band": (abs(predicted - knee) <= knee_band * knee
+                                if knee > 0 else None),
+                "classes": run_costs,
+            }
     flight.record("loadgen.knee", knee_rate=knee, slo_ms=float(slo_ms),
                   steps=len(steps), target=target)
     return {

@@ -369,7 +369,59 @@ METRIC_HELP = {
         "SLO state transitions, by SLO and destination state",
     "kdtree_history_samples_total": "metric-history ring samples taken",
     "kdtree_device_busy_frac":
-        "device busy fraction of the last analyzed profiler capture",
+        "device busy fraction of the last analyzed profiler capture "
+        "(fed continuously by the profiling duty cycle when armed)",
+    "kdtree_dispatch_lag_us":
+        "median host->device dispatch lag of the last analyzed capture",
+    # cost accounting & capacity headroom (docs/OBSERVABILITY.md "Cost
+    # accounting & capacity headroom"); class labels are the bounded
+    # {verb, gear, outcome} enum — unknown values fold to "other"
+    "kdtree_cost_requests_total":
+        "answered requests, by cost class {verb, gear, outcome}",
+    "kdtree_cost_rows_total":
+        "query rows answered, by cost class",
+    "kdtree_cost_queue_ms_total":
+        "admission-queue wait attributed to answered requests, by class",
+    "kdtree_cost_device_ms_total":
+        "dispatch-span device time amortized to requests by row share "
+        "(shares sum exactly to each batch's measured span), by class",
+    "kdtree_cost_visits_total":
+        "planned candidate-bucket visits (rows x visit cap, or rows x "
+        "num_buckets when exact), by class",
+    "kdtree_cost_retries_total":
+        "verb overflow retries amortized to batch members, by class",
+    "kdtree_cost_bytes_in_total":
+        "request body bytes attributed at answer time, by class",
+    "kdtree_cost_bytes_out_total":
+        "response body bytes attributed at answer time, by class",
+    "kdtree_cost_correction_ms_total":
+        "device time spent on shadow recall-sample re-answers "
+        "(maintenance, not charged to any request class)",
+    "kdtree_cost_correction_rows_total":
+        "rows shadow re-answered by the online recall sampler",
+    "kdtree_cost_writes_total":
+        "write operations cost-accounted, by op (upsert / delete)",
+    "kdtree_cost_write_ms_total":
+        "write apply time cost-accounted, by op",
+    "kdtree_cost_rebuilds_total":
+        "epoch rebuilds cost-accounted as maintenance",
+    "kdtree_cost_rebuild_ms_total":
+        "epoch-rebuild wall time cost-accounted as maintenance",
+    "kdtree_cost_per_query_ms":
+        "windowed device cost per answered query over the history ring",
+    "kdtree_capacity_predicted_rate":
+        "predicted sustainable answer rate (req/s): measured device "
+        "budget / current-mix cost-per-query",
+    "kdtree_capacity_headroom_frac":
+        "1 - observed_rate/predicted_rate, floored at 0 — the shard's "
+        "capacity headroom under the current traffic mix",
+    "kdtree_router_headroom_frac":
+        "fleet capacity headroom aggregated over the routable shards' "
+        "reported headroom blocks",
+    "kdtree_profile_duty_windows_total":
+        "profiling duty-cycle capture windows completed",
+    "kdtree_profile_duty_skipped_total":
+        "duty-cycle windows skipped because a capture was already live",
     # engines
     "kdtree_builds_total": "index builds by engine",
     "kdtree_build_points_total": "rows indexed by engine",
@@ -528,6 +580,18 @@ def _capacity_lines(cap: Dict) -> list:
         f"{cap.get('slo_ms', 0):g} ms, bad <= "
         f"{cap.get('max_bad_frac', 0):.0%})"
     )
+    pred = cap.get("predicted")
+    if isinstance(pred, dict):
+        wb = pred.get("within_band")
+        verdict = ("within band" if wb
+                   else "OUTSIDE band" if wb is not None
+                   else "no knee to judge against")
+        out.append(
+            f"predicted rate:      {pred.get('predicted_rate', 0):g} "
+            f"req/s from measured cost/query "
+            f"{pred.get('cost_per_query_ms', 0):g} ms — {verdict} "
+            f"(band {pred.get('band', 0):.0%} of the knee)"
+        )
     steps = cap.get("steps") or []
     if steps:
         out.append(f"{'rate':>8s}  {'sent':>6s}  {'goodput':>8s}  "
@@ -578,6 +642,95 @@ def _capacity_lines(cap: Dict) -> list:
         if delta is not None:
             out.append(f"rebuild p99 delta:   {delta:+g} ms "
                        f"(epoch {server.get('epoch')})")
+    return out
+
+
+def _cost_classes(counters: Dict) -> Dict:
+    """``{(verb, gear, outcome): {field: value}}`` distilled from the
+    flat ``kdtree_cost_*`` counter keys of a report snapshot. Class
+    labels come from the ledger's bounded enums, so splitting on commas
+    is safe — no label value can contain one."""
+    fields = {
+        "kdtree_cost_requests_total": "requests",
+        "kdtree_cost_rows_total": "rows",
+        "kdtree_cost_queue_ms_total": "queue_ms",
+        "kdtree_cost_device_ms_total": "device_ms",
+        "kdtree_cost_visits_total": "visits",
+        "kdtree_cost_retries_total": "retries",
+        "kdtree_cost_bytes_in_total": "bytes_in",
+        "kdtree_cost_bytes_out_total": "bytes_out",
+    }
+    classes: Dict = {}
+    for key, val in (counters or {}).items():
+        name = key.split("{", 1)[0]
+        field = fields.get(name)
+        if field is None or "{" not in key:
+            continue
+        labels = {}
+        for part in key.split("{", 1)[1].rstrip("}").split(","):
+            if "=" in part:
+                lk, lv = part.split("=", 1)
+                labels[lk] = lv.strip('"')
+        ck = (labels.get("verb", "?"), labels.get("gear", "?"),
+              labels.get("outcome", "?"))
+        classes.setdefault(ck, {})[field] = float(val)
+    return classes
+
+
+# relative cost-per-query growth that earns the "<- cost grew" flag in
+# stats --diff (display salience only; CI gating is trend's cost-growth
+# rule with its own band)
+COST_GROWTH_FLAG_FRAC = 0.05
+
+
+def _cost_lines(counters: Dict, old_counters: Optional[Dict] = None) -> list:
+    """Human rendering of the per-class cost table (ONE helper shared by
+    ``stats`` and ``stats --diff`` so the two views cannot drift).
+    cost/query is device_ms per answered request — the number the
+    capacity-headroom model divides the device budget by."""
+    classes = _cost_classes(counters)
+    old_classes = (_cost_classes(old_counters)
+                   if old_counters is not None else None)
+    if not classes and not old_classes:
+        return []
+
+    def cpq(row):
+        if not row or not row.get("requests"):
+            return None
+        return row.get("device_ms", 0.0) / row["requests"]
+
+    out = ["== cost per query (device_ms, by class) =="]
+    if old_classes is None:
+        out.append(f"{'class':<34s}  {'req':>7s}  {'cost/q':>9s}  "
+                   f"{'queue/q':>9s}  {'visits/q':>9s}  {'retries':>7s}")
+        for ck in sorted(classes):
+            row = classes[ck]
+            n = row.get("requests", 0.0)
+            c = cpq(row)
+            out.append(
+                f"{'/'.join(ck):<34s}  {n:>7g}  "
+                f"{f'{c:.3f}ms' if c is not None else '-':>9s}  "
+                f"{(row.get('queue_ms', 0.0) / n if n else 0.0):>7.3f}ms  "
+                f"{(row.get('visits', 0.0) / n if n else 0.0):>9.1f}  "
+                f"{row.get('retries', 0.0):>7g}"
+            )
+        return out
+    out.append(f"{'class':<34s}  {'OLD cost/q':>11s}  {'NEW cost/q':>11s}  "
+               f"{'delta':>8s}")
+    for ck in sorted(set(classes) | set(old_classes)):
+        o, n = cpq(old_classes.get(ck)), cpq(classes.get(ck))
+        delta = (_fmt_delta(o, n) if o is not None and n is not None
+                 else ("gone" if n is None else "new"))
+        flag = ""
+        if o is not None and n is not None and o > 0 and \
+                (n - o) / o > COST_GROWTH_FLAG_FRAC:
+            flag = "   <- cost grew"
+        out.append(
+            f"{'/'.join(ck):<34s}  "
+            f"{f'{o:.3f}ms' if o is not None else '-':>11s}  "
+            f"{f'{n:.3f}ms' if n is not None else '-':>11s}  "
+            f"{delta:>8s}{flag}"
+        )
     return out
 
 
@@ -669,6 +822,11 @@ def render_report(rep: Dict) -> str:
         width = max(len(k) for k in g)
         for key in sorted(g):
             out.append(f"{key:<{width}}  {g[key]:g}")
+
+    cost_block = _cost_lines(c)
+    if cost_block:
+        out.append("")
+        out.extend(cost_block)
 
     if isinstance(rep.get("capacity"), dict):
         out.append("")
@@ -794,6 +952,11 @@ def render_report_diff(old: Dict, new: Dict) -> str:
         for key, ov, nv in changed:
             out.append(f"{key:{width}s}  {ov:14g}  {nv:14g}  "
                        f"{_fmt_delta(ov, nv)}")
+
+    cost_block = _cost_lines(nc, old_counters=oc)
+    if cost_block:
+        out.append("")
+        out.extend(cost_block)
 
     og, ng = old.get("gauges", {}), new.get("gauges", {})
     moved = [

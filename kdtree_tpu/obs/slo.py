@@ -174,8 +174,16 @@ def default_specs() -> List[SloSpec]:
         ),
         SloSpec(
             name="device-busy",
-            objective="captured device busy_frac stays above 0.5 "
-                      "(inactive until a profiler capture runs)",
+            # the gauge is written ONLY when a capture is analyzed: by
+            # the profiling duty cycle (obs/costs.py, the steady-state
+            # feed unless KDTREE_TPU_PROFILE_DUTY=0) or by a manual
+            # /debug/profile / `kdtree-tpu profile` capture. Between
+            # captures there are no samples, so the verdict is OK with
+            # data:false — an idle gauge is missing data, never a burn.
+            objective="captured device busy_frac stays above 0.5 (fed by "
+                      "the profiling duty cycle; duty off => only manual "
+                      "captures feed it and verdicts stay data:false "
+                      "between them)",
             target=0.90,
             kind="gauge_min",
             gauge="kdtree_device_busy_frac",

@@ -381,6 +381,15 @@ def analyze_trace_file(
         from kdtree_tpu.obs.registry import get_registry
 
         get_registry().gauge("kdtree_device_busy_frac").set(float(busy))
+    # the companion headline: median host->device dispatch lag. The
+    # profiling duty cycle (obs/costs.py) refreshes both every period,
+    # which is what keeps them live in steady state between manual
+    # captures.
+    lag = rep.get("dispatches", {}).get("lag_us", {}).get("median")
+    if lag is not None:
+        from kdtree_tpu.obs.registry import get_registry
+
+        get_registry().gauge("kdtree_dispatch_lag_us").set(float(lag))
     return rep
 
 

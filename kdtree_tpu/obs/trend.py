@@ -50,6 +50,16 @@ series of bench artifacts and flags exactly that class of silent decay:
   throughput cliff, and fails CI the same way. Fractions are in
   [0, 1] and deterministic for a seeded schedule against a fixed
   fleet shape, so the band is absolute, like recall's.
+- **cost-growth**: a class's device cost-per-query (the loadgen
+  capacity steps' per-class ``costs`` columns, summed over the run —
+  docs/OBSERVABILITY.md "Cost accounting & capacity headroom") GROWING
+  beyond the relative band vs the previous cost-bearing run of the
+  same variant (per-variant cursors, like capacity's). A knee can hold
+  while every query quietly costs more device time — headroom erodes
+  before throughput does, and this rule fails CI at the erosion, not
+  at the cliff. The per-class keys also harden the knee comparison:
+  runs whose observed class mixes differ are incommensurable, exactly
+  like a changed gear or verb mix.
 
 The noise band is fitted from ``--pair`` runs when any input carries a
 ``pair_first`` block (two same-process passes bound the run-to-run
@@ -211,6 +221,8 @@ def _capacity_facts(cap) -> Optional[dict]:
     gears_known = False
     verbs = set()
     verbs_known = False
+    cost_agg: Dict[str, List[float]] = {}
+    costs_known = False
     for s in cap.get("steps") or []:
         if not isinstance(s, dict) or "rate" not in s:
             continue
@@ -223,6 +235,24 @@ def _capacity_facts(cap) -> Optional[dict]:
         if isinstance(s.get("verbs"), dict):
             verbs_known = True
             verbs.update(s["verbs"])
+        if isinstance(s.get("costs"), dict):
+            costs_known = True
+            for ck, ent in s["costs"].items():
+                try:
+                    req = float(ent.get("requests", 0))
+                    dev = float(ent.get("device_ms", 0))
+                except (TypeError, ValueError):
+                    continue  # malformed column reads as absent
+                agg = cost_agg.setdefault(str(ck), [0.0, 0.0])
+                agg[0] += req
+                agg[1] += dev
+    # run-level device cost-per-query by class, requests-weighted over
+    # the steps that carried cost columns (None for pre-cost artifacts):
+    # the cost-growth rule's input, and a second incommensurability key
+    # for the knee comparison (a changed class mix is a changed workload)
+    costs = ({ck: round(dev / req, 4)
+              for ck, (req, dev) in sorted(cost_agg.items()) if req > 0}
+             if costs_known else None)
     fanout = cap.get("fanout_frac")
     try:
         fanout = None if fanout is None else float(fanout)
@@ -261,7 +291,8 @@ def _capacity_facts(cap) -> Optional[dict]:
             # for unmixed/pre-verb artifacts): same incommensurability
             # rule — a knee measured 30% radius/count is not comparable
             # to a pure-knn one
-            "verbs": sorted(verbs) if verbs_known else None}
+            "verbs": sorted(verbs) if verbs_known else None,
+            "costs": costs}
 
 
 def _recall_facts(block) -> Optional[dict]:
@@ -450,8 +481,15 @@ def analyze(runs: List[dict], band: Optional[float] = None):
             # verbs do different amounts of work per request.
             pg, cg = prev_cap[1].get("gears"), cap.get("gears")
             pv, cv = prev_cap[1].get("verbs"), cap.get("verbs")
+            # ... and a changed COST-CLASS mix (the per-step cost
+            # columns' observed {verb, gear, outcome} keys) is a
+            # changed workload too — a knee served all-ok/exact is
+            # not comparable to one served part-degraded
+            pco, cco = prev_cap[1].get("costs"), cap.get("costs")
             comparable = (pg is None or cg is None or pg == cg) and \
-                (pv is None or cv is None or pv == cv)
+                (pv is None or cv is None or pv == cv) and \
+                (pco is None or cco is None or
+                 sorted(pco) == sorted(cco))
             if comparable and pknee and pknee > 0 and \
                     cknee is not None and \
                     (pknee - cknee) / pknee > used:
@@ -489,6 +527,32 @@ def analyze(runs: List[dict], band: Optional[float] = None):
                     "is eroding",
                 ))
         prev_fans[ccap.get("variant")] = (cur, cfan)
+    # per-class device cost-per-query compares against the previous
+    # COST-bearing run of the same variant (its own cursor, like
+    # fan-out's), growth direction, the relative noise band: headroom
+    # erodes before the knee falls, and this gate fires at the erosion
+    prev_costs: dict = {}
+    for cur in runs:
+        ccap = cur.get("capacity") or {}
+        ccost = ccap.get("costs")
+        if not ccost:
+            continue
+        prev_c = prev_costs.get(ccap.get("variant"))
+        if prev_c is not None:
+            for ck in sorted(set(prev_c[1]) & set(ccost)):
+                pcm, ccm = prev_c[1][ck], ccost[ck]
+                if pcm > 0 and (ccm - pcm) / pcm > used:
+                    findings.append(_finding(
+                        "cost-growth", f"capacity:cost:{ck}",
+                        prev_c[0], cur,
+                        f"device cost/query for {ck} grew {pcm:g} -> "
+                        f"{ccm:g} ms {_fmt_delta(pcm, ccm)} (band "
+                        f"{used:.0%}): each answered query of this "
+                        "class burns more device time than it used to "
+                        "— capacity headroom is eroding ahead of the "
+                        "knee",
+                    ))
+        prev_costs[ccap.get("variant")] = (cur, ccost)
     # the A/B knee gate judges each run AGAINST ITS OWN EMBEDDED
     # baseline (loadgen --ab-baseline), not against a neighboring run:
     # the artifact itself claims "this arm beats that arm", and the

@@ -45,6 +45,7 @@ from typing import List, Optional
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.obs import costs as costs_mod
 from kdtree_tpu.obs import flight
 from kdtree_tpu.obs import trace as trace_mod
 from kdtree_tpu.serve.admission import AdmissionQueue, PendingRequest
@@ -86,6 +87,7 @@ class MicroBatcher:
         ladder=None,
         faults=None,
         recall_sample: float = 0.0,
+        costs: Optional[costs_mod.CostLedger] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -178,6 +180,24 @@ class MicroBatcher:
         self._sample_tick = 0
         self._sampled_ewma: Optional[float] = None
         self._samples = reg.counter("kdtree_recall_samples_total")
+        # the cost ledger (obs/costs.py): every answered request gets a
+        # cost vector, with the batch's dispatch span amortized to
+        # members by row share (exact-sum identity). The server shares
+        # this instance so the HTTP layer's byte counts land in the
+        # same class table.
+        self.costs = costs if costs is not None else costs_mod.CostLedger()
+
+    def _visits_per_row(self, visit_cap) -> int:
+        """Planned candidate-bucket visits per query row: the resolved
+        visit cap for approximate gears, every bucket for exact (the
+        tree's bucket count)."""
+        if visit_cap:
+            return int(visit_cap)
+        tree = getattr(self.engine, "tree", None)
+        try:
+            return int(getattr(tree, "num_buckets", 0) or 0)
+        except Exception:
+            return 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -360,9 +380,23 @@ class MicroBatcher:
             epoch=getattr(self.engine, "last_answer_epoch", 0),
             traces=[r.trace_id for r in live],
         )
+        # cost attribution: the measured dispatch span amortized to
+        # members by row share (exact-sum identity — obs/costs.py)
+        span_ms = round((done - live[0].dispatched_at) * 1e3, 3)
+        outcome = "degraded" if forced is not None else "ok"
+        shares = self.costs.attribute_batch(
+            verb="knn", gear=gear, span_ms=span_ms,
+            members=[
+                (r.rows,
+                 round((r.dispatched_at - r.enqueued_at) * 1e3, 3),
+                 outcome)
+                for r in live
+            ],
+            visits_per_row=self._visits_per_row(visit_cap),
+        )
         done_unix = time.time()
         off = 0
-        for r in live:
+        for r, share in zip(live, shares):
             self._lat["dispatch"].observe(done - r.dispatched_at)
             self._lat["total"].observe(done - r.enqueued_at,
                                        exemplar=r.trace_id)
@@ -393,11 +427,14 @@ class MicroBatcher:
                 )
             # per-request decomposition, by trace id: queue (admit ->
             # dispatch) vs device (dispatch -> done) — the flight ring's
-            # answer to "why was THIS request slow"
+            # answer to "why was THIS request slow". device_ms is the
+            # WAIT (the whole span — latency truth); device_share_ms is
+            # the COST (this request's amortized slice of the span)
             flight.record(
                 "serve.request", trace=r.trace_id, rows=r.rows,
                 queue_ms=round((r.dispatched_at - r.enqueued_at) * 1e3, 3),
                 device_ms=round((done - r.dispatched_at) * 1e3, 3),
+                device_share_ms=share,
                 total_ms=round((done - r.enqueued_at) * 1e3, 3),
             )
             # fulfill LAST: it wakes the waiting handler thread, and a
@@ -507,9 +544,26 @@ class MicroBatcher:
             epoch=getattr(self.engine, "last_answer_epoch", 0),
             traces=[r.trace_id for r in live],
         )
+        # cost attribution: the span already CONTAINS the driver's
+        # overflow-retry re-dispatches, so the exact-sum identity holds
+        # with retries included; the retry count itself is split by the
+        # same row shares
+        span_ms = round((done - live[0].dispatched_at) * 1e3, 3)
+        outcome = "degraded" if forced is not None else "ok"
+        shares = self.costs.attribute_batch(
+            verb=fam, gear=gear, span_ms=span_ms,
+            members=[
+                (r.rows,
+                 round((r.dispatched_at - r.enqueued_at) * 1e3, 3),
+                 outcome)
+                for r in live
+            ],
+            retries=int(res.retries),
+            visits_per_row=self._visits_per_row(visit_cap),
+        )
         done_unix = time.time()
         off = 0
-        for r in live:
+        for r, share in zip(live, shares):
             self._lat["dispatch"].observe(done - r.dispatched_at)
             self._lat["total"].observe(done - r.enqueued_at,
                                        exemplar=r.trace_id)
@@ -538,6 +592,7 @@ class MicroBatcher:
                 queue_ms=round((r.dispatched_at - r.enqueued_at) * 1e3,
                                3),
                 device_ms=round((done - r.dispatched_at) * 1e3, 3),
+                device_share_ms=share,
                 total_ms=round((done - r.enqueued_at) * 1e3, 3),
             )
             r.fulfill(
@@ -562,7 +617,13 @@ class MicroBatcher:
         try:
             from kdtree_tpu.approx.recall import recall_at_k
 
+            t0 = time.monotonic()
             _, exact_ids, _ = self.engine.knn_batch(q)
+            # correction dispatch: real device time that answered no
+            # client — ledgered separately so cost-per-query stays
+            # honest while the capacity model still sees the spend
+            self.costs.attribute_correction(
+                round((time.monotonic() - t0) * 1e3, 3), rows)
             measured = recall_at_k(approx_ids[:rows], exact_ids[:rows])
         except Exception as e:
             flight.record("recall.sample_error", error=repr(e)[:200])
@@ -590,6 +651,7 @@ class MicroBatcher:
                       else "exact"].inc()
         counts = None
         truncated = False
+        t0 = time.monotonic()
         try:
             if req.verb == "knn":
                 d2, ids = self.engine.fallback_knn(req.queries, req.k)
@@ -616,6 +678,21 @@ class MicroBatcher:
             req.fail(f"fallback dispatch failed: {e!r}")
             return
         done = time.monotonic()
+        # a fallback is its own single-member dispatch: the brute-force
+        # compute span is the request's whole device cost (identity is
+        # trivial at batch size one). Every fallback answer is degraded.
+        self.costs.attribute_request(
+            verb=self._verb_family(req.verb) if req.verb != "knn"
+            else "knn",
+            gear="brute-deadline" if reason == "brute-deadline"
+            else "exact",
+            span_ms=round((done - t0) * 1e3, 3),
+            rows=req.rows,
+            queue_ms=round(
+                ((req.dispatched_at if req.dispatched_at is not None
+                  else done) - req.enqueued_at) * 1e3, 3),
+            outcome="degraded",
+        )
         if req.dispatched_at is not None:
             self._lat["dispatch"].observe(done - req.dispatched_at)
         self._lat["total"].observe(done - req.enqueued_at,

@@ -692,6 +692,12 @@ class RouterHandler(JsonRequestHandler):
         if path == "/debug/shards":
             self._send_json(200, {"shards": self.server.shard_report()})
             return
+        if path == "/debug/costs":
+            # the fleet cost view: per-replica /debug/costs fan-out +
+            # the aggregated headroom block (what `kdtree-tpu costs`
+            # renders when pointed at a router)
+            self._send_json(200, self.server.fleet_costs())
+            return
         self._send_json(404, {"error": f"no such path: {path}"})
 
     def _send_health(self) -> None:
@@ -726,6 +732,10 @@ class RouterHandler(JsonRequestHandler):
                                "hi": [float(x) for x in u[1]]}
         if rt.slo_engine is not None:
             body["slo"] = rt.slo_engine.health_block()
+        # fleet capacity headroom, summed over the routable replicas'
+        # own /healthz headroom blocks (ejected shards contribute
+        # nothing — see Router.fleet_headroom)
+        body["headroom"] = rt.fleet_headroom()
         self._send_json(200 if available >= rt.quorum else 503, body)
 
     # -- POST ---------------------------------------------------------------
@@ -2640,6 +2650,103 @@ class Router(GracefulHTTPServer):
                 key = f"{sname}{{{inner}}}" if inner else sname
                 lines.append(f"{key} {value}")
         return "\n".join(lines) + "\n"
+
+    # -- cost attribution & capacity headroom --------------------------------
+
+    def fleet_headroom(self) -> dict:
+        """Fleet capacity-headroom aggregation from the shard
+        ``/healthz`` headroom blocks the health loop already collects
+        (no extra fan-out on the read path): fleet predicted rate = sum
+        of the routable replicas' predicted rates, observed likewise.
+        An ejected replica's detail is ``{"ejected": ...}`` — it
+        contributes NOTHING to the sums, so losing a shard reads as
+        reduced predicted capacity, never as phantom headroom."""
+        entries = []
+        predicted = 0.0
+        observed = 0.0
+        reporting = 0
+        for shard in self.shards:
+            routable = shard.healthy and shard.breaker.state != OPEN
+            detail = shard.health_detail
+            hr = detail.get("headroom") if isinstance(detail, dict) \
+                else None
+            ent = {"shard": shard.index, "replica": shard.replica,
+                   "url": shard.url, "routable": routable}
+            if routable and isinstance(hr, dict):
+                ent["headroom"] = hr
+                if hr.get("data"):
+                    try:
+                        p = float(hr["predicted_rate"])
+                        o = float(hr["observed_rate"])
+                    except (KeyError, TypeError, ValueError):
+                        pass  # malformed block reads as absent
+                    else:
+                        predicted += p
+                        observed += o
+                        reporting += 1
+            entries.append(ent)
+        out = {
+            "data": reporting > 0,
+            "shards_reporting": reporting,
+            "shards_total": len(self.shards),
+            "shards": entries,
+        }
+        if reporting:
+            frac = (max(0.0, 1.0 - observed / predicted)
+                    if predicted > 0 else 0.0)
+            out["predicted_rate"] = predicted
+            out["observed_rate"] = observed
+            out["headroom_frac"] = frac
+            # lazy gauge, same idiom as the shard-side ledger: absent
+            # until a shard actually reports, never a misleading 0
+            obs.get_registry().gauge(
+                "kdtree_router_headroom_frac").set(frac)
+        return out
+
+    def fleet_costs(self) -> dict:
+        """``GET /debug/costs`` at the router: every replica's cost
+        report fetched concurrently (an unreachable replica is an
+        ``error`` entry, never a failed fan-out), plus the fleet
+        headroom aggregation."""
+        import http.client
+
+        results: List[Optional[dict]] = [None] * len(self.shards)
+
+        def fetch(i: int, shard: ShardState) -> None:
+            timeout = max(min(self.config.deadline_s, 2.0), 0.5)
+            try:
+                conn = http.client.HTTPConnection(
+                    shard.host, shard.port, timeout=timeout)
+                try:
+                    conn.request("GET", "/debug/costs")
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    if resp.status == 200:
+                        results[i] = json.loads(raw.decode("utf-8"))
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException, ValueError):
+                pass
+
+        fetchers = [
+            threading.Thread(target=fetch, args=(i, s),
+                             name="kdtree-route-costs")
+            for i, s in enumerate(self.shards)
+        ]
+        for t in fetchers:
+            t.start()
+        for t in fetchers:
+            t.join()
+        shards_out = []
+        for shard, res in zip(self.shards, results):
+            ent = {"shard": shard.index, "replica": shard.replica,
+                   "url": shard.url}
+            if res is None:
+                ent["error"] = "unreachable"
+            else:
+                ent["costs"] = res
+            shards_out.append(ent)
+        return {"shards": shards_out, "headroom": self.fleet_headroom()}
 
     # -- health ejection -----------------------------------------------------
 

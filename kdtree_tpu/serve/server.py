@@ -74,6 +74,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from kdtree_tpu import obs
+from kdtree_tpu.obs import costs as costs_mod
 from kdtree_tpu.obs import flight
 from kdtree_tpu.obs import trace as trace_mod
 from kdtree_tpu.serve.admission import (
@@ -155,7 +156,7 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def _send_bytes(
         self, code: int, body: bytes, content_type: str,
         extra_headers: Optional[dict] = None,
-    ) -> None:
+    ) -> int:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -170,15 +171,17 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             self.send_header(key, val)
         self.end_headers()
         self.wfile.write(body)
+        return len(body)
 
     def _send_json(
         self, code: int, obj: dict, extra_headers: Optional[dict] = None,
-    ) -> None:
+    ) -> int:
         # default=str: flight-ring events carry arbitrary recorded fields
         # (record() accepts anything by design); one unserializable value
         # must not turn /debug/flight into a dropped connection when the
-        # SIGUSR2 dump of the same payload would have succeeded
-        self._send_bytes(
+        # SIGUSR2 dump of the same payload would have succeeded.
+        # Returns the body size — the cost ledger's bytes_out source.
+        return self._send_bytes(
             code, (json.dumps(obj, default=str) + "\n").encode("utf-8"),
             "application/json", extra_headers,
         )
@@ -291,6 +294,9 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
                             {"error": f"Content-Length must be in "
                                       f"[0, {max_bytes}]"})
             return None
+        # the cost ledger's bytes_in source: the declared body size the
+        # answer paths attribute to the request's cost class
+        self._body_bytes = length
         try:
             payload = json.loads(self.rfile.read(length).decode("utf-8"))
         except (UnicodeDecodeError, ValueError):
@@ -440,6 +446,12 @@ class KnnRequestHandler(JsonRequestHandler):
                         "name": spec.name,
                         "recall_target": spec.recall_target,
                     }
+                # the capacity-headroom verdict (obs/costs.py): the
+                # router's fleet aggregation and any capacity planner
+                # read predicted sustainable rate vs observed from here;
+                # data:false while idle — no traffic is not no headroom
+                body["headroom"] = self.server.costs.headroom(
+                    history=self.server.history)
                 self._send_json(200, body)
             else:
                 self._send_json(503, {"status": "warming"},
@@ -469,6 +481,22 @@ class KnnRequestHandler(JsonRequestHandler):
         if path == "/debug/faults":
             self._send_json(200, {"enabled": self.server.faults_mutable,
                                   "active": self.server.faults.describe()})
+            return
+        if path == "/debug/costs":
+            # the cost ledger's full report: per-class cumulative cost
+            # vectors, the windowed cost-per-query, and the headroom
+            # verdict — what `kdtree-tpu costs` renders
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                window_s = float(qs.get("window", ["60"])[0])
+            except ValueError:
+                window_s = costs_mod.DEFAULT_WINDOW_S
+            if not (window_s > 0):
+                window_s = costs_mod.DEFAULT_WINDOW_S
+            self._send_json(200, self.server.costs.report(
+                window_s=window_s, history=self.server.history))
             return
         self._send_json(404, {"error": f"no such path: {path}"})
 
@@ -560,10 +588,13 @@ class KnnRequestHandler(JsonRequestHandler):
             _count_request("degraded")
             self._trace_finish(ctx, root_id, t_req0, "degraded", "oversized",
                                int(queries.shape[0]))
-            self._send_json(
+            sent = self._send_json(
                 200, self._result_json(d2, ids, k, degraded="oversized",
                                        trace_id=trace)
             )
+            self.server.costs.count_bytes(
+                verb="knn", gear="exact", outcome="degraded",
+                bytes_in=getattr(self, "_body_bytes", 0), bytes_out=sent)
             return
         deadline = (_time.monotonic() + deadline_s) if deadline_s else None
         req = PendingRequest(
@@ -605,10 +636,14 @@ class KnnRequestHandler(JsonRequestHandler):
         self._trace_finish(ctx, root_id, t_req0,
                            "degraded" if req.degraded else "ok",
                            req.degraded, req.rows)
-        self._send_json(
+        sent = self._send_json(
             200, self._result_json(req.d2, req.ids, k, degraded=req.degraded,
                                    trace_id=trace, gear=req.gear)
         )
+        self.server.costs.count_bytes(
+            verb="knn", gear=req.gear,
+            outcome="degraded" if req.degraded else "ok",
+            bytes_in=getattr(self, "_body_bytes", 0), bytes_out=sent)
 
     def _parse_knn_body(
         self,
@@ -759,9 +794,12 @@ class KnnRequestHandler(JsonRequestHandler):
             _count_request("degraded")
             self._trace_finish(ctx, root_id, t_req0, "degraded",
                                "oversized", int(queries.shape[0]))
-            self._send_json(200, self._verb_result_json(
+            sent = self._send_json(200, self._verb_result_json(
                 verb, res.counts, res.d2, res.ids, bool(res.truncated),
                 degraded="oversized", trace_id=trace))
+            self.server.costs.count_bytes(
+                verb=verb, gear="exact", outcome="degraded",
+                bytes_in=getattr(self, "_body_bytes", 0), bytes_out=sent)
             return
         deadline = (_time.monotonic() + deadline_s) if deadline_s else None
         req = PendingRequest(
@@ -804,9 +842,13 @@ class KnnRequestHandler(JsonRequestHandler):
         self._trace_finish(ctx, root_id, t_req0,
                            "degraded" if req.degraded else "ok",
                            req.degraded, req.rows)
-        self._send_json(200, self._verb_result_json(
+        sent = self._send_json(200, self._verb_result_json(
             verb, req.counts, req.d2, req.ids, req.truncated,
             degraded=req.degraded, trace_id=trace, gear=req.gear))
+        self.server.costs.count_bytes(
+            verb=verb, gear=req.gear,
+            outcome="degraded" if req.degraded else "ok",
+            bytes_in=getattr(self, "_body_bytes", 0), bytes_out=sent)
 
     def _parse_verb_body(
         self, endpoint: str,
@@ -1007,6 +1049,7 @@ class KnnRequestHandler(JsonRequestHandler):
         # contention show up here, not only in a profiler capture
         apply_ms = (_time.perf_counter() - t0) * 1e3
         self.server.write_latency[op].observe(apply_ms, exemplar=trace)
+        costs_mod.count_write(op, apply_ms)
         if ctx is not None:
             trace_mod.record_span(
                 ctx.trace_id, trace_mod.new_span_id(), root_id,
@@ -1220,6 +1263,11 @@ class KnnServer(GracefulHTTPServer):
         self.ladder = DegradationLadder(
             state.slo_engine, enabled=state.ladder_enabled,
         )
+        # ONE cost ledger per server: the batcher attributes device
+        # spans into it, the HTTP layer adds bytes, /debug/costs and
+        # the healthz headroom block read it — a shared class table so
+        # a request's cost vector lands in one row
+        self.costs = costs_mod.CostLedger()
         self.batcher = MicroBatcher(
             state.engine, self.queue,
             max_batch=state.max_batch,
@@ -1231,7 +1279,12 @@ class KnnServer(GracefulHTTPServer):
             # answered exactly, measured recall published) — 0 off, the
             # serve CLI arms its default fraction
             recall_sample=recall_sample,
+            costs=self.costs,
         )
+        # the profiling duty cycle (obs/costs.py): short capture_for
+        # windows on a period keep kdtree_device_busy_frac live in
+        # steady state; KDTREE_TPU_PROFILE_DUTY=0 kills it
+        self.duty = costs_mod.ProfileDutyCycle()
         # the history ring /debug/history serves and the sampler feeds:
         # the SLO engine's own ring when one is wired, else the process
         # default (they coincide for the default engine)
@@ -1267,6 +1320,9 @@ class KnnServer(GracefulHTTPServer):
         # the ladder's controller runs on the SAME tick, AFTER the SLO
         # verdicts it reads were refreshed (never raises either)
         self.ladder.tick()
+        # refresh the published cost/headroom gauges from the same tick
+        # (never raises; gauges stay absent while idle)
+        self.costs.publish(history=self.history)
 
     def start(self, warmup: bool = True, warmup_buckets=None) -> None:
         """Start the batch worker, the history sampler (+ SLO evaluation
@@ -1282,6 +1338,7 @@ class KnnServer(GracefulHTTPServer):
             on_sample=self._slo_tick,
         )
         self._sampler.start()
+        self.duty.start()  # no-op when KDTREE_TPU_PROFILE_DUTY=0
         self._serve_thread = threading.Thread(
             target=self.serve_forever, name="kdtree-serve-accept"
         )
@@ -1303,6 +1360,7 @@ class KnnServer(GracefulHTTPServer):
         if self._sampler is not None:
             self._sampler.stop()
             self._sampler = None
+        self.duty.stop()
         self.batcher.stop()  # closes admission, drains, fulfills futures
         if hasattr(self.state.engine, "close"):
             # join any in-flight epoch rebuild: the drain must not race

@@ -1438,6 +1438,7 @@ def cmd_loadgen(args) -> None:
         slo_quantile=args.slo_quantile, max_bad_frac=args.max_bad_frac,
         max_inflight=args.max_inflight, timeout_s=args.timeout_ms / 1e3,
         on_step=on_step, verb_radius=args.verb_radius,
+        knee_band=args.knee_band,
     )
     cap = report["capacity"]
     if args.variant:
@@ -1481,6 +1482,13 @@ def cmd_loadgen(args) -> None:
         "steps": len(cap["steps"]),
         "arrivals": desc["arrivals"],
         "out": args.out,
+        # the capacity-headroom model's A/B verdict (None when the
+        # target exported no cost counters): predicted sustainable
+        # rate from measured cost/query vs the knee the ladder found
+        "predicted_rate": (cap.get("predicted")
+                           or {}).get("predicted_rate"),
+        "predicted_within_band": (cap.get("predicted")
+                                  or {}).get("within_band"),
     }))
 
 
@@ -1664,6 +1672,119 @@ def cmd_trace(args) -> None:
             json.dump(assembled, f, indent=2, sort_keys=True, default=str)
             f.write("\n")
         print(f"trace artifact written to {args.out}", file=sys.stderr)
+
+
+def _render_cost_report(rep: dict, indent: str = "") -> list:
+    """Human lines for one shard's ``/debug/costs`` payload: the
+    per-class cost table, the windowed cost-per-query, the headroom
+    verdict, and the maintenance (unattributed) spend."""
+    lines = []
+    classes = rep.get("classes") or []
+    if classes:
+        lines.append(f"{indent}{'class':<34s}  {'req':>8s}  "
+                     f"{'cost/q':>10s}  {'rows':>8s}  {'retries':>7s}  "
+                     f"{'bytes out':>10s}")
+        for row in classes:
+            ck = "/".join((str(row.get("verb", "?")),
+                           str(row.get("gear", "?")),
+                           str(row.get("outcome", "?"))))
+            cm = row.get("cost_ms")
+            lines.append(
+                f"{indent}{ck:<34s}  {row.get('requests', 0):>8g}  "
+                f"{f'{cm:.3f}ms' if cm is not None else '-':>10s}  "
+                f"{row.get('rows', 0):>8g}  {row.get('retries', 0):>7g}  "
+                f"{row.get('bytes_out', 0):>10g}"
+            )
+    else:
+        lines.append(f"{indent}no answered requests yet")
+    window = rep.get("window")
+    if isinstance(window, dict):
+        lines.append(
+            f"{indent}window ({window.get('window_s', 0):g}s): "
+            f"{window.get('requests', 0):g} req at "
+            f"{window.get('observed_rate', 0):g} req/s, cost/query "
+            f"{window.get('cost_per_query_ms', 0):g} ms"
+        )
+    hr = rep.get("headroom")
+    if isinstance(hr, dict):
+        if hr.get("data"):
+            lines.append(
+                f"{indent}headroom: {hr.get('headroom_frac', 0):.1%} "
+                f"(observed {hr.get('observed_rate', 0):g} vs predicted "
+                f"{hr.get('predicted_rate', 0):g} req/s"
+                + (f", busy {hr['busy_frac']:.2f}"
+                   if hr.get("busy_frac") is not None else "")
+                + ")"
+            )
+        else:
+            lines.append(f"{indent}headroom: no data (no answered "
+                         "requests in the window)")
+    maint = rep.get("maintenance")
+    if isinstance(maint, dict) and any(maint.values()):
+        lines.append(
+            f"{indent}maintenance: corrections "
+            f"{maint.get('correction_ms', 0):g} ms / "
+            f"{maint.get('correction_rows', 0):g} rows, writes "
+            f"{maint.get('write_ms', 0):g} ms, rebuilds "
+            f"{maint.get('rebuilds', 0):g} ({maint.get('rebuild_ms', 0):g}"
+            " ms) — device/wall time no request class is charged for"
+        )
+    return lines
+
+
+def cmd_costs(args) -> None:
+    """Fetch ``/debug/costs`` from a live serve or route process and
+    render the cost-attribution view (docs/OBSERVABILITY.md "Cost
+    accounting & capacity headroom"): the per-class cost/query table, the
+    windowed cost-per-query, and the capacity-headroom verdict. A router
+    target renders every shard's ledger plus the fleet aggregation;
+    ``--json`` emits the raw payload for scripting."""
+    import urllib.request
+
+    base = args.target.rstrip("/")
+    url = f"{base}/debug/costs?window={args.window_s:g}"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout_s) as resp:
+            payload = json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        print(f"cannot fetch costs from {base}: {e}", file=sys.stderr)
+        sys.exit(1)
+    if args.json:
+        json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return
+    lines = []
+    if "shards" in payload and "classes" not in payload:
+        # router payload: per-shard ledgers + the fleet headroom block
+        for ent in payload.get("shards") or []:
+            tag = (f"shard {ent.get('shard', '?')}"
+                   + (f"/r{ent['replica']}" if ent.get("replica") else "")
+                   + f" ({ent.get('url', '?')})")
+            if "error" in ent:
+                lines.append(f"== {tag}: {ent['error']} ==")
+                continue
+            lines.append(f"== {tag} ==")
+            lines.extend(_render_cost_report(ent.get("costs") or {},
+                                             indent="  "))
+        fleet = payload.get("headroom") or {}
+        lines.append("== fleet ==")
+        if fleet.get("data"):
+            lines.append(
+                f"  headroom: {fleet.get('headroom_frac', 0):.1%} "
+                f"(observed {fleet.get('observed_rate', 0):g} vs "
+                f"predicted {fleet.get('predicted_rate', 0):g} req/s "
+                f"over {fleet.get('shards_reporting', 0)}/"
+                f"{fleet.get('shards_total', 0)} shards)"
+            )
+        else:
+            lines.append(
+                f"  headroom: no data "
+                f"({fleet.get('shards_reporting', 0)}/"
+                f"{fleet.get('shards_total', 0)} shards reporting)"
+            )
+    else:
+        lines.extend(_render_cost_report(payload))
+    sys.stdout.write("\n".join(lines) + "\n")
 
 
 def cmd_lint(args) -> None:
@@ -2434,6 +2555,11 @@ def main(argv=None) -> None:
                          "capacity.ab block, and the trend knee-drop "
                          "rule fails any run whose knee is not "
                          "strictly better than its baseline")
+    lg.add_argument("--knee-band", type=float, default=0.5,
+                    help="relative band the cost ledger's predicted "
+                         "sustainable rate must land within of the "
+                         "measured knee (the capacity.predicted "
+                         "within_band verdict)")
     lg.set_defaults(fn=cmd_loadgen)
 
     st = sub.add_parser(
@@ -2626,6 +2752,27 @@ def main(argv=None) -> None:
                     help="per-fetch HTTP timeout")
     tw.set_defaults(fn=cmd_trace)
 
+    co = sub.add_parser(
+        "costs",
+        help="fetch /debug/costs from a live serve/route process and "
+             "render per-class cost/query + the capacity-headroom "
+             'verdict (docs/OBSERVABILITY.md "Cost accounting & '
+             'capacity headroom")',
+    )
+    co.add_argument("--target", default="http://127.0.0.1:8080",
+                    metavar="URL",
+                    help="shard (one ledger) or router (per-shard "
+                         "ledgers + fleet aggregation) base url")
+    co.add_argument("--window-s", type=float, default=60.0,
+                    help="history window the cost-per-query and "
+                         "headroom verdicts are computed over")
+    co.add_argument("--json", action="store_true",
+                    help="emit the raw /debug/costs payload instead of "
+                         "the rendered table")
+    co.add_argument("--timeout-s", type=float, default=5.0,
+                    help="HTTP timeout")
+    co.set_defaults(fn=cmd_costs)
+
     args = p.parse_args(argv)
     if args.platform:
         import jax
@@ -2635,7 +2782,7 @@ def main(argv=None) -> None:
         # Usage parity with Utility.cpp:109-112
         print(f"Usage: {p.prog} harness SEED DIM_POINTS  NUM_POINTS", file=sys.stderr)
         sys.exit(1)
-    if args.cmd in ("lint", "trend", "trace"):
+    if args.cmd in ("lint", "trend", "trace", "costs"):
         # pure-stdlib paths: dispatch before the engine-error plumbing
         # below. (The kdtree_tpu package import itself still pulls in
         # jax — the ANALYSIS/trend code is stdlib-only, the entry point

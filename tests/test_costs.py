@@ -1,0 +1,472 @@
+"""Cost attribution, the profiling duty cycle, and the capacity-headroom
+model (docs/OBSERVABILITY.md "Cost accounting & capacity headroom").
+
+The load-bearing invariant is the accounting identity: a batch's
+dispatch span amortized over its members sums back to the span EXACTLY
+(integer-microsecond largest-remainder split) — cost totals reconcile
+against wall clock, no request double-billed, none of the span leaked.
+The enum tests pin the KDT105 discipline: unknown verbs/gears/outcomes
+fold into "other" and can never mint a new series.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kdtree_tpu.obs import costs as cm
+from kdtree_tpu.obs import history as hist
+from kdtree_tpu.obs.registry import MetricsRegistry
+
+
+def _micros(shares):
+    return [int(round(s * 1000)) for s in shares]
+
+
+# ---------------------------------------------------------------------------
+# exact-sum amortization
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("span_ms,rows", [
+    (10.0, [1, 3, 7]),
+    (0.001, [5, 5, 5]),            # fewer micros than members
+    (7.7777, [1, 1, 1, 1, 1, 1, 1]),
+    (123.456789, [64, 1, 13, 0, 7]),
+    (5.0, [0, 0, 3]),              # zero-row members get nothing extra
+    (0.0004, [1, 2]),              # rounds to 0 us: all-zero split
+])
+def test_amortize_exact_sum_identity(span_ms, rows):
+    shares = cm.amortize_span_ms(span_ms, rows)
+    assert len(shares) == len(rows)
+    assert sum(_micros(shares)) == int(round(span_ms * 1000))
+    # monotone in rows: a bigger member never gets a smaller share
+    for i, (ri, si) in enumerate(zip(rows, shares)):
+        for rj, sj in zip(rows[i + 1:], shares[i + 1:]):
+            if ri > rj:
+                assert si >= sj
+
+
+def test_amortize_degenerate_inputs():
+    assert cm.amortize_span_ms(-1.0, [1, 2]) == [0.0, 0.0]
+    assert cm.amortize_span_ms(10.0, [0, 0]) == [0.0, 0.0]
+    assert cm.amortize_span_ms(10.0, []) == []
+    # negative row weights are clamped, not propagated
+    shares = cm.amortize_span_ms(6.0, [-5, 2, 1])
+    assert shares[0] == 0.0 and sum(_micros(shares)) == 6000
+
+
+def test_largest_remainder_split_is_deterministic_on_ties():
+    a = cm._largest_remainder(10, [1, 1, 1])
+    b = cm._largest_remainder(10, [1, 1, 1])
+    assert a == b and sum(a) == 10
+    # the extra unit goes to the earliest index on equal remainders
+    assert a[0] >= a[-1]
+
+
+def test_amortize_proportionality():
+    shares = cm.amortize_span_ms(100.0, [75, 25])
+    assert shares[0] == pytest.approx(75.0)
+    assert shares[1] == pytest.approx(25.0)
+
+
+# ---------------------------------------------------------------------------
+# the ledger: attribution identity incl. retries and corrections
+# ---------------------------------------------------------------------------
+
+
+def _class_sum(reg, family):
+    snap = reg.snapshot()["counters"]
+    return sum(v for k, v in snap.items() if k.startswith(family))
+
+
+def test_attribute_batch_identity_across_classes():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    span = 42.4242
+    members = [(8, 1.5, "ok"), (3, 0.2, "ok"), (5, 9.0, "degraded")]
+    shares = led.attribute_batch(
+        verb="knn", gear="approx:0.9", span_ms=span, members=members,
+        retries=3, visits_per_row=4)
+    # the identity: per-member shares and the class counters both sum
+    # exactly to the span at microsecond resolution
+    assert sum(_micros(shares)) == int(round(span * 1000))
+    total_dev = _class_sum(reg, "kdtree_cost_device_ms_total")
+    assert int(round(total_dev * 1000)) == int(round(span * 1000))
+    assert _class_sum(reg, "kdtree_cost_requests_total") == 3
+    assert _class_sum(reg, "kdtree_cost_rows_total") == 16
+    assert _class_sum(reg, "kdtree_cost_retries_total") == 3
+    assert _class_sum(reg, "kdtree_cost_visits_total") == 16 * 4
+    assert _class_sum(reg, "kdtree_cost_queue_ms_total") == \
+        pytest.approx(10.7)
+    # outcomes split the class: ok and degraded series both exist
+    snap = reg.snapshot()["counters"]
+    assert any('outcome="ok"' in k for k in snap
+               if k.startswith("kdtree_cost_requests_total"))
+    assert any('outcome="degraded"' in k for k in snap
+               if k.startswith("kdtree_cost_requests_total"))
+
+
+def test_attribution_identity_survives_many_uneven_batches():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    expect_us = 0
+    for i in range(50):
+        span = 0.137 * (i + 1) + 0.0007
+        rows = [(i * j) % 11 for j in range(1, 6)]
+        led.attribute_batch(
+            verb="radius", gear=None, span_ms=span,
+            members=[(r, 0.1, "ok") for r in rows], retries=i % 3)
+        if sum(rows) > 0:
+            expect_us += int(round(span * 1000))
+    got = _class_sum(reg, "kdtree_cost_device_ms_total")
+    assert int(round(got * 1000)) == expect_us
+
+
+def test_attribute_request_is_a_batch_of_one():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    dev = led.attribute_request(verb="knn", gear="exact", span_ms=3.25,
+                               rows=70, queue_ms=0.5,
+                               outcome="degraded")
+    assert dev == pytest.approx(3.25)
+    snap = reg.snapshot()["counters"]
+    key = ('kdtree_cost_requests_total{gear="exact",outcome="degraded"'
+           ',verb="knn"}')
+    assert snap[key] == 1
+
+
+def test_correction_is_maintenance_not_request_cost():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    led.attribute_correction(12.5, 64)
+    led.attribute_correction(-1.0, -3)   # clamped, never negative
+    snap = reg.snapshot()["counters"]
+    assert snap["kdtree_cost_correction_ms_total"] == \
+        pytest.approx(12.5)
+    assert snap["kdtree_cost_correction_rows_total"] == 64
+    # no request class was charged
+    assert _class_sum(reg, "kdtree_cost_requests_total") == 0
+    assert _class_sum(reg, "kdtree_cost_device_ms_total") == 0
+    rep = led.report(history=hist.MetricHistory(capacity=4))
+    assert rep["maintenance"]["correction_ms"] == pytest.approx(12.5)
+    assert rep["maintenance"]["correction_rows"] == 64
+
+
+def test_write_and_rebuild_maintenance_fold():
+    reg = MetricsRegistry()
+    cm.count_write("upsert", 1.5, registry=reg)
+    cm.count_write("compact", 2.0, registry=reg)   # folds to other
+    cm.count_rebuild(250.0, registry=reg)
+    snap = reg.snapshot()["counters"]
+    assert snap['kdtree_cost_writes_total{op="upsert"}'] == 1
+    assert snap['kdtree_cost_writes_total{op="other"}'] == 1
+    assert snap["kdtree_cost_rebuilds_total"] == 1
+    assert snap["kdtree_cost_rebuild_ms_total"] == pytest.approx(250.0)
+    led = cm.CostLedger(registry=reg)
+    rep = led.report(history=hist.MetricHistory(capacity=4))
+    assert rep["maintenance"]["writes"] == 2
+    assert rep["maintenance"]["write_ms"] == pytest.approx(3.5)
+    assert rep["maintenance"]["rebuilds"] == 1
+
+
+# ---------------------------------------------------------------------------
+# bounded class enum (KDT105: folding is total, labels cannot be minted)
+# ---------------------------------------------------------------------------
+
+
+def test_class_folding_table():
+    assert cm.verb_class(None) == "knn"
+    assert cm.verb_class("count") == "count"
+    assert cm.verb_class("count_radius") == "count"
+    assert cm.verb_class("count_range") == "count"
+    assert cm.verb_class("teleport") == "other"
+    assert cm.gear_class(None) == "exact"
+    assert cm.gear_class("") == "exact"
+    assert cm.gear_class("approx:0.97") == "approx"
+    assert cm.gear_class("brute-deadline") == "brute-deadline"
+    assert cm.gear_class("hyperdrive") == "other"
+    assert cm.outcome_class(None) == "ok"
+    assert cm.outcome_class("degraded") == "degraded"
+    assert cm.outcome_class("shed") == "other"
+
+
+def test_unknown_labels_cannot_mint_series():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    for i in range(100):
+        led.attribute_batch(
+            verb=f"verb-{i}", gear=f"gear-{i}", span_ms=1.0,
+            members=[(1, 0.0, f"outcome-{i}")])
+    snap = reg.snapshot()["counters"]
+    req = [k for k in snap
+           if k.startswith("kdtree_cost_requests_total")]
+    # 100 distinct inputs, ONE folded series
+    assert req == ['kdtree_cost_requests_total{gear="other",'
+                   'outcome="other",verb="other"}']
+    assert snap[req[0]] == 100
+    # every label value anywhere in the cost families is from the enum
+    for k in snap:
+        if not k.startswith("kdtree_cost_") or "{" not in k:
+            continue
+        inner = k[k.index("{") + 1:-1]
+        for pair in inner.split(","):
+            name, _, val = pair.partition("=")
+            val = val.strip('"')
+            if name == "verb":
+                assert val in cm.COST_VERBS
+            elif name == "gear":
+                assert val in cm.COST_GEARS
+            elif name == "outcome":
+                assert val in cm.COST_OUTCOMES
+
+
+def test_ledger_never_raises_on_garbage():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    shares = led.attribute_batch(verb="knn", gear=None,
+                                 span_ms=float("nan"),
+                                 members=[("x", None, "ok")])
+    assert len(shares) == 1   # degraded to zeros, not an exception
+    led.count_bytes(verb="knn", gear=None, outcome="ok",
+                    bytes_in="junk", bytes_out=None)
+
+
+# ---------------------------------------------------------------------------
+# the windowed model and headroom math
+# ---------------------------------------------------------------------------
+
+
+def _traffic_history(reg, led, *, busy=None):
+    """Two-sample ring: idle at t=100, then 30 requests x 2ms device
+    time by t=160 (0.5 req/s over the 60s window)."""
+    h = hist.MetricHistory(capacity=8)
+    led.attribute_batch(verb="knn", gear=None, span_ms=0.0,
+                        members=[])  # touch nothing, keep t=100 idle
+    h.record(reg.snapshot(), ts=100.0)
+    for _ in range(30):
+        led.attribute_request(verb="knn", gear=None, span_ms=2.0,
+                              rows=1, queue_ms=0.1)
+    if busy is not None:
+        reg.gauge("kdtree_device_busy_frac").set(busy)
+    h.record(reg.snapshot(), ts=160.0)
+    return h
+
+
+def test_window_costs_none_when_idle():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    h = hist.MetricHistory(capacity=4)
+    h.record(reg.snapshot(), ts=100.0)
+    h.record(reg.snapshot(), ts=160.0)
+    assert led.window_costs(60.0, h, now=160.0) is None
+    hr = led.headroom(60.0, h, now=160.0)
+    assert hr == {"data": False, "window_s": 60.0, "busy_frac": None}
+
+
+def test_headroom_math_without_busy_capture():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    h = _traffic_history(reg, led)
+    w = led.window_costs(60.0, h, now=160.0)
+    assert w["requests"] == 30
+    assert w["cost_per_query_ms"] == pytest.approx(2.0)
+    assert w["observed_rate"] == pytest.approx(0.5)
+    hr = led.headroom(60.0, h, now=160.0)
+    assert hr["data"] is True
+    # no capture yet: full 1000 ms/s budget => 500 req/s predicted
+    assert hr["busy_frac"] is None
+    assert hr["predicted_rate"] == pytest.approx(500.0)
+    assert hr["headroom_frac"] == pytest.approx(1.0 - 0.5 / 500.0)
+
+
+def test_headroom_budget_scales_with_measured_busy_frac():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    h = _traffic_history(reg, led, busy=0.5)
+    hr = led.headroom(60.0, h, now=160.0)
+    assert hr["busy_frac"] == pytest.approx(0.5)
+    # half the device budget => half the predicted rate
+    assert hr["predicted_rate"] == pytest.approx(250.0)
+
+
+def test_headroom_clamps_at_zero_when_over_predicted():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    h = hist.MetricHistory(capacity=8)
+    h.record(reg.snapshot(), ts=100.0)
+    # 100ms/query at 20 req/s observed: observed >> predicted (10/s)
+    for _ in range(1200):
+        led.attribute_request(verb="knn", gear=None, span_ms=100.0,
+                              rows=1, queue_ms=0.0)
+    h.record(reg.snapshot(), ts=160.0)
+    hr = led.headroom(60.0, h, now=160.0)
+    assert hr["predicted_rate"] == pytest.approx(10.0)
+    assert hr["headroom_frac"] == 0.0
+
+
+def test_publish_registers_gauges_lazily():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    h = hist.MetricHistory(capacity=8)
+    h.record(reg.snapshot(), ts=100.0)
+    h.record(reg.snapshot(), ts=130.0)
+    led.publish(history=h, now=130.0)
+    gauges = reg.snapshot()["gauges"]
+    # absent means "no data", never a misleading 0
+    assert "kdtree_capacity_headroom_frac" not in gauges
+    assert "kdtree_cost_per_query_ms" not in gauges
+    h2 = _traffic_history(reg, led)
+    led.publish(history=h2, now=160.0)
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["kdtree_capacity_predicted_rate"] == \
+        pytest.approx(500.0)
+    assert gauges["kdtree_cost_per_query_ms"] == pytest.approx(2.0)
+    assert 0.0 <= gauges["kdtree_capacity_headroom_frac"] <= 1.0
+
+
+def test_report_shape_and_totals_identity():
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    led.attribute_batch(verb="knn", gear=None, span_ms=10.0,
+                        members=[(4, 1.0, "ok"), (4, 1.0, "ok")])
+    led.attribute_batch(verb="radius", gear="approx:0.9", span_ms=6.0,
+                        members=[(2, 0.5, "degraded")])
+    led.count_bytes(verb="knn", gear=None, outcome="ok",
+                    bytes_in=100, bytes_out=900)
+    rep = led.report(history=hist.MetricHistory(capacity=4))
+    assert rep["costs_version"] == cm.COSTS_VERSION
+    classes = {(c["verb"], c["gear"], c["outcome"]): c
+               for c in rep["classes"]}
+    assert classes[("knn", "exact", "ok")]["requests"] == 2
+    assert classes[("knn", "exact", "ok")]["bytes_out"] == 900
+    assert classes[("radius", "approx", "degraded")]["cost_ms"] == \
+        pytest.approx(6.0)
+    t = rep["totals"]
+    assert t["requests"] == 3
+    assert int(round(t["device_ms"] * 1000)) == 16000
+    assert rep["window"] is None and rep["headroom"]["data"] is False
+
+
+# ---------------------------------------------------------------------------
+# overhead: attribution is host-side counter math, within the <2% bar
+# ---------------------------------------------------------------------------
+
+
+def test_attribution_overhead_under_two_percent():
+    """2000 batches x 8 members at a (simulated) 10ms span each is 20s
+    of attributed device time; the attribution work itself must cost
+    under 2% of that. The real ratio is ~100x under the bar — the test
+    exists to catch an accidental O(classes) scan or device sync
+    sneaking into the hot path, not to microbenchmark."""
+    reg = MetricsRegistry()
+    led = cm.CostLedger(registry=reg)
+    members = [(8, 0.5, "ok")] * 8
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        led.attribute_batch(verb="knn", gear=None, span_ms=10.0,
+                            members=members)
+    elapsed = time.perf_counter() - t0
+    attributed_s = 2000 * 10.0 / 1000.0
+    assert elapsed < 0.02 * attributed_s, \
+        f"attribution cost {elapsed:.3f}s on {attributed_s:.0f}s " \
+        f"of simulated device time"
+
+
+# ---------------------------------------------------------------------------
+# the profiling duty cycle
+# ---------------------------------------------------------------------------
+
+
+def test_duty_env_knobs(monkeypatch):
+    monkeypatch.setenv("KDTREE_TPU_PROFILE_DUTY_PERIOD_S", "17.5")
+    monkeypatch.setenv("KDTREE_TPU_PROFILE_DUTY_WINDOW_S", "0.25")
+    assert cm.duty_period_s() == 17.5
+    assert cm.duty_window_s() == 0.25
+    monkeypatch.setenv("KDTREE_TPU_PROFILE_DUTY_PERIOD_S", "garbage")
+    monkeypatch.setenv("KDTREE_TPU_PROFILE_DUTY_WINDOW_S", "-3")
+    assert cm.duty_period_s() == cm.DEFAULT_DUTY_PERIOD_S
+    assert cm.duty_window_s() == cm.DEFAULT_DUTY_WINDOW_S
+
+
+def test_duty_kill_switch_blocks_start(monkeypatch):
+    monkeypatch.setattr(cm, "_DUTY_DISABLED", True)
+    duty = cm.ProfileDutyCycle(period_s=0.05, window_s=0.01)
+    assert not duty.enabled
+    duty.start()
+    assert not duty.running
+    duty.stop()   # idempotent no-op
+
+
+def test_duty_window_skips_when_capture_busy(monkeypatch, tmp_path):
+    from kdtree_tpu.obs import flight, profile
+
+    def busy(seconds, log_dir):
+        raise profile.CaptureBusyError("manual capture in flight")
+
+    monkeypatch.setattr(profile, "capture_for", busy)
+    duty = cm.ProfileDutyCycle(log_dir=str(tmp_path))
+    before = duty._skipped.value
+    assert duty.run_window() is None
+    assert duty._skipped.value == before + 1
+    kinds = [e for e in flight.recorder().snapshot()
+             if e.get("type") == "profile.duty_skip"]
+    assert kinds and kinds[-1]["reason"] == "capture-busy"
+
+
+def test_duty_window_publishes_and_cleans_artifact(monkeypatch, tmp_path):
+    """A completed window analyzes the trace, counts, flight-records,
+    and removes the multi-MB run directory — a long-lived replica must
+    not fill the disk at one artifact per period."""
+    from kdtree_tpu.obs import flight, profile, timeline
+
+    run_dir = tmp_path / "plugins" / "profile" / "run-1"
+    run_dir.mkdir(parents=True)
+    trace = run_dir / "host.trace.json.gz"
+    trace.write_bytes(b"fake")
+
+    class FakeResult:
+        trace_file = str(trace)
+
+    monkeypatch.setattr(profile, "capture_for",
+                        lambda seconds, log_dir: FakeResult())
+    fake_rep = {"device": {"busy_frac": 0.7},
+                "dispatches": {"lag_us": {"median": 42.0}}}
+    monkeypatch.setattr(timeline, "analyze_trace_file",
+                        lambda path: dict(fake_rep))
+    duty = cm.ProfileDutyCycle(log_dir=str(tmp_path), period_s=300,
+                               window_s=0.01)
+    before = duty._windows.value
+    rep = duty.run_window()
+    assert rep["device"]["busy_frac"] == 0.7
+    assert duty._windows.value == before + 1
+    assert not run_dir.exists()   # artifact cleaned after analysis
+    ev = [e for e in flight.recorder().snapshot()
+          if e.get("type") == "profile.duty_window"]
+    assert ev and ev[-1]["busy_frac"] == 0.7
+    assert ev[-1]["lag_us_median"] == 42.0
+
+
+def test_duty_thread_lifecycle(monkeypatch, tmp_path):
+    from kdtree_tpu.obs import profile
+
+    calls = []
+
+    def fake_capture(seconds, log_dir):
+        calls.append(seconds)
+        raise profile.CaptureBusyError("keep the loop cheap")
+
+    monkeypatch.setattr(profile, "capture_for", fake_capture)
+    duty = cm.ProfileDutyCycle(log_dir=str(tmp_path), period_s=0.05,
+                               window_s=0.01)
+    duty.start()
+    assert duty.running
+    duty.start()   # idempotent
+    deadline = time.time() + 5.0
+    while not calls and time.time() < deadline:
+        time.sleep(0.01)
+    duty.stop()
+    assert calls, "duty thread never attempted a window"
+    assert not duty.running
+    duty.stop()    # idempotent

@@ -878,3 +878,72 @@ def test_trend_treats_changed_verb_mix_as_incommensurable():
     # identical mixes stay comparable
     assert _capacity_facts(
         cap(["knn", "radius", "count"]))["verbs"] == mixed["verbs"]
+
+
+# ---------------------------------------------------------------------------
+# cost columns + the predicted-knee A/B (docs/OBSERVABILITY.md
+# "Cost accounting & capacity headroom")
+# ---------------------------------------------------------------------------
+
+
+def test_cost_delta_arithmetic_and_absence():
+    snap0 = {"knn/exact/ok": {"requests": 10.0, "device_ms": 20.0}}
+    snap1 = {"knn/exact/ok": {"requests": 25.0, "device_ms": 80.0},
+             "radius/exact/ok": {"requests": 5.0, "device_ms": 10.0}}
+    d = lg_runner._cost_delta(snap0, snap1)
+    assert d["knn/exact/ok"] == {"requests": 15, "device_ms": 60.0,
+                                 "cost_ms": 4.0}
+    # a class born mid-window deltas against zero
+    assert d["radius/exact/ok"]["requests"] == 5
+    # missing snapshots and empty windows are None, never fake zeros
+    assert lg_runner._cost_delta(None, snap1) is None
+    assert lg_runner._cost_delta(snap0, None) is None
+    assert lg_runner._cost_delta(snap1, snap1) is None
+
+
+def test_scrape_cost_classes_sums_federation_labels():
+    text = "\n".join([
+        '# TYPE kdtree_cost_requests_total counter',
+        'kdtree_cost_requests_total{shard="0",gear="exact",'
+        'outcome="ok",verb="knn"} 3',
+        'kdtree_cost_requests_total{shard="1",gear="exact",'
+        'outcome="ok",verb="knn"} 4',
+        'kdtree_cost_device_ms_total{shard="0",gear="exact",'
+        'outcome="ok",verb="knn"} 9.5',
+        'kdtree_cost_device_ms_total{shard="1",gear="exact",'
+        'outcome="ok",verb="knn"} 2.5',
+        'kdtree_cost_requests_total{gear="approx",outcome="ok",'
+        'verb="radius"} 2',
+    ])
+    classes = lg_runner._parse_cost_classes(text)
+    assert classes["knn/exact/ok"]["requests"] == 7.0
+    assert classes["knn/exact/ok"]["device_ms"] == 12.0
+    assert classes["radius/approx/ok"]["requests"] == 2.0
+
+
+def test_cost_columns_and_predicted_block_e2e(live_server):
+    """Each ladder step carries the boundary-scraped per-class cost
+    deltas, and the capacity block carries the headroom model's
+    predicted rate judged against the measured knee."""
+    target = _target(live_server)
+    facts = lg_runner.discover(target, retries=10)
+    sched = build_schedule([40, 80], 1.5, 29, facts["dim"])
+    rep = lg_runner.run_load(target, sched, k=2, slo_ms=250.0,
+                             timeout_s=10.0, knee_band=4.0)
+    cap = rep["capacity"]
+    costed = [s for s in cap["steps"] if s.get("costs")]
+    assert costed, cap["steps"]
+    for s in costed:
+        for ck, ent in s["costs"].items():
+            verb, gear, outcome = ck.split("/")
+            assert ent["requests"] > 0
+            assert ent["cost_ms"] == pytest.approx(
+                ent["device_ms"] / ent["requests"], rel=1e-2)
+    pred = cap["predicted"]
+    assert pred["cost_per_query_ms"] > 0
+    assert pred["predicted_rate"] == pytest.approx(
+        1000.0 / pred["cost_per_query_ms"], rel=1e-2)
+    assert pred["band"] == 4.0
+    assert pred["knee_rate"] == cap["knee_rate"]
+    assert pred["within_band"] in (True, False)
+    assert any(ck.startswith("knn/") for ck in pred["classes"])

@@ -761,3 +761,68 @@ def test_render_report_diff_capacity_knee_and_p99():
     # one-sided: a capacity block appearing is itself the signal
     text = export.render_report_diff({"counters": {}}, new)
     assert "new" in text and "knee rate" in text
+
+
+def test_cost_lines_single_and_diff_views():
+    """The per-class cost table: one shared renderer for stats and
+    stats --diff, with the "<- cost grew" flag past the salience
+    threshold (docs/OBSERVABILITY.md "Cost accounting")."""
+    old = {
+        'kdtree_cost_requests_total{gear="exact",outcome="ok",'
+        'verb="knn"}': 100.0,
+        'kdtree_cost_device_ms_total{gear="exact",outcome="ok",'
+        'verb="knn"}': 200.0,
+        'kdtree_cost_queue_ms_total{gear="exact",outcome="ok",'
+        'verb="knn"}': 50.0,
+    }
+    new = {
+        'kdtree_cost_requests_total{gear="exact",outcome="ok",'
+        'verb="knn"}': 200.0,
+        'kdtree_cost_device_ms_total{gear="exact",outcome="ok",'
+        'verb="knn"}': 600.0,   # 2.0 -> 3.0 ms/query: +50%
+        'kdtree_cost_requests_total{gear="approx",outcome="ok",'
+        'verb="radius"}': 10.0,
+        'kdtree_cost_device_ms_total{gear="approx",outcome="ok",'
+        'verb="radius"}': 5.0,
+    }
+    single = "\n".join(export._cost_lines(new))
+    assert "knn/exact/ok" in single
+    assert "3.000ms" in single
+    assert "radius/approx/ok" in single
+    diff = "\n".join(export._cost_lines(new, old_counters=old))
+    assert "+50.0%" in diff and "<- cost grew" in diff
+    assert "new" in diff          # the class born between snapshots
+    # no cost counters at all: the block is absent, not an empty table
+    assert export._cost_lines({}) == []
+    # growth inside the 5% salience band carries no flag
+    near = dict(old)
+    near['kdtree_cost_device_ms_total{gear="exact",outcome="ok",'
+         'verb="knn"}'] = 206.0
+    calm = "\n".join(export._cost_lines(near, old_counters=old))
+    assert "<- cost grew" not in calm
+
+
+def test_render_report_carries_cost_block():
+    rep = {
+        "report_version": 1,
+        "counters": {
+            'kdtree_cost_requests_total{gear="exact",outcome="ok",'
+            'verb="knn"}': 4.0,
+            'kdtree_cost_device_ms_total{gear="exact",outcome="ok",'
+            'verb="knn"}': 10.0,
+        },
+        "gauges": {}, "histograms": {}, "spans": [],
+    }
+    out = export.render_report(rep)
+    assert "cost per query" in out and "knn/exact/ok" in out
+    diff = export.render_report_diff(rep, {
+        "report_version": 1,
+        "counters": {
+            'kdtree_cost_requests_total{gear="exact",outcome="ok",'
+            'verb="knn"}': 4.0,
+            'kdtree_cost_device_ms_total{gear="exact",outcome="ok",'
+            'verb="knn"}': 20.0,
+        },
+        "gauges": {}, "histograms": {}, "spans": [],
+    })
+    assert "cost per query" in diff and "<- cost grew" in diff

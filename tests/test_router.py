@@ -2134,3 +2134,88 @@ def test_parent_router_refuses_writes_crisply(spatial_fleet):
         q = _near(SP_CENTERS[1], 72)
         status, out = _post(parent, {"queries": q.tolist(), "k": K})
         assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# fleet capacity headroom (docs/OBSERVABILITY.md "Cost accounting")
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_headroom_aggregation_and_ejection(shards):
+    """The router sums routable shards' health-detail headroom blocks;
+    an ejected shard contributes NOTHING, so losing capacity reads as a
+    predicted-rate drop, never as phantom headroom."""
+    with router_for(shards) as router:
+        for shard in router.shards:
+            router._probe_health(shard)
+        # the real probe already carries each shard's headroom block
+        for shard in router.shards:
+            assert "headroom" in shard.health_detail
+        hr = router.fleet_headroom()
+        assert hr["shards_total"] == N_SHARDS
+        # the aggregation itself is dict math over health_detail —
+        # fabricate live blocks to pin the sums exactly
+        for i, shard in enumerate(router.shards):
+            shard.health_detail = {"headroom": {
+                "data": True, "predicted_rate": 100.0 + i,
+                "observed_rate": 10.0, "headroom_frac": 0.9,
+            }}
+        hr = router.fleet_headroom()
+        assert hr["data"] is True
+        assert hr["shards_reporting"] == N_SHARDS
+        assert hr["predicted_rate"] == pytest.approx(303.0)
+        assert hr["observed_rate"] == pytest.approx(30.0)
+        assert hr["headroom_frac"] == pytest.approx(1.0 - 30.0 / 303.0)
+        # ejection: shard 1 unhealthy -> its 101 req/s leave the fleet
+        router.shards[1].healthy = False
+        hr = router.fleet_headroom()
+        assert hr["shards_reporting"] == N_SHARDS - 1
+        assert hr["predicted_rate"] == pytest.approx(202.0)
+        ent = hr["shards"][1]
+        assert ent["routable"] is False and "headroom" not in ent
+        router.shards[1].healthy = True
+        # a malformed block reads as absent, never a crash
+        router.shards[2].health_detail = {"headroom": {
+            "data": True, "predicted_rate": "wat"}}
+        hr = router.fleet_headroom()
+        assert hr["shards_reporting"] == N_SHARDS - 1
+        assert hr["predicted_rate"] == pytest.approx(201.0)
+        # a data:false block counts as present-but-not-reporting
+        router.shards[2].health_detail = {"headroom": {"data": False}}
+        hr = router.fleet_headroom()
+        assert hr["shards_reporting"] == N_SHARDS - 1
+        # the router /healthz carries the fleet block
+        status, body = _get(router, "/healthz")
+        assert status == 200 and "headroom" in body
+        assert body["headroom"]["shards_total"] == N_SHARDS
+
+
+def test_router_debug_costs_fans_out(shards):
+    """GET /debug/costs on the router returns every shard's ledger plus
+    the fleet headroom aggregation; a dead shard is an error entry,
+    never a failed fan-out."""
+    with router_for(shards) as router:
+        # drive one routed request so every shard has a knn class
+        status, _ = _post(router, {"queries": _queries(2).tolist()})
+        assert status == 200
+        status, rep = _get(router, "/debug/costs")
+        assert status == 200
+        assert rep["headroom"]["shards_total"] == N_SHARDS
+        with_ledgers = [e for e in rep["shards"] if "costs" in e]
+        assert len(with_ledgers) == N_SHARDS
+        for ent in with_ledgers:
+            classes = ent["costs"]["classes"]
+            assert any(c["verb"] == "knn" and c["requests"] >= 1
+                       for c in classes), (ent["shard"], classes)
+        # unreachable shard: error entry, the rest still answer
+        router.shards[0].port = 1  # nothing listens there
+        try:
+            status, rep = _get(router, "/debug/costs")
+            assert status == 200
+            errs = [e for e in rep["shards"] if "error" in e]
+            assert len(errs) == 1 and errs[0]["error"] == "unreachable"
+            assert len([e for e in rep["shards"] if "costs" in e]) \
+                == N_SHARDS - 1
+        finally:
+            router.shards[0].port = int(
+                router.shards[0].url.rsplit(":", 1)[1])

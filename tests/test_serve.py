@@ -780,3 +780,86 @@ def test_debug_profile_captures_live_traffic(server, tmp_path):
     # the serve.batch span (sync=False, but it materializes the result
     # inside the span) must correlate with the batch's device slices
     assert rep["correlated_spans"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# cost attribution & capacity headroom (docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+
+def test_costs_attributed_end_to_end(tree):
+    """Answered requests land in the bounded cost classes with byte
+    accounting, /debug/costs serves the ledger, /healthz carries the
+    headroom block, and the cost families are on /metrics. The server
+    shares the process-global registry, so counter checks are DELTAS
+    against a pre-traffic snapshot — earlier tests in the session may
+    already have charged these classes. (Absence-not-zero headroom and
+    lazy-gauge contracts are pinned hermetically in test_costs.py.)"""
+
+    def _by_class(rep):
+        return {(c["verb"], c["gear"], c["outcome"]): c
+                for c in rep["classes"]}
+
+    with fresh_server(tree) as httpd:
+        base = _by_class(json.loads(_get(httpd, "/debug/costs")[1]))
+
+        q = [[0.1, 0.2, 0.3], [0.5, 0.5, 0.5]]
+        for _ in range(3):
+            status, _ = _post(httpd, {"queries": q, "k": 2})
+            assert status == 200
+        req = urllib.request.Request(
+            _url(httpd, "/v1/radius"),
+            data=json.dumps({"queries": [q[0]], "r": 10.0}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 200
+
+        status, body = _get(httpd, "/debug/costs")
+        assert status == 200
+        rep = json.loads(body)
+        assert rep["costs_version"] == 1
+        classes = _by_class(rep)
+
+        def delta(ck, field):
+            return classes[ck][field] - base.get(ck, {}).get(field, 0)
+
+        knn = ("knn", "exact", "ok")
+        assert delta(knn, "requests") == 3 and delta(knn, "rows") == 6
+        assert delta(knn, "device_ms") > 0
+        assert delta(knn, "bytes_in") > 0 and delta(knn, "bytes_out") > 0
+        assert classes[knn]["cost_ms"] > 0
+        rad = ("radius", "exact", "ok")
+        assert delta(rad, "requests") == 1 and delta(rad, "device_ms") > 0
+        # totals reconcile with the per-class table
+        assert rep["totals"]["requests"] == sum(
+            c["requests"] for c in rep["classes"])
+        # the headroom verdict always ships with an explicit data bit
+        assert isinstance(rep["headroom"]["data"], bool)
+        assert "window_s" in rep["headroom"]
+        # ?window= parses (and garbage falls back, never 500s)
+        assert _get(httpd, "/debug/costs?window=5")[0] == 200
+        assert _get(httpd, "/debug/costs?window=junk")[0] == 200
+
+        status, hz = _get(httpd, "/healthz")
+        hr = json.loads(hz)["headroom"]
+        assert isinstance(hr["data"], bool) and "window_s" in hr
+
+        status, metrics = _get(httpd, "/metrics")
+        assert ('kdtree_cost_requests_total{gear="exact",outcome="ok"'
+                ',verb="knn"}') in metrics
+        assert "# TYPE kdtree_cost_device_ms_total counter" in metrics
+
+
+def test_costs_deadline_straggler_lands_degraded(tree):
+    """A request answered past its deadline is charged to the degraded
+    outcome class — cost attribution follows the served contract, not
+    the request's intent."""
+    with fresh_server(tree) as httpd:
+        status, out = _post(
+            httpd, {"queries": [[0.0] * DIM], "deadline_ms": 0.001})
+        assert status == 200 and out["degraded"] is not None
+        rep = json.loads(_get(httpd, "/debug/costs")[1])
+        degraded = [c for c in rep["classes"]
+                    if c["outcome"] == "degraded"]
+        assert degraded and sum(c["requests"] for c in degraded) >= 1

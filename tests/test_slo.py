@@ -353,3 +353,44 @@ def test_slo_chain_end_to_end_page_and_recover(tree, tmp_path, monkeypatch):
         assert len(limited["events"]) <= 2
     finally:
         httpd.stop()
+
+
+# ---------------------------------------------------------------------------
+# device-busy feed modes (the profiling duty cycle vs manual captures)
+# ---------------------------------------------------------------------------
+
+
+def test_device_busy_data_false_until_any_capture_feeds_it():
+    """The device-busy SLO is fed by whatever publishes
+    kdtree_device_busy_frac — the background duty cycle when armed,
+    manual /debug/profile captures otherwise. With NEITHER having run,
+    the verdict must stay data:false forever (an unfed gauge is missing
+    data, never a burn), and the first published sample flips it live.
+    Regression for the duty-cycle wiring: the engine itself must not
+    care which mode fed the gauge."""
+    reg = MetricsRegistry()
+    h = hist.MetricHistory(capacity=16)
+    spec = next(s for s in slo.default_specs()
+                if s.name == "device-busy")
+    eng = slo.SloEngine([spec], history=h, registry=reg)
+    # mode 0: no duty cycle, no manual capture — gauge never set
+    for i in range(5):
+        h.record(reg.snapshot(), ts=100.0 + i)
+    det = eng.evaluate(now=104.0)["device-busy"]
+    assert det["data"] is False
+    assert det["state"] == "OK"   # never pages on absence of data
+    # either feed mode publishes the same gauge; one healthy sample
+    # makes the verdict live (data:true, still OK)
+    reg.gauge("kdtree_device_busy_frac").set(0.9)
+    for i in range(5, 10):
+        h.record(reg.snapshot(), ts=100.0 + i)
+    det = eng.evaluate(now=109.0)["device-busy"]
+    assert det["data"] is True
+    assert det["state"] == "OK"
+    # and a sustained below-threshold busy_frac burns for real
+    reg.gauge("kdtree_device_busy_frac").set(0.1)
+    for i in range(10, 40):
+        h.record(reg.snapshot(), ts=100.0 + i)
+    det = eng.evaluate(now=139.0)["device-busy"]
+    assert det["data"] is True
+    assert det["state"] in ("WARN", "PAGE")

@@ -617,3 +617,85 @@ def test_knee_drop_tolerates_malformed_and_absent_ab(tmp_path):
     run2 = tr.load_run(_write(tmp_path, "v.json", rep2))
     assert run2["capacity"]["variant"] == "pooled"
     assert run2["capacity"]["ab"]["baseline_variant"] == "fresh"
+
+
+# ---------------------------------------------------------------------------
+# cost-growth (per-class device cost/query from the capacity cost columns)
+# ---------------------------------------------------------------------------
+
+
+def _costed_report(cost_ms_per_query, knee=100.0,
+                   classes=("knn/exact/ok",)):
+    rep = _loadgen_report(knee)
+    for s in rep["capacity"]["steps"]:
+        s["costs"] = {
+            ck: {"requests": 10,
+                 "device_ms": 10 * cost_ms_per_query,
+                 "cost_ms": cost_ms_per_query}
+            for ck in classes
+        }
+    return rep
+
+
+def test_cost_growth_flagged_and_grandfatherable(tmp_path):
+    runs = [
+        tr.load_run(_write(tmp_path, "c1.json", _costed_report(2.0))),
+        tr.load_run(_write(tmp_path, "c2.json", _costed_report(5.0))),
+    ]
+    findings, _ = tr.analyze(runs, band=0.3)
+    assert [f["rule"] for f in findings] == ["cost-growth"]
+    assert findings[0]["metric"] == "capacity:cost:knn/exact/ok"
+    assert "2" in findings[0]["detail"] and "5" in findings[0]["detail"]
+    # grandfathering works exactly like capacity-drop's
+    base_path = str(tmp_path / "base.json")
+    tr.save_baseline(base_path, findings)
+    assert tr.partition(findings, tr.load_baseline(base_path)) == []
+    # growth inside the band, or IMPROVEMENT, is clean
+    runs2 = [
+        tr.load_run(_write(tmp_path, "c3.json", _costed_report(2.0))),
+        tr.load_run(_write(tmp_path, "c4.json", _costed_report(2.2))),
+        tr.load_run(_write(tmp_path, "c5.json", _costed_report(1.0))),
+    ]
+    findings2, _ = tr.analyze(runs2, band=0.3)
+    assert findings2 == []
+
+
+def test_cost_mix_change_is_incommensurable(tmp_path):
+    """A changed class mix is a changed workload: the per-class cost
+    cursor only compares shared classes, and the KNEE comparison skips
+    the pair entirely (same rule as a changed gear/verb mix)."""
+    runs = [
+        tr.load_run(_write(tmp_path, "m1.json", _costed_report(
+            2.0, knee=100.0, classes=("knn/exact/ok",)))),
+        tr.load_run(_write(tmp_path, "m2.json", _costed_report(
+            9.0, knee=25.0,
+            classes=("knn/approx/ok", "radius/exact/ok")))),
+    ]
+    findings, _ = tr.analyze(runs, band=0.3)
+    # no shared class -> no cost comparison; changed mix -> the 4x
+    # knee drop is NOT a finding either
+    assert findings == []
+    # shared classes still compare across a mix extension
+    runs2 = [
+        tr.load_run(_write(tmp_path, "m3.json", _costed_report(
+            2.0, classes=("knn/exact/ok",)))),
+        tr.load_run(_write(tmp_path, "m4.json", _costed_report(
+            9.0, classes=("knn/exact/ok", "radius/exact/ok")))),
+    ]
+    findings2, _ = tr.analyze(runs2, band=0.3)
+    assert [f["rule"] for f in findings2] == ["cost-growth"]
+    assert findings2[0]["metric"] == "capacity:cost:knn/exact/ok"
+
+
+def test_cost_growth_skips_cost_free_interposed_runs(tmp_path):
+    """A plain bench sidecar (no capacity) or a pre-cost loadgen report
+    between two cost-bearing runs must neither compare nor reset the
+    cursor — same discipline as the recall and fan-out cursors."""
+    runs = [
+        tr.load_run(_write(tmp_path, "s1.json", _costed_report(2.0))),
+        tr.load_run(_write(tmp_path, "s2.json",
+                           _loadgen_report(100.0))),  # pre-cost
+        tr.load_run(_write(tmp_path, "s3.json", _costed_report(5.0))),
+    ]
+    findings, _ = tr.analyze(runs, band=0.3)
+    assert [f["rule"] for f in findings] == ["cost-growth"]
